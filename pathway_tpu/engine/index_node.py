@@ -1,0 +1,183 @@
+"""External-index operator: incremental index maintenance + as-of-now queries.
+
+TPU-native rebuild of the reference external-index machinery (reference:
+src/engine/dataflow/operators/external_index.rs use_external_index_as_of_now
+_core:76 — index stream broadcast to every worker, batched by time;
+src/external_integration/mod.rs IndexDerivedImpl:50). Departure: instead of
+replicating the index per worker, the KNN buffer is a device array shardable
+over the TPU mesh (ops/knn.py); queries batch through XLA.
+
+Within one engine time, index updates apply before queries — the same
+timestamp-synchronized contract as the reference's batch_by_time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from pathway_tpu.engine.engine import Engine, Node
+from pathway_tpu.engine.operators import _DiffCache
+from pathway_tpu.engine.value import ERROR, Error, Pointer
+
+
+class IndexImpl:
+    """Interface every index backend implements (reference:
+    trait ExternalIndex, external_integration/mod.rs:40-48)."""
+
+    def add(self, key: Pointer, value: Any, metadata: Any) -> None:
+        raise NotImplementedError
+
+    def remove(self, key: Pointer) -> None:
+        raise NotImplementedError
+
+    def search(
+        self, value: Any, k: int, metadata_filter: str | None
+    ) -> List[tuple]:
+        """Return [(key, score)] ranked best-first."""
+        raise NotImplementedError
+
+    def search_many(
+        self, values: List[Any], ks: List[int], filters: List[str | None]
+    ) -> List[List[tuple]]:
+        """Batched search — backends override to hit XLA once per batch."""
+        return [
+            self.search(v, k, f) for v, k, f in zip(values, ks, filters)
+        ]
+
+
+class ExternalIndexNode(Node):
+    """inputs: [data, queries]. Output universe = query keys; columns =
+    (match_ids, match_scores, *per-data-column tuples) — repacking fused into
+    the operator (reference splits this into index op + asof-now join,
+    data_index.py:294)."""
+
+    name = "external_index"
+
+    def __init__(
+        self,
+        engine: Engine,
+        data_node: Node,
+        query_node: Node,
+        index_impl: IndexImpl,
+        data_value_prog,
+        data_filter_prog,  # may be None
+        query_value_prog,
+        query_k_prog,
+        query_filter_prog,  # may be None
+        *,
+        data_width: int,
+        as_of_now: bool = True,
+    ):
+        super().__init__(engine, [data_node, query_node])
+        self.index = index_impl
+        self.data_value_prog = data_value_prog
+        self.data_filter_prog = data_filter_prog
+        self.query_value_prog = query_value_prog
+        self.query_k_prog = query_k_prog
+        self.query_filter_prog = query_filter_prog
+        self.data_width = data_width
+        self.as_of_now = as_of_now
+        self.data_rows: Dict[Pointer, tuple] = {}
+        # retained only when not as_of_now (query results track index changes)
+        self.query_rows: Dict[Pointer, tuple] = {}  # key -> (value, k, filter)
+        self.cache = _DiffCache()
+        self._emitted_asof: Dict[Pointer, tuple] = {}
+
+    def process(self, time: int) -> None:
+        data_deltas = self.take(0)
+        query_deltas = self.take(1)
+        if not data_deltas and not query_deltas:
+            return
+        index_changed = False
+        if data_deltas:
+            keys = [d[0] for d in data_deltas]
+            rows = ([d[1] for d in data_deltas],)
+            values = self.data_value_prog(keys, rows)
+            metas = (
+                self.data_filter_prog(keys, rows)
+                if self.data_filter_prog is not None
+                else [None] * len(keys)
+            )
+            for (key, row, diff), value, meta in zip(data_deltas, values, metas):
+                if diff > 0:
+                    if isinstance(value, Error) or value is None:
+                        self.log_error("index: invalid data value")
+                        continue
+                    self.index.add(key, value, meta)
+                    self.data_rows[key] = row
+                    index_changed = True
+                else:
+                    self.index.remove(key)
+                    self.data_rows.pop(key, None)
+                    index_changed = True
+
+        out = []
+        if query_deltas:
+            q_keys = [d[0] for d in query_deltas]
+            q_rows = ([d[1] for d in query_deltas],)
+            q_values = self.query_value_prog(q_keys, q_rows)
+            q_ks = self.query_k_prog(q_keys, q_rows)
+            q_filters = (
+                self.query_filter_prog(q_keys, q_rows)
+                if self.query_filter_prog is not None
+                else [None] * len(q_keys)
+            )
+            if self.as_of_now:
+                live = []
+                for (qk, _qrow, diff), value, k, filt in zip(
+                    query_deltas, q_values, q_ks, q_filters
+                ):
+                    if diff > 0:
+                        live.append((qk, value, k, filt, diff))
+                    else:
+                        prev = self._emitted_asof.pop(qk, None)
+                        if prev is not None:
+                            out.append((qk, prev, -1))
+                results = self.index.search_many(
+                    [v for _, v, _, _, _ in live],
+                    [int(k) if k is not None else 3 for _, _, k, _, _ in live],
+                    [f for _, _, _, f, _ in live],
+                )
+                for (qk, _v, _k, _f, diff), matches in zip(live, results):
+                    row = self._result_row(matches)
+                    self._emitted_asof[qk] = row
+                    out.append((qk, row, diff))
+            else:
+                for (qk, _qrow, diff), value, k, filt in zip(
+                    query_deltas, q_values, q_ks, q_filters
+                ):
+                    if diff > 0:
+                        self.query_rows[qk] = (value, k, filt)
+                    else:
+                        self.query_rows.pop(qk, None)
+
+        if not self.as_of_now and (index_changed or query_deltas):
+            items = list(self.query_rows.items())
+            results = self.index.search_many(
+                [v for _, (v, _, _) in items],
+                [int(k) if k is not None else 3 for _, (_, k, _) in items],
+                [f for _, (_, _, f) in items],
+            )
+            current = {
+                qk: self._result_row(matches)
+                for (qk, _), matches in zip(items, results)
+            }
+            for qk, row in current.items():
+                self.cache.diff(qk, {qk: row}, out)
+            gone = set(self.cache.emitted.keys()) - set(current.keys())
+            for qk in gone:
+                self.cache.diff(qk, {}, out)
+        self.emit(time, out)
+
+    def _result_row(self, matches: List[tuple]) -> tuple:
+        ids = tuple(k for k, _s in matches)
+        scores = tuple(float(s) for _k, s in matches)
+        col_tuples = []
+        for ci in range(self.data_width):
+            col_tuples.append(
+                tuple(
+                    self.data_rows[k][ci] if k in self.data_rows else None
+                    for k, _s in matches
+                )
+            )
+        return (ids, scores, *col_tuples)

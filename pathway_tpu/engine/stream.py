@@ -1,0 +1,102 @@
+"""Delta streams — the engine's unit of data exchange.
+
+TPU-native rebuild of differential-dataflow update semantics restricted to
+totally-ordered times (the reference's engine time is a total order too:
+src/engine/timestamp.rs — u64, even values mark batch boundaries). A delta is
+`(key, values, diff)` with diff ∈ {+1, -1}; a batch is all deltas of one
+logical time. Consolidation sums diffs of equal (key, values) pairs so
+operators see a minimal change set.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Tuple
+
+from pathway_tpu.engine.value import Pointer, values_equal
+
+# (key, values-tuple, diff)
+Delta = Tuple[Pointer, tuple, int]
+
+
+def consolidate(deltas: Iterable[Delta]) -> List[Delta]:
+    """Sum diffs of identical (key, values); drop zero net changes. Keeps
+    retractions before insertions per key so single-valued state transitions
+    are well-ordered."""
+    acc: dict = {}
+    order: list = []
+    for key, values, diff in deltas:
+        try:
+            group = (key, _hashable(values))
+        except TypeError:
+            group = (key, id(values))
+        if group in acc:
+            acc[group][2] += diff
+        else:
+            entry = [key, values, diff]
+            acc[group] = entry
+            order.append(entry)
+    out = [
+        (key, values, diff) for key, values, diff in order if diff != 0
+    ]
+    # retractions first, insertions second; stable within each class
+    out.sort(key=lambda d: 0 if d[2] < 0 else 1)
+    return out
+
+
+def _hashable(values: tuple):
+    return tuple(_hashable_one(v) for v in values)
+
+
+def _hashable_one(v: Any):
+    import numpy as np
+
+    if isinstance(v, np.ndarray):
+        return (v.shape, v.tobytes())
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable_one(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable_one(x)) for k, x in v.items()))
+    return v
+
+
+class TableState:
+    """Materialized current content of a stream: key -> values tuple.
+
+    Enforces the unique-key-per-universe invariant (a Pathway table is a
+    keyed collection, not a general multiset)."""
+
+    __slots__ = ("rows",)
+
+    def __init__(self):
+        self.rows: dict = {}
+
+    def apply(self, deltas: Iterable[Delta], *, source: str = "") -> None:
+        for key, values, diff in deltas:
+            if diff < 0:
+                for _ in range(-diff):
+                    if key not in self.rows:
+                        raise KeyError(
+                            f"{source}: retraction of absent key {key!r}"
+                        )
+                    del self.rows[key]
+            else:
+                for _ in range(diff):
+                    if key in self.rows and not values_equal_tuple(
+                        self.rows[key], values
+                    ):
+                        raise KeyError(
+                            f"{source}: duplicate key {key!r}: "
+                            f"{self.rows[key]!r} vs {values!r}"
+                        )
+                    self.rows[key] = values
+
+    def snapshot_deltas(self) -> List[Delta]:
+        return [(k, v, 1) for k, v in self.rows.items()]
+
+
+def values_equal_tuple(a: tuple, b: tuple) -> bool:
+    if a is b:
+        return True
+    if len(a) != len(b):
+        return False
+    return all(values_equal(x, y) for x, y in zip(a, b))

@@ -1,0 +1,316 @@
+"""Engine value model: keys, pointers, Json, Error/Pending sentinels.
+
+TPU-native rebuild of the reference's value layer (reference:
+src/engine/value.rs:41-231). Keys are 128-bit hashes (blake2b-derived, the
+stdlib equivalent of the reference's xxh3-128) so row identity is stable across
+workers and restarts; the low SHARD_BITS bits select the data-parallel shard —
+on TPU the shard maps to a mesh device / host worker.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json as _json
+import struct
+from typing import Any, Iterable
+
+import numpy as np
+
+SHARD_BITS = 16
+SHARD_MASK = (1 << SHARD_BITS) - 1
+_KEY_MASK = (1 << 128) - 1
+
+
+class Error:
+    """Singleton-ish error value (reference: Value::Error). Errors propagate
+    through expressions and reducers; `fill_error` replaces them."""
+
+    __slots__ = ("trace",)
+    _instance: "Error | None" = None
+
+    def __new__(cls, trace: str | None = None):
+        if trace is None and cls._instance is not None:
+            return cls._instance
+        obj = super().__new__(cls)
+        obj.trace = trace
+        if trace is None:
+            cls._instance = obj
+        return obj
+
+    def __repr__(self) -> str:
+        return "Error"
+
+    def __bool__(self):
+        raise ValueError("cannot convert Error to bool")
+
+
+ERROR = Error()
+
+
+class _Pending:
+    """Placeholder for not-yet-computed fully-async UDF results
+    (reference: Value::Pending)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "Pending"
+
+
+Pending = _Pending()
+
+
+class Pointer:
+    """A row id: 128-bit key (reference: Key(u128), value.rs:41).
+
+    Optionally remembers the values it was derived from for debug printing.
+    """
+
+    __slots__ = ("value", "_origin")
+
+    def __init__(self, value: int, origin: tuple | None = None):
+        self.value = value & _KEY_MASK
+        self._origin = origin
+
+    def __eq__(self, other):
+        return isinstance(other, Pointer) and self.value == other.value
+
+    def __lt__(self, other):
+        return self.value < other.value
+
+    def __le__(self, other):
+        return self.value <= other.value
+
+    def __gt__(self, other):
+        return self.value > other.value
+
+    def __ge__(self, other):
+        return self.value >= other.value
+
+    def __hash__(self):
+        return hash(self.value)
+
+    def __repr__(self):
+        if self._origin is not None and len(self._origin) == 1:
+            return f"^{self._origin[0]}"
+        return f"^{self.value:032X}"[:12]
+
+    @property
+    def shard(self) -> int:
+        return self.value & SHARD_MASK
+
+    def with_shard_of(self, other: "Pointer") -> "Pointer":
+        return Pointer((self.value & ~SHARD_MASK) | (other.value & SHARD_MASK))
+
+
+def _hash_bytes(data: bytes) -> int:
+    return int.from_bytes(hashlib.blake2b(data, digest_size=16).digest(), "little")
+
+
+def _serialize_for_hash(value: Any, out: list) -> None:
+    if value is None:
+        out.append(b"\x00N")
+    elif isinstance(value, bool):
+        out.append(b"\x01" + (b"\x01" if value else b"\x00"))
+    elif isinstance(value, int):
+        out.append(b"\x02" + value.to_bytes(16, "little", signed=True))
+    elif isinstance(value, float):
+        if value.is_integer() and abs(value) < 2**62:
+            # ints and integral floats hash identically (reference HashInto
+            # treats 1 == 1.0 for keying)
+            out.append(b"\x02" + int(value).to_bytes(16, "little", signed=True))
+        else:
+            out.append(b"\x03" + struct.pack("<d", value))
+    elif isinstance(value, str):
+        b = value.encode()
+        out.append(b"\x04" + len(b).to_bytes(8, "little") + b)
+    elif isinstance(value, bytes):
+        out.append(b"\x05" + len(value).to_bytes(8, "little") + value)
+    elif isinstance(value, Pointer):
+        out.append(b"\x06" + value.value.to_bytes(16, "little"))
+    elif isinstance(value, (tuple, list)):
+        out.append(b"\x07" + len(value).to_bytes(8, "little"))
+        for v in value:
+            _serialize_for_hash(v, out)
+    elif isinstance(value, np.ndarray):
+        out.append(b"\x08" + str(value.dtype).encode() + value.tobytes())
+    elif isinstance(value, Json):
+        out.append(b"\x09" + _json.dumps(value.value, sort_keys=True).encode())
+    else:
+        import datetime
+
+        if isinstance(value, datetime.datetime):
+            out.append(b"\x0a" + value.isoformat().encode())
+        elif isinstance(value, datetime.timedelta):
+            out.append(b"\x0b" + struct.pack("<d", value.total_seconds()))
+        else:
+            out.append(b"\x0c" + repr(value).encode())
+
+
+def hash_values(*values: Any) -> int:
+    out: list = []
+    for v in values:
+        _serialize_for_hash(v, out)
+    return _hash_bytes(b"".join(out))
+
+
+def ref_scalar(*values: Any, optional: bool = False, instance: Any = None) -> Pointer:
+    """Build a Pointer from values (reference: Key::for_values). With
+    `instance`, the low shard bits are taken from the instance's key so rows
+    sharing an instance co-locate on a shard (Key::with_shard_of)."""
+    if optional and any(v is None for v in values):
+        return None  # type: ignore[return-value]
+    key = Pointer(hash_values(*values), origin=tuple(values))
+    if instance is not None:
+        key = key.with_shard_of(ref_scalar(instance))
+    return key
+
+
+_seq_counter = [0]
+
+
+def unsafe_make_pointer(value: int) -> Pointer:
+    return Pointer(value)
+
+
+def sequential_pointer() -> Pointer:
+    _seq_counter[0] += 1
+    return Pointer(hash_values("__auto__", _seq_counter[0]))
+
+
+class Json:
+    """Wrapper marking a value as a JSON document (reference:
+    internals/json.py:31, Value::Json). Provides typed accessors."""
+
+    __slots__ = ("value",)
+
+    NULL: "Json"
+
+    def __init__(self, value: Any = None):
+        if isinstance(value, Json):
+            value = value.value
+        self.value = value
+
+    def __eq__(self, other):
+        if isinstance(other, Json):
+            return self.value == other.value
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(_json.dumps(self.value, sort_keys=True, default=str))
+
+    def __repr__(self):
+        return _json.dumps(self.value, default=str)
+
+    def __str__(self):
+        return _json.dumps(self.value, default=str)
+
+    def __getitem__(self, item):
+        v = self.value[item]
+        return Json(v)
+
+    def __iter__(self):
+        if isinstance(self.value, dict):
+            return iter(self.value)
+        return (Json(v) for v in self.value)
+
+    def __len__(self):
+        return len(self.value)
+
+    def __contains__(self, item):
+        return item in self.value
+
+    def __bool__(self):
+        return bool(self.value)
+
+    def get(self, key, default=None):
+        if isinstance(self.value, dict):
+            v = self.value.get(key, _MISSING)
+            return Json(v) if v is not _MISSING else default
+        if isinstance(self.value, list) and isinstance(key, int):
+            if -len(self.value) <= key < len(self.value):
+                return Json(self.value[key])
+        return default
+
+    def as_int(self) -> int | None:
+        if isinstance(self.value, bool):
+            return None
+        return self.value if isinstance(self.value, int) else None
+
+    def as_float(self) -> float | None:
+        if isinstance(self.value, (int, float)) and not isinstance(self.value, bool):
+            return float(self.value)
+        return None
+
+    def as_str(self) -> str | None:
+        return self.value if isinstance(self.value, str) else None
+
+    def as_bool(self) -> bool | None:
+        return self.value if isinstance(self.value, bool) else None
+
+    def as_list(self) -> list | None:
+        return self.value if isinstance(self.value, list) else None
+
+    def as_dict(self) -> dict | None:
+        return self.value if isinstance(self.value, dict) else None
+
+    @staticmethod
+    def parse(s: str | bytes) -> "Json":
+        return Json(_json.loads(s))
+
+    @staticmethod
+    def dumps(obj: Any) -> str:
+        if isinstance(obj, Json):
+            obj = obj.value
+        return _json.dumps(obj, default=str)
+
+
+Json.NULL = Json(None)
+_MISSING = object()
+
+
+class PyObjectWrapper:
+    """Opaque python object carried through the dataflow
+    (reference: Value::PyObjectWrapper, engine/py_object_wrapper.rs)."""
+
+    __slots__ = ("value", "_serializer")
+
+    def __init__(self, value: Any, *, serializer: Any = None):
+        self.value = value
+        self._serializer = serializer
+
+    def __eq__(self, other):
+        return isinstance(other, PyObjectWrapper) and self.value == other.value
+
+    def __hash__(self):
+        try:
+            return hash(self.value)
+        except TypeError:
+            return hash(id(self.value))
+
+    def __repr__(self):
+        return f"PyObjectWrapper({self.value!r})"
+
+
+def wrap_py_object(value: Any, *, serializer: Any = None) -> PyObjectWrapper:
+    return PyObjectWrapper(value, serializer=serializer)
+
+
+def values_equal(a: Any, b: Any) -> bool:
+    """Deep equality that treats numpy arrays elementwise and NaN == NaN
+    (needed for retraction matching in stateful operators)."""
+    if a is b:
+        return True
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        try:
+            return bool(np.array_equal(a, b, equal_nan=True))
+        except TypeError:
+            return bool(np.array_equal(a, b))
+    if isinstance(a, float) and isinstance(b, float):
+        return a == b or (a != a and b != b)
+    return a == b

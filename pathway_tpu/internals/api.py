@@ -1,0 +1,124 @@
+"""Top-level expression/table functions: pw.apply, pw.cast, pw.if_else, ...
+
+TPU-native rebuild of the reference's top-level namespace (reference:
+python/pathway/__init__.py, internals/common.py).
+"""
+
+from __future__ import annotations
+
+import typing
+from typing import Any, Callable
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.expression import (
+    ApplyExpression,
+    CastExpression,
+    CoalesceExpression,
+    ColumnExpression,
+    DeclareTypeExpression,
+    FillErrorExpression,
+    FullyAsyncApplyExpression,
+    IfElseExpression,
+    MakeTupleExpression,
+    RequireExpression,
+    UnwrapExpression,
+    smart_wrap,
+)
+
+
+def _infer_return_type(fun: Callable) -> Any:
+    hints = typing.get_type_hints(fun) if callable(fun) else {}
+    return hints.get("return", Any)
+
+
+def apply(fun: Callable, *args, **kwargs) -> ColumnExpression:
+    """Apply a python function rowwise (reference: pw.apply)."""
+    return ApplyExpression(fun, _infer_return_type(fun), *args, **kwargs)
+
+
+def apply_with_type(fun: Callable, ret_type, *args, **kwargs) -> ColumnExpression:
+    return ApplyExpression(fun, ret_type, *args, **kwargs)
+
+
+def apply_async(fun: Callable, *args, **kwargs) -> ColumnExpression:
+    return ApplyExpression(
+        fun, _infer_return_type(fun), *args, is_async=True, **kwargs
+    )
+
+
+def apply_fully_async(fun: Callable, *args, **kwargs) -> ColumnExpression:
+    return FullyAsyncApplyExpression(
+        fun, _infer_return_type(fun), *args, is_async=True, **kwargs
+    )
+
+
+def cast(target_type, col) -> ColumnExpression:
+    return CastExpression(dt.wrap(target_type), col)
+
+
+def declare_type(target_type, col) -> ColumnExpression:
+    return DeclareTypeExpression(dt.wrap(target_type), col)
+
+
+def if_else(if_clause, then_clause, else_clause) -> ColumnExpression:
+    return IfElseExpression(if_clause, then_clause, else_clause)
+
+
+def coalesce(*args) -> ColumnExpression:
+    return CoalesceExpression(*args)
+
+
+def require(val, *deps) -> ColumnExpression:
+    return RequireExpression(val, *deps)
+
+
+def unwrap(col) -> ColumnExpression:
+    return UnwrapExpression(col)
+
+
+def fill_error(col, replacement) -> ColumnExpression:
+    return FillErrorExpression(col, replacement)
+
+
+def make_tuple(*args) -> ColumnExpression:
+    return MakeTupleExpression(*args)
+
+
+def assert_table_has_schema(
+    table,
+    schema,
+    *,
+    allow_superset: bool = True,
+    ignore_primary_keys: bool = True,
+) -> None:
+    schema.assert_matches_schema(
+        table.schema,
+        allow_superset=allow_superset,
+        ignore_primary_keys=ignore_primary_keys,
+    )
+
+
+def table_transformer(func=None, **kwargs):
+    """Decorator marking a Table -> Table transformer (reference:
+    pw.table_transformer); checks are advisory here."""
+
+    def wrap_fn(f):
+        return f
+
+    if func is None:
+        return wrap_fn
+    return wrap_fn(func)
+
+
+def iterate(func, iteration_limit: int | None = None, **kwargs):
+    """Fixed-point iteration (reference: pw.iterate, internals
+    complex_columns.rs / Graph::iterate:895).
+
+    Runs `func` on snapshot tables repeatedly until outputs stop changing
+    (or `iteration_limit`), per engine time. The body is re-executed as a
+    nested batch dataflow on each iteration — idiomatic for a
+    recompute-based engine; XLA-compiled bodies amortize via jit caching.
+    """
+    from pathway_tpu.internals.iterate import iterate_impl
+
+    return iterate_impl(func, iteration_limit=iteration_limit, **kwargs)

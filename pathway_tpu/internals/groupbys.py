@@ -1,0 +1,226 @@
+"""groupby().reduce() desugaring (reference:
+python/pathway/internals/groupbys.py)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import thisclass
+from pathway_tpu.internals.desugaring import desugar
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    IdReference,
+    ReducerExpression,
+    smart_wrap,
+)
+from pathway_tpu.internals.schema import ColumnSchema, schema_from_columns
+from pathway_tpu.internals.type_interpreter import infer_dtype
+from pathway_tpu.internals.universe import Universe
+
+
+class GroupedTable:
+    """Intermediate of t.groupby(...) (reference: groupbys.py GroupedTable)."""
+
+    def __init__(
+        self,
+        table,
+        grouping: List[ColumnExpression],
+        *,
+        instance: ColumnExpression | None = None,
+        id_expr: ColumnExpression | None = None,
+        sort_by: ColumnExpression | None = None,
+    ):
+        self._table = table
+        self._grouping = grouping
+        self._instance = instance
+        self._id_expr = id_expr
+        self._sort_by = sort_by
+
+    def reduce(self, *args, **kwargs):
+        from pathway_tpu.internals.table import Table, _compile_on
+
+        source = self._table
+        mapping = {thisclass.this: source}
+        cols: Dict[str, ColumnExpression] = {}
+        for arg in args:
+            resolved = desugar(arg, mapping)
+            if not isinstance(resolved, ColumnReference):
+                raise TypeError(
+                    "positional reduce arguments must be column references"
+                )
+            cols[resolved.name] = resolved
+        for name, e in kwargs.items():
+            cols[name] = desugar(e, mapping)
+
+        # harvest reducers from the output expressions
+        reducers: List[ReducerExpression] = []
+
+        def harvest(expr: ColumnExpression) -> ColumnExpression:
+            if isinstance(expr, ReducerExpression):
+                reducers.append(expr)
+                return _ReducerSlot(len(reducers) - 1, expr)
+            out = copy.copy(expr)
+            changed = False
+            for attr, value in list(vars(expr).items()):
+                if isinstance(value, ColumnExpression):
+                    setattr(out, attr, harvest(value))
+                    changed = True
+                elif isinstance(value, tuple) and any(
+                    isinstance(v, ColumnExpression) for v in value
+                ):
+                    setattr(
+                        out,
+                        attr,
+                        tuple(
+                            harvest(v) if isinstance(v, ColumnExpression) else v
+                            for v in value
+                        ),
+                    )
+                    changed = True
+            return out if changed else expr
+
+        cols = {name: harvest(e) for name, e in cols.items()}
+
+        grouping = self._grouping
+        instance = self._instance
+        id_expr = self._id_expr
+        sort_by = self._sort_by
+        n_group = len(grouping)
+
+        def build(ctx):
+            from pathway_tpu.engine.operators import ReduceNode
+            from pathway_tpu.engine.value import ERROR, Pointer, ref_scalar
+
+            node = ctx.node(source)
+            group_progs = [_compile_on(ctx, [source], g) for g in grouping]
+            instance_prog = (
+                _compile_on(ctx, [source], instance) if instance is not None else None
+            )
+            id_prog = (
+                _compile_on(ctx, [source], id_expr) if id_expr is not None else None
+            )
+            sort_prog = (
+                _compile_on(ctx, [source], sort_by) if sort_by is not None else None
+            )
+
+            def group_fn(keys, rows):
+                gcols = [p(keys, rows) for p in group_progs]
+                instances = (
+                    instance_prog(keys, rows) if instance_prog is not None else None
+                )
+                ids = id_prog(keys, rows) if id_prog is not None else None
+                out = []
+                for i in range(len(keys)):
+                    gvals = tuple(c[i] for c in gcols)
+                    if ids is not None:
+                        gkey = ids[i]
+                    else:
+                        inst = instances[i] if instances is not None else None
+                        gkey = ref_scalar(*gvals, instance=inst)
+                    out.append((gkey, gvals))
+                return out
+
+            args_fns = []
+            for red in reducers:
+                arg_progs = [_compile_on(ctx, [source], a) for a in red._args]
+
+                def make_fn(arg_progs=arg_progs):
+                    def fn(keys, rows):
+                        if not arg_progs:
+                            return [() for _ in keys]
+                        acols = [p(keys, rows) for p in arg_progs]
+                        return [tuple(c[i] for c in acols) for i in range(len(keys))]
+
+                    return fn
+
+                args_fns.append(make_fn())
+
+            return ReduceNode(
+                ctx.engine,
+                node,
+                group_fn,
+                [r._reducer for r in reducers],
+                args_fns,
+                gval_width=n_group,
+                sort_fn=sort_prog,
+            )
+
+        # the raw reduce output: grouping values then reducer results
+        raw_cols: Dict[str, ColumnSchema] = {}
+        for i, g in enumerate(grouping):
+            raw_cols[f"_g{i}"] = ColumnSchema(
+                name=f"_g{i}", dtype=self._infer_on_source(g)
+            )
+        for j, red in enumerate(reducers):
+            raw_cols[f"_r{j}"] = ColumnSchema(
+                name=f"_r{j}", dtype=self._infer_on_source(red)
+            )
+        raw = Table(
+            schema=schema_from_columns(raw_cols),
+            universe=Universe(),
+            build=build,
+        )
+
+        # rewrite output expressions against the raw table
+        group_index: Dict[tuple, int] = {}
+        for i, g in enumerate(grouping):
+            if isinstance(g, ColumnReference) and not isinstance(g, IdReference):
+                group_index[(id(g._table), g.name)] = i
+            elif isinstance(g, IdReference):
+                group_index[(id(g._table), "id")] = i
+
+        def rewrite(expr: ColumnExpression) -> ColumnExpression:
+            if isinstance(expr, _ReducerSlot):
+                return raw[f"_r{expr.index}"]
+            if isinstance(expr, IdReference):
+                loc = group_index.get((id(expr._table), "id"))
+                if loc is not None:
+                    return raw[f"_g{loc}"]
+                return IdReference(raw)
+            if isinstance(expr, ColumnReference):
+                loc = group_index.get((id(expr._table), expr.name))
+                if loc is None:
+                    raise ValueError(
+                        f"column {expr.name!r} used in reduce() is neither a "
+                        "grouping column nor inside a reducer"
+                    )
+                return raw[f"_g{loc}"]
+            out = copy.copy(expr)
+            for attr, value in list(vars(expr).items()):
+                if isinstance(value, ColumnExpression):
+                    setattr(out, attr, rewrite(value))
+                elif isinstance(value, tuple) and any(
+                    isinstance(v, ColumnExpression) for v in value
+                ):
+                    setattr(
+                        out,
+                        attr,
+                        tuple(
+                            rewrite(v) if isinstance(v, ColumnExpression) else v
+                            for v in value
+                        ),
+                    )
+            return out
+
+        final_cols = {name: rewrite(e) for name, e in cols.items()}
+        return raw._select_impl(final_cols)
+
+    def _infer_on_source(self, expr: ColumnExpression) -> dt.DType:
+        def resolve(ref: ColumnReference) -> dt.DType:
+            if isinstance(ref, IdReference):
+                return dt.POINTER
+            return ref._table._schema[ref.name].dtype
+
+        return infer_dtype(expr, resolve)
+
+
+class _ReducerSlot(ColumnExpression):
+    def __init__(self, index: int, original: ReducerExpression):
+        self.index = index
+        self.original = original
+
+    def _deps(self):
+        return ()

@@ -1,0 +1,381 @@
+"""Join desugaring (reference: python/pathway/internals/joins.py,
+src/engine/dataflow.rs join_tables:2691)."""
+
+from __future__ import annotations
+
+import copy
+import enum
+from typing import Any, Dict, List, Optional, Tuple
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import thisclass
+from pathway_tpu.internals.desugaring import desugar, expand_select_args
+from pathway_tpu.internals.expression import (
+    BinaryOpExpression,
+    ColumnExpression,
+    ColumnReference,
+    IdReference,
+    collect_tables,
+    smart_wrap,
+)
+from pathway_tpu.internals.schema import ColumnSchema, schema_from_columns
+from pathway_tpu.internals.universe import Universe
+
+
+class JoinMode(enum.Enum):
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    OUTER = "outer"
+
+
+class JoinResult:
+    """Intermediate of t.join(other, ...) supporting select/filter/reduce
+    (reference: joins.py JoinResult)."""
+
+    def __init__(
+        self,
+        left,
+        right,
+        on: tuple,
+        *,
+        id_expr=None,
+        mode: JoinMode = JoinMode.INNER,
+    ):
+        self._left = left
+        self._right = right
+        self._mode = mode
+        self._filters: List[ColumnExpression] = []
+        mapping = {
+            thisclass.left: left,
+            thisclass.right: right,
+            thisclass.this: left,
+        }
+        self._on_left: List[ColumnExpression] = []
+        self._on_right: List[ColumnExpression] = []
+        for cond in on:
+            cond = desugar(cond, mapping)
+            if not (
+                isinstance(cond, BinaryOpExpression) and cond._op == "=="
+            ):
+                raise TypeError(
+                    "join conditions must be equalities like "
+                    "t1.a == t2.b"
+                )
+            a, b = cond._left, cond._right
+            a_tables = collect_tables(a, set())
+            b_tables = collect_tables(b, set())
+            if a_tables <= {left} and b_tables <= {right}:
+                self._on_left.append(a)
+                self._on_right.append(b)
+            elif a_tables <= {right} and b_tables <= {left}:
+                self._on_left.append(b)
+                self._on_right.append(a)
+            else:
+                raise ValueError(
+                    "each join condition side must reference only one table"
+                )
+        # id= parameter: result rows keyed by one side's id
+        self._id_mode = "both"
+        if id_expr is not None:
+            id_expr = desugar(id_expr, mapping)
+            if isinstance(id_expr, IdReference):
+                if id_expr._table is left:
+                    self._id_mode = "left"
+                elif id_expr._table is right:
+                    self._id_mode = "right"
+                else:
+                    raise ValueError("join id= must be pw.left.id or pw.right.id")
+            else:
+                raise ValueError("join id= must be pw.left.id or pw.right.id")
+
+    # -- combined-storage helpers ----------------------------------------
+    def _resolve_this(self, name: str) -> ColumnReference:
+        if name in self._left.column_names():
+            if name in self._right.column_names():
+                raise ValueError(
+                    f"column {name!r} exists on both join sides; "
+                    "use pw.left/pw.right"
+                )
+            return self._left[name]
+        if name in self._right.column_names():
+            return self._right[name]
+        raise KeyError(f"no column {name!r} on either join side")
+
+    def _mapping(self) -> dict:
+        return {
+            thisclass.left: self._left,
+            thisclass.right: self._right,
+            thisclass.this: _JoinThisProxy(self),
+        }
+
+    def _join_node(self, ctx):
+        """Build (or reuse) the engine JoinNode for this join."""
+        from pathway_tpu.engine.operators import JoinNode
+        from pathway_tpu.internals.table import _compile_on
+
+        cached = ctx.join_nodes.get(id(self))
+        if cached is not None:
+            return cached
+        from pathway_tpu.internals.expression import MakeTupleExpression
+
+        left_node = ctx.node(self._left)
+        right_node = ctx.node(self._right)
+        left_prog = _compile_on(
+            ctx, [self._left], MakeTupleExpression(*self._on_left)
+        )
+        right_prog = _compile_on(
+            ctx, [self._right], MakeTupleExpression(*self._on_right)
+        )
+        node = JoinNode(
+            ctx.engine,
+            left_node,
+            right_node,
+            left_prog,
+            right_prog,
+            left_width=len(self._left.column_names()),
+            right_width=len(self._right.column_names()),
+            left_outer=self._mode in (JoinMode.LEFT, JoinMode.OUTER),
+            right_outer=self._mode in (JoinMode.RIGHT, JoinMode.OUTER),
+            id_mode=self._id_mode,
+        )
+        ctx.join_nodes[id(self)] = node
+        return node
+
+    def _combined_resolver(self):
+        left, right = self._left, self._right
+        nl = len(left.column_names())
+        left_idx = {n: i for i, n in enumerate(left.column_names())}
+        right_idx = {n: i for i, n in enumerate(right.column_names())}
+
+        def resolve(ref: ColumnReference):
+            if isinstance(ref, IdReference):
+                if ref._table is left:
+                    return (0, 0)
+                if ref._table is right:
+                    return (0, 1)
+                return ("id",)
+            if ref._table is left:
+                return (0, 2 + left_idx[ref.name])
+            if ref._table is right:
+                return (0, 2 + nl + right_idx[ref.name])
+            return None
+
+        return resolve
+
+    def _compile_combined(self, ctx, expr: ColumnExpression):
+        from pathway_tpu.engine.expression_eval import EvalContext, compile_batch
+
+        ectx = EvalContext(self._combined_resolver())
+        ectx.error_logger = ctx.engine.log_error
+        return compile_batch(expr, ectx)
+
+    def _expand_args(self, args) -> Dict[str, ColumnExpression]:
+        out: Dict[str, ColumnExpression] = {}
+        mapping = self._mapping()
+        for arg in args:
+            if arg is thisclass.left:
+                for n in self._left.column_names():
+                    out[n] = self._left[n]
+            elif arg is thisclass.right:
+                for n in self._right.column_names():
+                    out[n] = self._right[n]
+            elif arg is thisclass.this:
+                for n in self._left.column_names():
+                    out[n] = self._left[n]
+                for n in self._right.column_names():
+                    if n not in out:
+                        out[n] = self._right[n]
+            else:
+                sub = expand_select_args([arg], self._left, mapping)
+                out.update(sub)
+        return out
+
+    def filter(self, expression) -> "JoinResult":
+        out = copy.copy(self)
+        out._filters = self._filters + [desugar(expression, self._mapping())]
+        return out
+
+    def select(self, *args, **kwargs):
+        from pathway_tpu.internals.table import Table
+
+        cols = self._expand_args(args)
+        mapping = self._mapping()
+        for name, e in kwargs.items():
+            cols[name] = desugar(e, mapping)
+        jr = self
+
+        def build(ctx):
+            from pathway_tpu.engine.engine import FilterNode, RowwiseNode
+
+            node = jr._join_node(ctx)
+            for f in jr._filters:
+                node = FilterNode(ctx.engine, node, jr._compile_combined(ctx, f))
+            progs = [jr._compile_combined(ctx, e) for e in cols.values()]
+
+            def batch_fn(keys, rows):
+                if not progs:
+                    return [() for _ in keys]
+                columns = [p(keys, rows) for p in progs]
+                return list(zip(*columns))
+
+            return RowwiseNode(ctx.engine, [node], batch_fn)
+
+        schema_cols = {}
+        for name, e in cols.items():
+            schema_cols[name] = ColumnSchema(
+                name=name, dtype=self._infer_joined(e)
+            )
+        return Table(
+            schema=schema_from_columns(schema_cols),
+            universe=Universe(),
+            build=build,
+        )
+
+    def _infer_joined(self, expr: ColumnExpression) -> dt.DType:
+        from pathway_tpu.internals.type_interpreter import infer_dtype
+
+        left, right = self._left, self._right
+        optional_left = self._mode in (JoinMode.RIGHT, JoinMode.OUTER)
+        optional_right = self._mode in (JoinMode.LEFT, JoinMode.OUTER)
+
+        def resolve(ref: ColumnReference) -> dt.DType:
+            if isinstance(ref, IdReference):
+                return dt.POINTER
+            base = ref._table._schema[ref.name].dtype
+            if ref._table is left and optional_left:
+                return dt.Optionalize(base)
+            if ref._table is right and optional_right:
+                return dt.Optionalize(base)
+            return base
+
+        return infer_dtype(expr, resolve)
+
+    def reduce(self, *args, **kwargs):
+        return self._grouped([]).reduce(*args, **kwargs)
+
+    def groupby(self, *args, id=None, instance=None):
+        mapping = self._mapping()
+        grouping = [desugar(a, mapping) for a in args]
+        return self._grouped(
+            grouping,
+            id_expr=desugar(id, mapping) if id is not None else None,
+            instance=desugar(instance, mapping) if instance is not None else None,
+        )
+
+    def _grouped(self, grouping, id_expr=None, instance=None):
+        """Materialize the combined row as a table, then group it."""
+        cols: Dict[str, ColumnExpression] = {}
+        for n in self._left.column_names():
+            cols[f"_l_{n}"] = self._left[n]
+        for n in self._right.column_names():
+            cols[f"_r_{n}"] = self._right[n]
+        cols["_pw_left_id"] = self._left.id
+        cols["_pw_right_id"] = self._right.id
+        combined = self.select(**cols)
+        return _RemappedGroupBy(
+            combined,
+            self._left,
+            self._right,
+            grouping,
+            id_expr=id_expr,
+            instance=instance,
+        )
+
+
+class _RemappedGroupBy:
+    """groupby over a join: grouping/reducer expressions referencing the
+    original sides are rewritten onto the combined table."""
+
+    def __init__(self, combined, left, right, grouping, id_expr=None, instance=None):
+        self._combined = combined
+        self._left = left
+        self._right = right
+        self._grouping = [self._remap(g) for g in grouping]
+        self._id_expr = self._remap(id_expr) if id_expr is not None else None
+        self._instance = self._remap(instance) if instance is not None else None
+
+    def _remap(self, expr: ColumnExpression) -> ColumnExpression:
+        left, right, combined = self._left, self._right, self._combined
+
+        def rec(e: ColumnExpression) -> ColumnExpression:
+            if isinstance(e, IdReference):
+                if e._table is left:
+                    return combined["_pw_left_id"]
+                if e._table is right:
+                    return combined["_pw_right_id"]
+                return IdReference(combined)
+            if isinstance(e, ColumnReference):
+                if e._table is left:
+                    return combined[f"_l_{e.name}"]
+                if e._table is right:
+                    return combined[f"_r_{e.name}"]
+                return e
+            out = copy.copy(e)
+            for attr, value in list(vars(e).items()):
+                if isinstance(value, ColumnExpression):
+                    setattr(out, attr, rec(value))
+                elif isinstance(value, tuple) and any(
+                    isinstance(v, ColumnExpression) for v in value
+                ):
+                    setattr(
+                        out,
+                        attr,
+                        tuple(
+                            rec(v) if isinstance(v, ColumnExpression) else v
+                            for v in value
+                        ),
+                    )
+            return out
+
+        return rec(expr)
+
+    def reduce(self, *args, **kwargs):
+        from pathway_tpu.internals.groupbys import GroupedTable
+
+        args = [self._remap(desugar(a, self._join_mapping())) for a in args]
+        kwargs = {
+            k: self._remap(desugar(v, self._join_mapping()))
+            for k, v in kwargs.items()
+        }
+        gt = GroupedTable(
+            self._combined,
+            self._grouping,
+            id_expr=self._id_expr,
+            instance=self._instance,
+        )
+        result = gt.reduce(
+            **{self._strip(a): a for a in args},
+            **kwargs,
+        )
+        return result
+
+    def _strip(self, ref) -> str:
+        name = ref.name
+        if name.startswith("_l_") or name.startswith("_r_"):
+            return name[3:]
+        return name
+
+    def _join_mapping(self):
+        return {
+            thisclass.left: self._left,
+            thisclass.right: self._right,
+            thisclass.this: self._combined,
+        }
+
+
+class _JoinThisProxy:
+    """Resolution target for pw.this inside join select: picks the side
+    that has the column."""
+
+    def __init__(self, jr: JoinResult):
+        self._jr = jr
+
+    def __getitem__(self, name: str):
+        return self._jr._resolve_this(name)
+
+    def column_names(self):
+        seen = dict.fromkeys(
+            self._jr._left.column_names() + self._jr._right.column_names()
+        )
+        return list(seen)

@@ -1,0 +1,42 @@
+"""Global lazy parse graph (reference:
+python/pathway/internals/parse_graph.py — `G = ParseGraph()`).
+
+Tables are lazy: each holds a build closure over its dependency tables.
+The graph object registers *sinks* (output connectors, subscribes) and
+iteration contexts so `pw.run()` knows what to execute, and gives tests a
+`clear()` to reset state between cases.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, List
+
+
+class SinkSpec:
+    """A registered output: tables to build + a hook attaching the engine
+    sink node (subscribe callback, writer, ...)."""
+
+    def __init__(self, tables: list, attach: Callable):
+        self.tables = tables
+        self.attach = attach
+
+
+class ParseGraph:
+    def __init__(self):
+        self.sinks: List[SinkSpec] = []
+        self.sources: List[Any] = []  # streaming connector descriptors
+        self.node_counter = itertools.count()
+        self.cache: dict = {}  # misc per-graph caches (udf caches etc.)
+
+    def add_sink(self, tables: list, attach: Callable) -> None:
+        self.sinks.append(SinkSpec(tables, attach))
+
+    def add_source(self, source: Any) -> None:
+        self.sources.append(source)
+
+    def clear(self) -> None:
+        self.__init__()
+
+
+G = ParseGraph()

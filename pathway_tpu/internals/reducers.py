@@ -1,0 +1,378 @@
+"""Reducers: pw.reducers.* API + engine aggregation logic.
+
+TPU-native rebuild of the reference reducer set (reference:
+src/engine/reduce.rs:27-45, python/pathway/internals/reducers.py,
+custom_reducers.py). The engine recomputes a group's aggregate from its keyed
+row set on every change (correct for all reducers, including non-invertible
+min/max/tuple); numeric-column groups are batched into numpy segment
+reductions by the engine where possible.
+
+Each engine entry is `(row_key, args_tuple, time, seq)`; `time/seq` give the
+deterministic arrival order that earliest/latest/tuple rely on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Tuple
+
+import numpy as np
+
+from pathway_tpu.engine.value import ERROR, Error
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ReducerExpression,
+    smart_wrap,
+)
+
+Entry = Tuple[Any, tuple, int, int]  # (row_key, args, time, seq)
+
+
+class Reducer:
+    """A reducer spec: name + engine compute function + dtype rule."""
+
+    def __init__(
+        self,
+        name: str,
+        compute: Callable[[List[Entry]], Any],
+        dtype_fn: Callable[[list], dt.DType] | None = None,
+        skip_errors: bool = False,
+    ):
+        self.name = name
+        self.compute = compute
+        self.dtype_fn = dtype_fn or (lambda arg_dtypes: dt.ANY)
+        self.skip_errors = skip_errors
+
+    def __call__(self, *args, **kwargs) -> ReducerExpression:
+        return ReducerExpression(self, *args, **kwargs)
+
+    def __repr__(self):
+        return f"<reducer {self.name}>"
+
+
+def _arg0(entries: List[Entry]) -> List[Any]:
+    return [e[1][0] for e in entries]
+
+
+def _clean(values: List[Any], skip_nones: bool = False) -> List[Any] | Error:
+    if any(isinstance(v, Error) for v in values):
+        return ERROR
+    if skip_nones:
+        return [v for v in values if v is not None]
+    return values
+
+
+def _compute_count(entries):
+    return len(entries)
+
+
+def _compute_sum(entries):
+    vals = _clean(_arg0(entries))
+    if isinstance(vals, Error):
+        return ERROR
+    if not vals:
+        return 0
+    if isinstance(vals[0], np.ndarray):
+        out = vals[0].copy()
+        for v in vals[1:]:
+            out = out + v
+        return out
+    return sum(vals)
+
+
+def _compute_min(entries):
+    vals = _clean(_arg0(entries))
+    if isinstance(vals, Error):
+        return ERROR
+    return min(vals) if vals else None
+
+
+def _compute_max(entries):
+    vals = _clean(_arg0(entries))
+    if isinstance(vals, Error):
+        return ERROR
+    return max(vals) if vals else None
+
+
+def _compute_argmin(entries):
+    best = None
+    for row_key, args, _t, _s in entries:
+        v = args[0]
+        if isinstance(v, Error):
+            return ERROR
+        if best is None or (v, row_key) < best[0]:
+            best = ((v, row_key), row_key)
+    return best[1] if best else None
+
+
+def _compute_argmax(entries):
+    best = None
+    for row_key, args, _t, _s in entries:
+        v = args[0]
+        if isinstance(v, Error):
+            return ERROR
+        if best is None or (v, _neg_key(row_key)) > best[0]:
+            best = ((v, _neg_key(row_key)), row_key)
+    return best[1] if best else None
+
+
+def _neg_key(k):
+    # tie-break argmax toward the smallest key, mirroring argmin
+    class _Neg:
+        __slots__ = ("k",)
+
+        def __init__(self, k):
+            self.k = k
+
+        def __lt__(self, other):
+            return other.k < self.k
+
+        def __gt__(self, other):
+            return other.k > self.k
+
+        def __eq__(self, other):
+            return other.k == self.k
+
+    return _Neg(k)
+
+
+def _compute_avg(entries):
+    vals = _clean(_arg0(entries))
+    if isinstance(vals, Error):
+        return ERROR
+    if not vals:
+        return None
+    return sum(vals) / len(vals)
+
+
+def _compute_unique(entries):
+    vals = _arg0(entries)
+    first = vals[0] if vals else None
+    for v in vals[1:]:
+        if not _eq(v, first):
+            return ERROR
+    return first
+
+
+def _eq(a, b):
+    from pathway_tpu.engine.value import values_equal
+
+    return values_equal(a, b)
+
+
+def _compute_any(entries):
+    if not entries:
+        return None
+    return min(entries, key=lambda e: (e[2], e[3]))[1][0]
+
+
+def _make_tuple_reducer(sort_by_value: bool):
+    def compute(entries, skip_nones: bool = False):
+        ordered = sorted(entries, key=lambda e: (e[2], e[3]))
+        vals = [e[1][0] for e in ordered]
+        if skip_nones:
+            vals = [v for v in vals if v is not None]
+        if any(isinstance(v, Error) for v in vals):
+            return ERROR
+        if sort_by_value:
+            vals = sorted(vals)
+        return tuple(vals)
+
+    return compute
+
+
+def _compute_ndarray(entries, skip_nones: bool = False):
+    ordered = sorted(entries, key=lambda e: (e[2], e[3]))
+    vals = [e[1][0] for e in ordered]
+    if skip_nones:
+        vals = [v for v in vals if v is not None]
+    if any(isinstance(v, Error) for v in vals):
+        return ERROR
+    return np.array(vals)
+
+
+def _compute_earliest(entries):
+    if not entries:
+        return None
+    return min(entries, key=lambda e: (e[2], e[3]))[1][0]
+
+
+def _compute_latest(entries):
+    if not entries:
+        return None
+    return max(entries, key=lambda e: (e[2], e[3]))[1][0]
+
+
+def _compute_count_distinct(entries):
+    from pathway_tpu.engine.stream import _hashable_one
+
+    vals = _arg0(entries)
+    if any(isinstance(v, Error) for v in vals):
+        return ERROR
+    return len({_hashable_one(v) for v in vals})
+
+
+def _numeric_dtype(arg_dtypes: list) -> dt.DType:
+    if arg_dtypes and dt.unoptionalize(arg_dtypes[0]) in (dt.INT, dt.FLOAT):
+        return dt.unoptionalize(arg_dtypes[0])
+    return dt.ANY
+
+
+count = Reducer("count", _compute_count, lambda a: dt.INT)
+sum_ = Reducer("sum", _compute_sum, _numeric_dtype)
+min_ = Reducer("min", _compute_min, lambda a: dt.unoptionalize(a[0]) if a else dt.ANY)
+max_ = Reducer("max", _compute_max, lambda a: dt.unoptionalize(a[0]) if a else dt.ANY)
+argmin = Reducer("argmin", _compute_argmin, lambda a: dt.POINTER)
+argmax = Reducer("argmax", _compute_argmax, lambda a: dt.POINTER)
+avg = Reducer("avg", _compute_avg, lambda a: dt.FLOAT)
+unique = Reducer(
+    "unique", _compute_unique, lambda a: dt.unoptionalize(a[0]) if a else dt.ANY
+)
+any_ = Reducer(
+    "any", _compute_any, lambda a: dt.unoptionalize(a[0]) if a else dt.ANY
+)
+tuple_ = Reducer(
+    "tuple",
+    _make_tuple_reducer(sort_by_value=False),
+    lambda a: dt.ListDType(a[0]) if a else dt.ANY_TUPLE,
+)
+sorted_tuple = Reducer(
+    "sorted_tuple",
+    _make_tuple_reducer(sort_by_value=True),
+    lambda a: dt.ListDType(a[0]) if a else dt.ANY_TUPLE,
+)
+ndarray = Reducer("ndarray", _compute_ndarray, lambda a: dt.ANY_ARRAY)
+earliest = Reducer(
+    "earliest", _compute_earliest, lambda a: dt.unoptionalize(a[0]) if a else dt.ANY
+)
+latest = Reducer(
+    "latest", _compute_latest, lambda a: dt.unoptionalize(a[0]) if a else dt.ANY
+)
+count_distinct = Reducer("count_distinct", _compute_count_distinct, lambda a: dt.INT)
+count_distinct_approximate = Reducer(
+    "count_distinct_approximate", _compute_count_distinct, lambda a: dt.INT
+)
+
+
+def infer_reducer_dtype(expr: ReducerExpression, rec) -> dt.DType:
+    reducer: Reducer = expr._reducer
+    arg_dtypes = [rec(a) for a in expr._args]
+    return reducer.dtype_fn(arg_dtypes)
+
+
+# ---------------------------------------------------------------------------
+# Custom (stateful) reducers — reference: internals/custom_reducers.py
+# ---------------------------------------------------------------------------
+
+
+class BaseCustomAccumulator:
+    """User-defined accumulator (reference: custom_reducers.py
+    BaseCustomAccumulator:177). Subclass and define from_row / update /
+    compute_result (and optionally retract / neutral)."""
+
+    @classmethod
+    def from_row(cls, row):
+        raise NotImplementedError
+
+    def update(self, other) -> None:
+        raise NotImplementedError
+
+    def compute_result(self) -> Any:
+        raise NotImplementedError
+
+
+def udf_reducer(accumulator: type[BaseCustomAccumulator]):
+    """Build a reducer from a BaseCustomAccumulator subclass."""
+
+    def compute(entries: List[Entry]) -> Any:
+        ordered = sorted(entries, key=lambda e: (e[2], e[3]))
+        acc = None
+        for _k, args, _t, _s in ordered:
+            nxt = accumulator.from_row(list(args))
+            if acc is None:
+                acc = nxt
+            else:
+                acc.update(nxt)
+        if acc is None:
+            return None
+        return acc.compute_result()
+
+    return Reducer(f"udf_{accumulator.__name__}", compute)
+
+
+def stateful_many(combine_many: Callable):
+    """Reducer from a fold over batches of rows (reference:
+    custom_reducers.py stateful_many:36). combine_many(state, rows) where
+    rows = [(args_tuple, diff)]."""
+
+    def compute(entries: List[Entry]) -> Any:
+        ordered = sorted(entries, key=lambda e: (e[2], e[3]))
+        state = None
+        rows = [(e[1], 1) for e in ordered]
+        state = combine_many(state, rows)
+        return state
+
+    return Reducer(f"stateful_{getattr(combine_many, '__name__', 'many')}", compute)
+
+
+def stateful_single(combine_single: Callable):
+    def combine_many(state, rows):
+        for args, diff in rows:
+            for _ in range(diff):
+                state = combine_single(state, *args)
+        return state
+
+    return stateful_many(combine_many)
+
+
+class _ReducersNamespace:
+    """pw.reducers.*"""
+
+    count = staticmethod(count)
+    sum = staticmethod(sum_)
+    min = staticmethod(min_)
+    max = staticmethod(max_)
+    argmin = staticmethod(argmin)
+    argmax = staticmethod(argmax)
+    avg = staticmethod(avg)
+    unique = staticmethod(unique)
+    any = staticmethod(any_)
+    earliest = staticmethod(earliest)
+    latest = staticmethod(latest)
+    count_distinct = staticmethod(count_distinct)
+    count_distinct_approximate = staticmethod(count_distinct_approximate)
+    udf_reducer = staticmethod(udf_reducer)
+    stateful_many = staticmethod(stateful_many)
+    stateful_single = staticmethod(stateful_single)
+
+    @staticmethod
+    def tuple(arg, *, skip_nones: bool = False):
+        base = _make_tuple_reducer(sort_by_value=False)
+        red = Reducer(
+            "tuple",
+            lambda entries: base(entries, skip_nones=skip_nones),
+            lambda a: dt.ListDType(a[0]) if a else dt.ANY_TUPLE,
+        )
+        return red(arg)
+
+    @staticmethod
+    def sorted_tuple(arg, *, skip_nones: bool = False):
+        base = _make_tuple_reducer(sort_by_value=True)
+        red = Reducer(
+            "sorted_tuple",
+            lambda entries: base(entries, skip_nones=skip_nones),
+            lambda a: dt.ListDType(a[0]) if a else dt.ANY_TUPLE,
+        )
+        return red(arg)
+
+    @staticmethod
+    def ndarray(arg, *, skip_nones: bool = False):
+        red = Reducer(
+            "ndarray",
+            lambda entries: _compute_ndarray(entries, skip_nones=skip_nones),
+            lambda a: dt.ANY_ARRAY,
+        )
+        return red(arg)
+
+
+reducers = _ReducersNamespace()

@@ -1,0 +1,95 @@
+"""Graph runner: builds engine nodes from lazy tables and drives the engine.
+
+TPU-native rebuild of the reference graph runner (reference:
+python/pathway/internals/graph_runner/__init__.py:38 GraphRunner,
+api.run_with_new_graph). Tree-shaking is implicit: only tables reachable from
+the requested outputs/sinks are built.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from pathway_tpu.engine.engine import CaptureNode, Engine
+from pathway_tpu.internals.parse_graph import G
+
+
+class RunContext:
+    """Memoized table -> engine-node builder."""
+
+    def __init__(self, engine: Engine):
+        self.engine = engine
+        self._nodes: Dict[int, Any] = {}
+        self._keepalive: List[Any] = []  # tables must outlive id() keys
+        self.join_nodes: Dict[int, Any] = {}
+
+    def node(self, table):
+        n = self._nodes.get(id(table))
+        if n is None:
+            n = table._build(self)
+            self._nodes[id(table)] = n
+            self._keepalive.append(table)
+        return n
+
+
+def run_tables(
+    *tables,
+    record_stream: bool = False,
+    engine: Engine | None = None,
+) -> List[CaptureNode]:
+    """Build and run the graph needed for `tables`; return their captures."""
+    engine = engine or Engine()
+    ctx = RunContext(engine)
+    captures = []
+    for t in tables:
+        node = ctx.node(t)
+        captures.append(CaptureNode(engine, node, record_stream=record_stream))
+    _attach_monitoring(engine)
+    engine.run_static()
+    return captures
+
+
+def run(
+    *,
+    debug: bool = False,
+    monitoring_level=None,
+    with_http_server: bool = False,
+    **kwargs,
+) -> None:
+    """pw.run — execute every registered sink (reference:
+    internals/run.py:11)."""
+    engine = Engine()
+    ctx = RunContext(engine)
+    for sink in G.sinks:
+        nodes = [ctx.node(t) for t in sink.tables]
+        sink.attach(ctx, nodes)
+    _attach_monitoring(engine)
+    if G.sources:
+        _run_streaming(engine, ctx)
+    else:
+        engine.run_static()
+
+
+def run_all(**kwargs) -> None:
+    run(**kwargs)
+
+
+def _attach_monitoring(engine: Engine) -> None:
+    import logging
+
+    logger = logging.getLogger("pathway_tpu")
+
+    def on_error(entry):
+        logger.warning("%s (operator %s)", entry.message, entry.operator)
+
+    engine.on_error = on_error
+
+
+def _run_streaming(engine: Engine, ctx: RunContext) -> None:
+    """Drive streaming sources: start connector threads, advance engine time
+    as batches arrive (reference: Connector::run, src/connectors/mod.rs:523)."""
+    from pathway_tpu.io._connector_runtime import StreamingDriver
+
+    driver = StreamingDriver(engine, ctx)
+    driver.run(G.sources)

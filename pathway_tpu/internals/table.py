@@ -1,0 +1,842 @@
+"""The Table DSL — lazy, typed, keyed collections.
+
+TPU-native rebuild of the reference Table (reference:
+python/pathway/internals/table.py:53 — 108 methods). A Table is a schema +
+universe + a build closure producing its engine node; operations compose
+build closures lazily, and `pw.run()` / `pw.debug` drive the engine.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Type
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import thisclass
+from pathway_tpu.internals.desugaring import desugar, expand_select_args
+from pathway_tpu.internals.expression import (
+    ApplyExpression,
+    BinaryOpExpression,
+    CastExpression,
+    ColumnExpression,
+    ColumnReference,
+    DeclareTypeExpression,
+    IdReference,
+    PointerExpression,
+    ReducerExpression,
+    collect_tables,
+    smart_wrap,
+)
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.schema import (
+    ColumnSchema,
+    Schema,
+    schema_from_columns,
+    schema_from_types,
+)
+from pathway_tpu.internals.type_interpreter import infer_dtype
+from pathway_tpu.internals.universe import Universe, solver
+
+_table_names = itertools.count()
+
+
+class Table:
+    """A lazy keyed table (reference: internals/table.py Table:53)."""
+
+    def __init__(
+        self,
+        *,
+        schema: Type[Schema],
+        universe: Universe,
+        build: Callable,
+        name: str | None = None,
+    ):
+        self._schema = schema
+        self._universe = universe
+        self._build = build
+        self._name = name or f"table_{next(_table_names)}"
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def schema(self) -> Type[Schema]:
+        return self._schema
+
+    @property
+    def id(self) -> IdReference:
+        return IdReference(self)
+
+    def column_names(self) -> List[str]:
+        return list(self._schema.keys())
+
+    def keys(self):
+        return self._schema.keys()
+
+    def typehints(self) -> Dict[str, Any]:
+        return self._schema.typehints()
+
+    def dtypes(self) -> Dict[str, dt.DType]:
+        return self._schema.dtypes()
+
+    @property
+    def C(self) -> "ColumnNamespace":
+        return ColumnNamespace(self)
+
+    @property
+    def slice(self) -> "TableSlice":
+        return TableSlice(self, self.column_names())
+
+    def __repr__(self):
+        cols = ", ".join(
+            f"{n}: {c.dtype!r}" for n, c in self._schema.columns().items()
+        )
+        return f"<pw.Table {self._name}({cols})>"
+
+    def __getattr__(self, name: str) -> ColumnReference:
+        if name.startswith("__"):
+            raise AttributeError(name)
+        if name == "id":
+            return IdReference(self)
+        schema = object.__getattribute__(self, "_schema")
+        if name not in schema.keys():
+            raise AttributeError(
+                f"table {self._name!r} has no column {name!r}; "
+                f"columns: {self.column_names()}"
+            )
+        return ColumnReference(self, name)
+
+    def __getitem__(self, arg):
+        if isinstance(arg, str):
+            if arg == "id":
+                return IdReference(self)
+            if arg not in self._schema.keys():
+                raise KeyError(
+                    f"table {self._name!r} has no column {arg!r}; "
+                    f"columns: {self.column_names()}"
+                )
+            return ColumnReference(self, arg)
+        if isinstance(arg, ColumnReference):
+            return self[arg.name]
+        from pathway_tpu.internals.expression import ThisColumnReference
+
+        if isinstance(arg, ThisColumnReference):
+            return self[arg.name]
+        if isinstance(arg, (list, tuple)):
+            return self.select(*(self[c] for c in arg))
+        raise TypeError(f"cannot index table with {arg!r}")
+
+    def __iter__(self):
+        raise TypeError("a Table is not iterable; use pw.debug utilities")
+
+    # -- mapping context --------------------------------------------------
+    def _mapping(self) -> dict:
+        return {thisclass.this: self}
+
+    def _infer(self, expr: ColumnExpression) -> dt.DType:
+        def resolve(ref: ColumnReference) -> dt.DType:
+            if isinstance(ref, IdReference):
+                return dt.POINTER
+            return ref._table._schema[ref.name].dtype
+
+        return infer_dtype(expr, resolve)
+
+    # -- core transformations --------------------------------------------
+    def select(self, *args, **kwargs) -> "Table":
+        """Project/compute columns (reference: table.py select)."""
+        mapping = self._mapping()
+        cols = expand_select_args(args, self, mapping)
+        for name, e in kwargs.items():
+            cols[name] = desugar(e, mapping)
+        return self._select_impl(cols)
+
+    def _select_impl(self, cols: Dict[str, ColumnExpression]) -> "Table":
+        schema_cols = {
+            name: ColumnSchema(name=name, dtype=self._infer(e))
+            for name, e in cols.items()
+        }
+        schema = schema_from_columns(schema_cols)
+        build = _rowwise_build(self, cols)
+        return Table(schema=schema, universe=self._universe, build=build)
+
+    def filter(self, filter_expression) -> "Table":
+        """Subset rows (reference: table.py filter)."""
+        expr = desugar(filter_expression, self._mapping())
+        self_ = self
+
+        def build(ctx):
+            from pathway_tpu.engine.engine import FilterNode
+
+            node = ctx.node(self_)
+            prog = _compile_on(ctx, [self_], expr)
+            return FilterNode(ctx.engine, node, prog)
+
+        return Table(
+            schema=self._schema, universe=self._universe.subset(), build=build
+        )
+
+    def split(self, split_expression) -> tuple["Table", "Table"]:
+        pos = self.filter(split_expression)
+        from pathway_tpu.internals.expression import UnaryOpExpression
+
+        neg = self.filter(UnaryOpExpression("~", desugar(split_expression, self._mapping())))
+        return pos, neg
+
+    def with_columns(self, *args, **kwargs) -> "Table":
+        mapping = self._mapping()
+        cols: Dict[str, ColumnExpression] = {
+            name: self[name] for name in self.column_names()
+        }
+        cols.update(expand_select_args(args, self, mapping))
+        for name, e in kwargs.items():
+            cols[name] = desugar(e, mapping)
+        return self._select_impl(cols)
+
+    def without(self, *columns) -> "Table":
+        drop = {c if isinstance(c, str) else c.name for c in columns}
+        cols = {
+            name: self[name] for name in self.column_names() if name not in drop
+        }
+        return self._select_impl(cols)
+
+    def rename_columns(self, **kwargs) -> "Table":
+        """rename_columns(new_name=pw.this.old) (reference: table.py)."""
+        renames: Dict[str, str] = {}
+        for new, old in kwargs.items():
+            old_name = old if isinstance(old, str) else old.name
+            renames[old_name] = new
+        return self._rename_impl(renames)
+
+    def rename_by_dict(self, names_mapping: Mapping) -> "Table":
+        renames = {
+            (k if isinstance(k, str) else k.name): v
+            for k, v in names_mapping.items()
+        }
+        return self._rename_impl(renames)
+
+    def _rename_impl(self, renames: Dict[str, str]) -> "Table":
+        missing = set(renames) - set(self.column_names())
+        if missing:
+            raise ValueError(f"rename: unknown columns {sorted(missing)}")
+        cols: Dict[str, ColumnExpression] = {}
+        for name in self.column_names():
+            out_name = renames.get(name, name)
+            if out_name in cols:
+                raise ValueError(
+                    f"rename: output column {out_name!r} would collide"
+                )
+            cols[out_name] = self[name]
+        if len(cols) != len(self.column_names()):
+            raise ValueError("rename: output column names collide")
+        return self._select_impl(cols)
+
+    def rename(self, names_mapping: Mapping | None = None, **kwargs) -> "Table":
+        if names_mapping is not None:
+            return self.rename_by_dict(names_mapping)
+        return self.rename_columns(**kwargs)
+
+    def copy(self) -> "Table":
+        self_ = self
+        return Table(
+            schema=self._schema,
+            universe=self._universe,
+            build=lambda ctx: ctx.node(self_),
+        )
+
+    # -- typing -----------------------------------------------------------
+    def cast_to_types(self, **kwargs) -> "Table":
+        cols: Dict[str, ColumnExpression] = {
+            name: self[name] for name in self.column_names()
+        }
+        for name, target in kwargs.items():
+            cols[name] = CastExpression(dt.wrap(target), self[name])
+        return self._select_impl(cols)
+
+    def update_types(self, **kwargs) -> "Table":
+        cols: Dict[str, ColumnExpression] = {
+            name: self[name] for name in self.column_names()
+        }
+        for name, target in kwargs.items():
+            cols[name] = DeclareTypeExpression(dt.wrap(target), self[name])
+        return self._select_impl(cols)
+
+    # -- keying -----------------------------------------------------------
+    def pointer_from(self, *args, optional: bool = False, instance=None):
+        return PointerExpression(
+            self,
+            *(desugar(a, self._mapping()) for a in args),
+            optional=optional,
+            instance=instance,
+        )
+
+    def with_id_from(self, *args, instance=None) -> "Table":
+        expr = PointerExpression(
+            self,
+            *(desugar(a, self._mapping()) for a in args),
+            instance=(
+                desugar(instance, self._mapping()) if instance is not None else None
+            ),
+        )
+        return self._reindex(expr)
+
+    def with_id(self, new_id) -> "Table":
+        expr = desugar(new_id, self._mapping())
+        return self._reindex(expr)
+
+    def _reindex(self, key_expr: ColumnExpression) -> "Table":
+        self_ = self
+
+        def build(ctx):
+            from pathway_tpu.engine.engine import ReindexNode
+
+            node = ctx.node(self_)
+            prog = _compile_on(ctx, [self_], key_expr)
+            return ReindexNode(ctx.engine, node, prog)
+
+        return Table(schema=self._schema, universe=Universe(), build=build)
+
+    # -- groupby / reduce -------------------------------------------------
+    def groupby(
+        self,
+        *args,
+        id=None,
+        instance=None,
+        sort_by=None,
+        _filter_out_results_of_forgetting: bool = False,
+        **kwargs,
+    ):
+        from pathway_tpu.internals.groupbys import GroupedTable
+
+        mapping = self._mapping()
+        grouping = [desugar(a, mapping) for a in args]
+        return GroupedTable(
+            self,
+            grouping,
+            instance=desugar(instance, mapping) if instance is not None else None,
+            id_expr=desugar(id, mapping) if id is not None else None,
+            sort_by=desugar(sort_by, mapping) if sort_by is not None else None,
+        )
+
+    def reduce(self, *args, **kwargs) -> "Table":
+        return self.groupby().reduce(*args, **kwargs)
+
+    def deduplicate(
+        self,
+        *,
+        value=None,
+        instance=None,
+        acceptor: Callable[[Any, Any], bool] = None,
+        name: str | None = None,
+        persistent_id: str | None = None,
+    ) -> "Table":
+        """Keep the latest accepted row per instance (reference: table.py
+        deduplicate / Graph::deduplicate)."""
+        mapping = self._mapping()
+        value_expr = (
+            desugar(value, mapping) if value is not None else IdReference(self)
+        )
+        instance_expr = desugar(instance, mapping) if instance is not None else None
+        if acceptor is None:
+            acceptor = lambda new, old: True  # noqa: E731
+        self_ = self
+
+        def build(ctx):
+            from pathway_tpu.engine.operators import DeduplicateNode
+
+            node = ctx.node(self_)
+            value_prog = _compile_on(ctx, [self_], value_expr)
+            instance_prog = (
+                _compile_on(ctx, [self_], instance_expr)
+                if instance_expr is not None
+                else None
+            )
+            return DeduplicateNode(ctx.engine, node, value_prog, instance_prog, acceptor)
+
+        return Table(schema=self._schema, universe=Universe(), build=build)
+
+    # -- joins ------------------------------------------------------------
+    def join(self, other: "Table", *on, id=None, how=None, **kwargs):
+        from pathway_tpu.internals.joins import JoinMode, JoinResult
+
+        if how is None:
+            how = JoinMode.INNER
+        if isinstance(how, str):
+            how = JoinMode[how.upper()]
+        return JoinResult(self, other, on, id_expr=id, mode=how)
+
+    def join_inner(self, other: "Table", *on, id=None, **kwargs):
+        from pathway_tpu.internals.joins import JoinMode, JoinResult
+
+        return JoinResult(self, other, on, id_expr=id, mode=JoinMode.INNER)
+
+    def join_left(self, other: "Table", *on, id=None, **kwargs):
+        from pathway_tpu.internals.joins import JoinMode, JoinResult
+
+        return JoinResult(self, other, on, id_expr=id, mode=JoinMode.LEFT)
+
+    def join_right(self, other: "Table", *on, id=None, **kwargs):
+        from pathway_tpu.internals.joins import JoinMode, JoinResult
+
+        return JoinResult(self, other, on, id_expr=id, mode=JoinMode.RIGHT)
+
+    def join_outer(self, other: "Table", *on, id=None, **kwargs):
+        from pathway_tpu.internals.joins import JoinMode, JoinResult
+
+        return JoinResult(self, other, on, id_expr=id, mode=JoinMode.OUTER)
+
+    # -- universe algebra -------------------------------------------------
+    def intersect(self, *tables: "Table") -> "Table":
+        out = self
+        for other in tables:
+            out = _semijoin(out, other, keep_present=True)
+        return out
+
+    def difference(self, other: "Table") -> "Table":
+        return _semijoin(self, other, keep_present=False)
+
+    def restrict(self, other: "Table") -> "Table":
+        result = _semijoin(self, other, keep_present=True)
+        solver.register_equal(result._universe, other._universe)
+        return result
+
+    def having(self, *indexers) -> "Table":
+        out = self
+        for indexer in indexers:
+            expr = smart_wrap(indexer)
+            src_tables = list(collect_tables(expr, set()))
+            if len(src_tables) != 1:
+                raise ValueError("having() indexer must reference one table")
+            src = src_tables[0]
+            out = _semijoin(out, src, keep_present=True, filter_expr=expr)
+        return out
+
+    def update_rows(self, other: "Table") -> "Table":
+        """Rows of `other` override/add to `self` (reference: table.py
+        update_rows, update_rows_table in graph.rs)."""
+        if set(other.column_names()) != set(self.column_names()):
+            raise ValueError(
+                "update_rows: schemas must have the same columns; "
+                f"{self.column_names()} vs {other.column_names()}"
+            )
+        other_aligned = other.select(
+            **{c: other[c] for c in self.column_names()}
+        )
+        self_ = self
+
+        def build(ctx):
+            from pathway_tpu.engine.operators import UpdateRowsNode
+
+            return UpdateRowsNode(
+                ctx.engine, ctx.node(self_), ctx.node(other_aligned)
+            )
+
+        schema_cols = {}
+        for name in self.column_names():
+            merged = dt.types_lca(
+                self._schema[name].dtype, other._schema[name].dtype
+            )
+            schema_cols[name] = ColumnSchema(name=name, dtype=merged)
+        universe = solver.get_union(self._universe, other._universe)
+        return Table(
+            schema=schema_from_columns(schema_cols), universe=universe, build=build
+        )
+
+    def update_cells(self, other: "Table") -> "Table":
+        """Override a subset of columns for keys present in `other`
+        (reference: table.py update_cells, `t << other`)."""
+        extra = set(other.column_names()) - set(self.column_names())
+        if extra:
+            raise ValueError(f"update_cells: unknown columns {sorted(extra)}")
+        self_ = self
+        other_cols = other.column_names()
+        self_cols = self.column_names()
+        other_idx = {c: i for i, c in enumerate(other_cols)}
+
+        def build(ctx):
+            from pathway_tpu.engine.engine import RowwiseNode
+
+            a = ctx.node(self_)
+            b = ctx.node(other)
+
+            def batch_fn(keys, rows):
+                out = []
+                a_rows, b_rows = rows
+                for ar, br in zip(a_rows, b_rows):
+                    if br is None:
+                        out.append(ar)
+                    else:
+                        out.append(
+                            tuple(
+                                br[other_idx[c]] if c in other_idx else ar[i]
+                                for i, c in enumerate(self_cols)
+                            )
+                        )
+                return out
+
+            return RowwiseNode(ctx.engine, [a, b], batch_fn)
+
+        schema_cols = {}
+        for name in self_cols:
+            dtype = self._schema[name].dtype
+            if name in other_idx:
+                dtype = dt.types_lca(dtype, other._schema[name].dtype)
+            schema_cols[name] = ColumnSchema(name=name, dtype=dtype)
+        return Table(
+            schema=schema_from_columns(schema_cols),
+            universe=self._universe,
+            build=build,
+        )
+
+    def __lshift__(self, other: "Table") -> "Table":
+        return self.update_cells(other)
+
+    def with_universe_of(self, other: "Table") -> "Table":
+        self_ = self
+        result = Table(
+            schema=self._schema,
+            universe=other._universe,
+            build=lambda ctx: ctx.node(self_),
+        )
+        return result
+
+    def unsafe_promise_universes_are_equal(self, other: "Table") -> "Table":
+        solver.register_equal(self._universe, other._universe)
+        return self
+
+    def unsafe_promise_universe_is_subset_of(self, other: "Table") -> "Table":
+        solver.register_subset(self._universe, other._universe)
+        return self
+
+    def promise_universes_are_disjoint(self, other: "Table") -> "Table":
+        return self
+
+    def promise_universe_is_subset_of(self, other: "Table") -> "Table":
+        solver.register_subset(self._universe, other._universe)
+        return self
+
+    def promise_universe_is_equal_to(self, other: "Table") -> "Table":
+        solver.register_equal(self._universe, other._universe)
+        return self
+
+    # -- concat / flatten / sort -----------------------------------------
+    def concat(self, *others: "Table") -> "Table":
+        """Disjoint union (reference: table.py concat)."""
+        tables = [self] + [
+            o.select(**{c: o[c] for c in self.column_names()}) for o in others
+        ]
+
+        def build(ctx):
+            from pathway_tpu.engine.operators import ConcatNode
+
+            return ConcatNode(ctx.engine, [ctx.node(t) for t in tables])
+
+        schema_cols = {}
+        for name in self.column_names():
+            dtype = self._schema[name].dtype
+            for o in others:
+                dtype = dt.types_lca(dtype, o._schema[name].dtype)
+            schema_cols[name] = ColumnSchema(name=name, dtype=dtype)
+        universe = solver.get_union(*(t._universe for t in [self, *others]))
+        return Table(
+            schema=schema_from_columns(schema_cols), universe=universe, build=build
+        )
+
+    def concat_reindex(self, *others: "Table") -> "Table":
+        reindexed = [
+            t.with_id_from(IdReference(t), i)
+            for i, t in enumerate([self, *others])
+        ]
+        return reindexed[0].concat(*reindexed[1:])
+
+    def flatten(self, to_flatten: ColumnReference, *, origin_id: str | None = None) -> "Table":
+        """One row per element of a sequence column (reference: table.py
+        flatten, flatten_table)."""
+        ref = desugar(to_flatten, self._mapping())
+        if not isinstance(ref, ColumnReference):
+            raise TypeError("flatten expects a column reference")
+        flat_name = ref.name
+        flat_idx = self.column_names().index(flat_name)
+        self_ = self
+
+        def build(ctx):
+            from pathway_tpu.engine.operators import FlattenNode
+
+            return FlattenNode(ctx.engine, ctx.node(self_), flat_idx)
+
+        schema_cols = {}
+        for name in self.column_names():
+            dtype = self._schema[name].dtype
+            if name == flat_name:
+                core = dt.unoptionalize(dtype)
+                if isinstance(core, dt.ListDType):
+                    dtype = core.arg
+                elif isinstance(core, dt.TupleDType):
+                    out = core.args[0] if core.args else dt.ANY
+                    for a in core.args[1:]:
+                        out = dt.types_lca(out, a)
+                    dtype = out
+                elif core is dt.STR:
+                    dtype = dt.STR
+                else:
+                    dtype = dt.ANY
+            schema_cols[name] = ColumnSchema(name=name, dtype=dtype)
+        return Table(
+            schema=schema_from_columns(schema_cols),
+            universe=Universe(),
+            build=build,
+        )
+
+    def sort(self, key, instance=None) -> "Table":
+        """prev/next pointers in key order (reference: table.py sort,
+        operators/prev_next.rs)."""
+        mapping = self._mapping()
+        key_expr = desugar(key, mapping)
+        instance_expr = desugar(instance, mapping) if instance is not None else None
+        self_ = self
+
+        def build(ctx):
+            from pathway_tpu.engine.operators import SortNode
+
+            node = ctx.node(self_)
+            key_prog = _compile_on(ctx, [self_], key_expr)
+            inst_prog = (
+                _compile_on(ctx, [self_], instance_expr)
+                if instance_expr is not None
+                else None
+            )
+            return SortNode(ctx.engine, node, key_prog, inst_prog)
+
+        schema = schema_from_columns(
+            {
+                "prev": ColumnSchema(name="prev", dtype=dt.Optionalize(dt.POINTER)),
+                "next": ColumnSchema(name="next", dtype=dt.Optionalize(dt.POINTER)),
+            }
+        )
+        return Table(schema=schema, universe=self._universe, build=build)
+
+    # -- lookup -----------------------------------------------------------
+    def ix(self, expression, *, optional: bool = False, context=None, allow_misses: bool = False) -> "Table":
+        """`target.ix(keys)` — row lookup by pointer (reference: table.py ix,
+        ix_table in graph.rs)."""
+        expr = smart_wrap(expression)
+        src_tables = [t for t in collect_tables(expr, set()) if t is not self]
+        if not src_tables:
+            src_tables = list(collect_tables(expr, set()))
+        if len(src_tables) != 1:
+            raise ValueError("ix() key expression must reference exactly one table")
+        source = src_tables[0]
+        optional = optional or allow_misses
+        self_ = self
+
+        def build(ctx):
+            from pathway_tpu.engine.operators import IxNode
+
+            src_node = ctx.node(source)
+            target_node = ctx.node(self_)
+            key_prog = _compile_on(ctx, [source], expr)
+            return IxNode(
+                ctx.engine,
+                src_node,
+                target_node,
+                key_prog,
+                target_width=len(self_.column_names()),
+                optional=optional,
+            )
+
+        schema_cols = {}
+        for name in self.column_names():
+            dtype = self._schema[name].dtype
+            if optional:
+                dtype = dt.Optionalize(dtype)
+            schema_cols[name] = ColumnSchema(name=name, dtype=dtype)
+        return Table(
+            schema=schema_from_columns(schema_cols),
+            universe=source._universe,
+            build=build,
+        )
+
+    def ix_ref(self, *args, optional: bool = False, context=None, instance=None) -> "Table":
+        exprs = [smart_wrap(a) for a in args]
+        ptr = PointerExpression(self, *exprs, optional=optional, instance=instance)
+        return self.ix(ptr, optional=optional, context=context)
+
+    # -- misc -------------------------------------------------------------
+    @staticmethod
+    def empty(**kwargs) -> "Table":
+        schema = schema_from_types(**kwargs)
+
+        def build(ctx):
+            from pathway_tpu.engine.engine import StaticSource
+
+            return StaticSource(ctx.engine, {})
+
+        return Table(schema=schema, universe=Universe(), build=build)
+
+    @staticmethod
+    def from_columns(*args, **kwargs) -> "Table":
+        raise NotImplementedError(
+            "Table.from_columns: use pw.debug.table_from_pandas"
+        )
+
+    def _materialize_build(self, record_stream: bool = False):
+        """Build closure attaching a CaptureNode (used by runner/debug)."""
+        self_ = self
+
+        def build(ctx):
+            from pathway_tpu.engine.engine import CaptureNode
+
+            return CaptureNode(
+                ctx.engine, ctx.node(self_), record_stream=record_stream
+            )
+
+        return build
+
+
+class ColumnNamespace:
+    """`t.C.colname` (reference: internals/column_namespace.py)."""
+
+    def __init__(self, table: Table):
+        object.__setattr__(self, "_table", table)
+
+    def __getattr__(self, name):
+        return self._table[name]
+
+    def __getitem__(self, name):
+        return self._table[name]
+
+
+class TableSlice:
+    """`t.slice[...]` (reference: internals/table_slice.py:16)."""
+
+    def __init__(self, table: Table, columns: List[str]):
+        self._table = table
+        self._columns = columns
+
+    def __getitem__(self, arg):
+        if isinstance(arg, (list, tuple)):
+            names = [a if isinstance(a, str) else a.name for a in arg]
+            return TableSlice(self._table, names)
+        name = arg if isinstance(arg, str) else arg.name
+        return self._table[name]
+
+    def __iter__(self):
+        return iter(self._table[c] for c in self._columns)
+
+    def without(self, *cols):
+        drop = {c if isinstance(c, str) else c.name for c in cols}
+        return TableSlice(
+            self._table, [c for c in self._columns if c not in drop]
+        )
+
+    def rename(self, mapping):
+        raise NotImplementedError
+
+    def keys(self):
+        return list(self._columns)
+
+    def _table_slice_columns(self):
+        return [(c, self._table[c]) for c in self._columns]
+
+
+# ---------------------------------------------------------------------------
+# build helpers
+# ---------------------------------------------------------------------------
+
+
+def make_resolver(tables: List[Table]):
+    """Map ColumnReference -> (input idx, column idx) over an ordered table
+    list (the reference's column-path computation, graph_runner/path_evaluator)."""
+    locations: Dict[tuple, tuple] = {}
+    for ti, t in enumerate(tables):
+        for ci, name in enumerate(t.column_names()):
+            locations[(id(t), name)] = (ti, ci)
+
+    def resolve(ref: ColumnReference):
+        if isinstance(ref, IdReference):
+            return ("id",)
+        return locations.get((id(ref._table), ref.name))
+
+    return resolve
+
+
+def _compile_on(ctx, tables: List[Table], expr: ColumnExpression):
+    """Compile an expression against an ordered input-table list."""
+    from pathway_tpu.engine.expression_eval import EvalContext, compile_batch
+
+    ectx = EvalContext(make_resolver(tables))
+    ectx.error_logger = ctx.engine.log_error
+    return compile_batch(expr, ectx)
+
+
+def _ordered_tables(primary: Table, exprs: Iterable[ColumnExpression]) -> List[Table]:
+    tables = [primary]
+    seen = {id(primary)}
+    for e in exprs:
+        for t in collect_tables(e, set()):
+            if id(t) not in seen:
+                tables.append(t)
+                seen.add(id(t))
+    return tables
+
+
+def _rowwise_build(primary: Table, cols: Dict[str, ColumnExpression]):
+    tables = _ordered_tables(primary, cols.values())
+
+    def build(ctx):
+        from pathway_tpu.engine.engine import RowwiseNode
+        from pathway_tpu.engine.expression_eval import EvalContext, compile_batch
+
+        nodes = [ctx.node(t) for t in tables]
+        ectx = EvalContext(make_resolver(tables))
+        ectx.error_logger = ctx.engine.log_error
+        progs = [compile_batch(e, ectx) for e in cols.values()]
+        n_cols = len(progs)
+
+        def batch_fn(keys, rows):
+            if n_cols == 0:
+                return [() for _ in keys]
+            columns = [p(keys, rows) for p in progs]
+            return list(zip(*columns))
+
+        deterministic = all(_expr_deterministic(e) for e in cols.values())
+        return RowwiseNode(ctx.engine, nodes, batch_fn, deterministic=deterministic)
+
+    return build
+
+
+def _expr_deterministic(expr: ColumnExpression) -> bool:
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ApplyExpression) and not node._deterministic:
+            return False
+        stack.extend(node._deps())
+        for attr in ("_left", "_right", "_arg", "_expr", "_if", "_then", "_else"):
+            child = getattr(node, attr, None)
+            if isinstance(child, ColumnExpression):
+                stack.append(child)
+    return True
+
+
+def _semijoin(
+    table: Table,
+    other: Table,
+    *,
+    keep_present: bool,
+    filter_expr: ColumnExpression | None = None,
+) -> Table:
+    def build(ctx):
+        from pathway_tpu.engine.operators import SemijoinNode
+
+        filter_key_fn = None
+        if filter_expr is not None:
+            filter_key_fn = _compile_on(ctx, [other], filter_expr)
+        return SemijoinNode(
+            ctx.engine,
+            ctx.node(table),
+            ctx.node(other),
+            keep_present=keep_present,
+            filter_key_fn=filter_key_fn,
+        )
+
+    return Table(
+        schema=table._schema, universe=table._universe.subset(), build=build
+    )

@@ -1,0 +1,167 @@
+"""UDF executors: sync batched / async with capacity+timeout / fully async.
+
+TPU-native rebuild of the reference executors (reference:
+python/pathway/internals/udfs/executors.py:152,226-237,387).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from pathway_tpu.internals.expression import (
+    ApplyExpression,
+    FullyAsyncApplyExpression,
+)
+
+
+class Executor:
+    def _build_expression(self, udf, fun, args, kwargs) -> ApplyExpression:
+        raise NotImplementedError
+
+
+@dataclass
+class SyncExecutor(Executor):
+    def _build_expression(self, udf, fun, args, kwargs):
+        wrapped = _apply_cache(udf, fun)
+        return ApplyExpression(
+            wrapped,
+            udf._resolve_return_type(fun),
+            *args,
+            propagate_none=udf.propagate_none,
+            deterministic=udf.deterministic,
+            max_batch_size=udf.max_batch_size,
+            **kwargs,
+        )
+
+
+@dataclass
+class AsyncExecutor(Executor):
+    capacity: int | None = None
+    timeout: float | None = None
+    retry_strategy: Any = None
+
+    def _build_expression(self, udf, fun, args, kwargs):
+        from pathway_tpu.internals.udfs import coerce_async
+
+        afun = coerce_async(fun)
+        if self.retry_strategy is not None:
+            from pathway_tpu.internals.udfs.retries import with_retry_strategy
+
+            afun = with_retry_strategy(afun, self.retry_strategy)
+        if self.timeout is not None:
+            afun = _with_timeout(afun, self.timeout)
+        if self.capacity is not None:
+            afun = _with_capacity(afun, self.capacity)
+        afun = _apply_cache(udf, afun, is_async=True)
+        return ApplyExpression(
+            afun,
+            udf._resolve_return_type(fun),
+            *args,
+            propagate_none=udf.propagate_none,
+            deterministic=udf.deterministic,
+            is_async=True,
+            **kwargs,
+        )
+
+
+@dataclass
+class FullyAsyncExecutor(Executor):
+    capacity: int | None = None
+    timeout: float | None = None
+    retry_strategy: Any = None
+    autocommit_duration_ms: int | None = 100
+
+    def _build_expression(self, udf, fun, args, kwargs):
+        from pathway_tpu.internals.udfs import coerce_async
+
+        afun = coerce_async(fun)
+        if self.capacity is not None:
+            afun = _with_capacity(afun, self.capacity)
+        expr = FullyAsyncApplyExpression(
+            afun,
+            udf._resolve_return_type(fun),
+            *args,
+            propagate_none=udf.propagate_none,
+            deterministic=udf.deterministic,
+            is_async=True,
+            **kwargs,
+        )
+        expr.autocommit_duration_ms = self.autocommit_duration_ms
+        return expr
+
+
+class AutoExecutor(Executor):
+    def _build_expression(self, udf, fun, args, kwargs):
+        if asyncio.iscoroutinefunction(fun):
+            return AsyncExecutor()._build_expression(udf, fun, args, kwargs)
+        return SyncExecutor()._build_expression(udf, fun, args, kwargs)
+
+
+def auto_executor() -> Executor:
+    return AutoExecutor()
+
+
+def sync_executor() -> Executor:
+    return SyncExecutor()
+
+
+def async_executor(
+    *, capacity: int | None = None, timeout: float | None = None, retry_strategy=None
+) -> Executor:
+    return AsyncExecutor(
+        capacity=capacity, timeout=timeout, retry_strategy=retry_strategy
+    )
+
+
+def fully_async_executor(
+    *,
+    capacity: int | None = None,
+    timeout: float | None = None,
+    retry_strategy=None,
+    autocommit_duration_ms: int | None = 100,
+) -> Executor:
+    return FullyAsyncExecutor(
+        capacity=capacity,
+        timeout=timeout,
+        retry_strategy=retry_strategy,
+        autocommit_duration_ms=autocommit_duration_ms,
+    )
+
+
+def _with_capacity(afun: Callable, capacity: int) -> Callable:
+    semaphores: dict = {}
+
+    @functools.wraps(afun)
+    async def wrapper(*args, **kwargs):
+        loop = asyncio.get_running_loop()
+        sem = semaphores.get(id(loop))
+        if sem is None:
+            sem = asyncio.Semaphore(capacity)
+            semaphores[id(loop)] = sem
+        async with sem:
+            return await afun(*args, **kwargs)
+
+    return wrapper
+
+
+def _with_timeout(afun: Callable, timeout: float) -> Callable:
+    @functools.wraps(afun)
+    async def wrapper(*args, **kwargs):
+        return await asyncio.wait_for(afun(*args, **kwargs), timeout)
+
+    return wrapper
+
+
+with_capacity = _with_capacity
+with_timeout = _with_timeout
+
+
+def _apply_cache(udf, fun: Callable, is_async: bool = False) -> Callable:
+    if udf.cache_strategy is None:
+        return fun
+    from pathway_tpu.internals.udfs.caches import with_cache_strategy
+
+    return with_cache_strategy(fun, udf.cache_strategy, is_async=is_async)

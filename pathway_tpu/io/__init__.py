@@ -1,0 +1,56 @@
+"""pw.io — connectors (reference: python/pathway/io/__init__.py).
+
+Connector modules are populated progressively; `subscribe` and the python
+ConnectorSubject are the core primitives (reference: io/_subscribe.py:16,
+io/python/__init__.py:47).
+"""
+
+from __future__ import annotations
+
+from pathway_tpu.io._subscribe import subscribe
+
+from pathway_tpu.io import csv, fs, jsonlines, null, plaintext, python
+
+__all__ = [
+    "subscribe",
+    "csv",
+    "fs",
+    "jsonlines",
+    "null",
+    "plaintext",
+    "python",
+]
+
+
+def __getattr__(name):
+    # lazily import heavier connector modules
+    import importlib
+
+    known = {
+        "http",
+        "kafka",
+        "redpanda",
+        "debezium",
+        "s3",
+        "minio",
+        "sqlite",
+        "postgres",
+        "elasticsearch",
+        "mongodb",
+        "nats",
+        "mqtt",
+        "deltalake",
+        "iceberg",
+        "bigquery",
+        "pubsub",
+        "dynamodb",
+        "questdb",
+        "logstash",
+        "slack",
+        "gdrive",
+        "airbyte",
+        "pyfilesystem",
+    }
+    if name in known:
+        return importlib.import_module(f"pathway_tpu.io.{name}")
+    raise AttributeError(f"module pathway_tpu.io has no attribute {name!r}")

@@ -1,0 +1,255 @@
+"""Connector runtime: reader threads, commit ticks, the streaming driver.
+
+TPU-native rebuild of the reference connector machinery (reference:
+src/connectors/mod.rs Connector::run:523 — reader thread per source, commit
+ticks advancing engine time; even timestamps mark batch boundaries,
+src/engine/timestamp.rs). Here each live source runs a python thread pushing
+events into the driver's queue; the driver groups them into engine times and
+steps the dataflow.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as queue_mod
+import threading
+import time as time_mod
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from pathway_tpu.engine.value import Pointer, ref_scalar
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.schema import Schema
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+
+_source_ids = itertools.count()
+
+
+class LiveSource:
+    """One streaming input: a subject factory + the engine node it feeds."""
+
+    def __init__(self, subject_factory, schema, name: str):
+        self.subject_factory = subject_factory
+        self.schema = schema
+        self.name = name
+        self.node = None  # set at build time
+
+
+def connector_table(
+    schema,
+    subject_factory: Callable[[], "ConnectorSubjectBase"],
+    *,
+    mode: str = "streaming",
+    name: str | None = None,
+) -> Table:
+    """Create a table fed by a connector subject (reference:
+    Graph::connector_table, dataflow.rs:3880)."""
+    name = name or f"source_{next(_source_ids)}"
+    live = LiveSource(subject_factory, schema, name)
+
+    if mode == "static":
+
+        def build_static(ctx):
+            from pathway_tpu.engine.engine import StaticSource
+
+            subject = subject_factory()
+            collector = _StaticCollector(schema)
+            subject._bind(collector)
+            subject.run()
+            subject.on_stop()
+            return StaticSource(ctx.engine, collector.rows)
+
+        return Table(schema=schema, universe=Universe(), build=build_static)
+
+    def build_streaming(ctx):
+        from pathway_tpu.engine.engine import InputQueueSource
+
+        node = InputQueueSource(ctx.engine)
+        live.node = node
+        if live not in G.sources:
+            G.add_source(live)
+        return node
+
+    return Table(schema=schema, universe=Universe(), build=build_streaming)
+
+
+class _StaticCollector:
+    """Synchronously drains a subject in static mode."""
+
+    def __init__(self, schema):
+        self.schema = schema
+        self.names = list(schema.keys())
+        self.pk = schema.primary_key_columns()
+        self.rows: Dict[Pointer, tuple] = {}
+        self._counter = 0
+
+    def push_row(self, row: dict, diff: int = 1) -> None:
+        values = tuple(row.get(c) for c in self.names)
+        if self.pk:
+            key = ref_scalar(*(row.get(c) for c in self.pk))
+        else:
+            self._counter += 1
+            key = ref_scalar(self.schema.__name__, self._counter)
+        if diff > 0:
+            self.rows[key] = values
+        else:
+            self.rows.pop(key, None)
+
+    def commit(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class ConnectorSubjectBase:
+    """Base for python connector subjects (reference:
+    io/python/__init__.py:47 ConnectorSubject): background thread calling
+    next()/commit()/close()."""
+
+    def __init__(self):
+        self._sink = None
+        self._closed = False
+
+    def _bind(self, sink) -> None:
+        self._sink = sink
+
+    # -- API used by subclasses ------------------------------------------
+    def next(self, **kwargs) -> None:
+        self._sink.push_row(kwargs)
+
+    def next_json(self, message: dict) -> None:
+        self.next(**message)
+
+    def next_bytes(self, payload: bytes) -> None:
+        self.next(data=payload)
+
+    def next_str(self, message: str) -> None:
+        self.next(data=message)
+
+    def _remove(self, row: dict) -> None:
+        self._sink.push_row(row, diff=-1)
+
+    def commit(self) -> None:
+        self._sink.commit()
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._sink.close()
+
+    # -- to override ------------------------------------------------------
+    def run(self) -> None:
+        raise NotImplementedError
+
+    def on_stop(self) -> None:
+        pass
+
+    @property
+    def _deletions_enabled(self) -> bool:
+        return True
+
+
+class _QueueSink:
+    """Routes a live subject's rows into the driver queue."""
+
+    def __init__(self, driver_queue, live: LiveSource):
+        self.queue = driver_queue
+        self.live = live
+        self.names = list(live.schema.keys())
+        self.pk = live.schema.primary_key_columns()
+        self._counter = 0
+
+    def push_row(self, row: dict, diff: int = 1) -> None:
+        values = tuple(row.get(c) for c in self.names)
+        if self.pk:
+            key = ref_scalar(*(row.get(c) for c in self.pk))
+        else:
+            self._counter += 1
+            key = ref_scalar(self.live.name, self._counter)
+        self.queue.put(("data", self.live, (key, values, diff)))
+
+    def commit(self) -> None:
+        self.queue.put(("commit", self.live, None))
+
+    def close(self) -> None:
+        self.queue.put(("close", self.live, None))
+
+
+class StreamingDriver:
+    """Main streaming loop: collects source events, advances engine time
+    (reference: worker main loop, dataflow.rs:6552-6620)."""
+
+    def __init__(self, engine, ctx, *, autocommit_ms: float = 100.0):
+        self.engine = engine
+        self.ctx = ctx
+        self.autocommit_s = autocommit_ms / 1000.0
+        self.queue: queue_mod.SimpleQueue = queue_mod.SimpleQueue()
+
+    def run(self, sources: List[LiveSource]) -> None:
+        threads = []
+        active = 0
+        for live in sources:
+            if live.node is None:
+                continue  # source never built (tree-shaken)
+            subject = live.subject_factory()
+            sink = _QueueSink(self.queue, live)
+            subject._bind(sink)
+
+            def runner(subject=subject):
+                try:
+                    subject.run()
+                finally:
+                    subject.on_stop()
+                    subject.close()
+
+            t = threading.Thread(target=runner, daemon=True, name=live.name)
+            threads.append(t)
+            active += 1
+        # initial time 0 processes static parts of the graph
+        self.engine.process_time(0)
+        for t in threads:
+            t.start()
+
+        time = 2
+        pending: Dict[LiveSource, List] = {}
+        last_flush = time_mod.monotonic()
+
+        def flush():
+            nonlocal time, last_flush
+            flushed = False
+            for live, deltas in pending.items():
+                if deltas:
+                    live.node.push(time, deltas)
+                    flushed = True
+            pending.clear()
+            if flushed:
+                self.engine.process_time(time)
+                time += 2
+            # run scheduled times that are due
+            nxt = self.engine.next_scheduled_time()
+            while nxt is not None and nxt <= time:
+                self.engine.process_time(nxt)
+                nxt = self.engine.next_scheduled_time()
+            last_flush = time_mod.monotonic()
+
+        while active > 0:
+            timeout = max(
+                0.0, self.autocommit_s - (time_mod.monotonic() - last_flush)
+            )
+            try:
+                kind, live, payload = self.queue.get(timeout=timeout or 0.01)
+            except queue_mod.Empty:
+                flush()
+                continue
+            if kind == "data":
+                pending.setdefault(live, []).append(payload)
+            elif kind == "commit":
+                flush()
+            elif kind == "close":
+                active -= 1
+            if self.engine.terminate_flag.is_set():
+                break
+        flush()
+        self.engine.finish()

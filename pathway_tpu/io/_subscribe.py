@@ -1,0 +1,39 @@
+"""pw.io.subscribe — change callbacks (reference:
+python/pathway/io/_subscribe.py:16, engine subscribe_table)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from pathway_tpu.internals.parse_graph import G
+
+
+def subscribe(
+    table,
+    on_change: Callable | None = None,
+    on_end: Callable | None = None,
+    on_time_end: Callable | None = None,
+    *,
+    skip_persisted_batch: bool = True,
+    name: str | None = None,
+    sort_by=None,
+) -> None:
+    """Register callbacks on table changes. on_change(key, row, time,
+    is_addition) fires per delta; on_time_end(time) per closed batch;
+    on_end() at end of stream."""
+    column_names = table.column_names()
+
+    def attach(ctx, nodes):
+        from pathway_tpu.engine.engine import SubscribeNode
+
+        (node,) = nodes
+        SubscribeNode(
+            ctx.engine,
+            node,
+            on_change=on_change,
+            on_time_end=on_time_end,
+            on_end=on_end,
+            column_names=column_names,
+        )
+
+    G.add_sink([table], attach)

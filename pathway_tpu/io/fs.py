@@ -1,0 +1,277 @@
+"""pw.io.fs — filesystem connector (reference: python/pathway/io/fs,
+src/connectors/posix_like.rs, scanner/filesystem.rs: glob-pattern polling
+scanner with modify/delete detection).
+"""
+
+from __future__ import annotations
+
+import csv as csv_mod
+import glob as glob_mod
+import json
+import os
+import time as time_mod
+from typing import Any, Dict, List, Optional
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.schema import (
+    ColumnSchema,
+    Schema,
+    schema_from_columns,
+)
+from pathway_tpu.io._connector_runtime import (
+    ConnectorSubjectBase,
+    connector_table,
+)
+
+
+def _plaintext_schema():
+    return schema_from_columns(
+        {"data": ColumnSchema(name="data", dtype=dt.STR)}, name="PlaintextSchema"
+    )
+
+
+def _binary_schema():
+    return schema_from_columns(
+        {"data": ColumnSchema(name="data", dtype=dt.BYTES)}, name="BinarySchema"
+    )
+
+
+def _with_metadata(schema):
+    cols = dict(schema.columns().items())
+    cols["_metadata"] = ColumnSchema(name="_metadata", dtype=dt.JSON)
+    return schema_from_columns(cols, name=schema.__name__ + "Meta")
+
+
+class _FsSubject(ConnectorSubjectBase):
+    def __init__(
+        self,
+        path: str,
+        format: str,
+        schema,
+        mode: str,
+        with_metadata: bool,
+        refresh_interval: float = 1.0,
+        object_pattern: str = "*",
+    ):
+        super().__init__()
+        self.path = path
+        self.format = format
+        self.schema = schema
+        self.mode = mode
+        self.with_metadata = with_metadata
+        self.refresh_interval = refresh_interval
+        self.object_pattern = object_pattern
+        self._seen: Dict[str, float] = {}
+
+    def _list_files(self) -> List[str]:
+        p = self.path
+        if os.path.isdir(p):
+            pattern = os.path.join(p, "**", self.object_pattern)
+            files = glob_mod.glob(pattern, recursive=True)
+        else:
+            files = glob_mod.glob(p, recursive=True)
+        return sorted(f for f in files if os.path.isfile(f))
+
+    def _metadata(self, f: str):
+        from pathway_tpu.engine.value import Json
+
+        st = os.stat(f)
+        return Json(
+            {
+                "path": os.path.abspath(f),
+                "size": st.st_size,
+                "modified_at": int(st.st_mtime),
+                "seen_at": int(time_mod.time()),
+            }
+        )
+
+    def _emit_file(self, f: str) -> None:
+        meta = {"_metadata": self._metadata(f)} if self.with_metadata else {}
+        if self.format == "binary":
+            with open(f, "rb") as fh:
+                self.next(data=fh.read(), **meta)
+        elif self.format in ("plaintext", "plaintext_by_file"):
+            with open(f, "r", errors="replace") as fh:
+                if self.format == "plaintext_by_file":
+                    self.next(data=fh.read(), **meta)
+                else:
+                    for line in fh:
+                        self.next(data=line.rstrip("\n"), **meta)
+        elif self.format in ("json", "jsonlines"):
+            names = set(self.schema.keys())
+            with open(f, "r", errors="replace") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    obj = json.loads(line)
+                    row = {
+                        k: _coerce_json_value(v, self.schema[k].dtype)
+                        for k, v in obj.items()
+                        if k in names
+                    }
+                    self.next(**row, **meta)
+        elif self.format == "csv":
+            names = set(self.schema.keys())
+            with open(f, "r", newline="", errors="replace") as fh:
+                reader = csv_mod.DictReader(fh)
+                for rec in reader:
+                    row = {
+                        k: _parse_csv_value(v, self.schema[k].dtype)
+                        for k, v in rec.items()
+                        if k in names
+                    }
+                    self.next(**row, **meta)
+        else:
+            raise ValueError(f"unknown format {self.format!r}")
+
+    def run(self) -> None:
+        while True:
+            for f in self._list_files():
+                try:
+                    mtime = os.stat(f).st_mtime
+                except OSError:
+                    continue
+                if self._seen.get(f) == mtime:
+                    continue
+                self._seen[f] = mtime
+                self._emit_file(f)
+            self.commit()
+            if self.mode == "static":
+                return
+            time_mod.sleep(self.refresh_interval)
+
+
+def _parse_csv_value(text, dtype: dt.DType):
+    if text is None:
+        return None
+    core = dt.unoptionalize(dtype)
+    try:
+        if core is dt.INT:
+            return int(text)
+        if core is dt.FLOAT:
+            return float(text)
+        if core is dt.BOOL:
+            return text.strip().lower() in ("true", "1", "yes", "on")
+    except ValueError:
+        return None
+    return text
+
+
+def _coerce_json_value(v, dtype: dt.DType):
+    core = dt.unoptionalize(dtype)
+    if core is dt.JSON:
+        from pathway_tpu.engine.value import Json
+
+        return Json(v)
+    if core is dt.FLOAT and isinstance(v, int):
+        return float(v)
+    if isinstance(v, (dict, list)):
+        from pathway_tpu.engine.value import Json
+
+        return Json(v)
+    return v
+
+
+def read(
+    path: str,
+    *,
+    format: str = "csv",
+    schema=None,
+    mode: str = "streaming",
+    csv_settings=None,
+    json_field_paths=None,
+    object_pattern: str = "*",
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    name: str | None = None,
+    refresh_interval: float = 1.0,
+    **kwargs,
+):
+    """Read files as a table (reference: io/fs read; StorageType PosixLike /
+    CsvFilesystem, data_storage.rs:359)."""
+    if schema is None:
+        if format in ("plaintext", "plaintext_by_file"):
+            schema = _plaintext_schema()
+        elif format == "binary":
+            schema = _binary_schema()
+        else:
+            raise ValueError(f"schema required for format {format!r}")
+    out_schema = _with_metadata(schema) if with_metadata else schema
+
+    def factory():
+        return _FsSubject(
+            path,
+            format,
+            schema,
+            mode,
+            with_metadata,
+            refresh_interval=refresh_interval,
+            object_pattern=object_pattern,
+        )
+
+    return connector_table(out_schema, factory, mode=mode, name=name)
+
+
+def write(table, filename: str, *, format: str = "json", name: str | None = None, **kwargs) -> None:
+    """Write a table's change stream to a file (reference: io/fs write)."""
+    column_names = table.column_names()
+
+    def attach(ctx, nodes):
+        from pathway_tpu.engine.engine import SubscribeNode
+
+        (node,) = nodes
+        fh = open(filename, "w", newline="")
+        if format == "csv":
+            writer = csv_mod.writer(fh)
+            writer.writerow(column_names + ["time", "diff"])
+
+            def on_change(key, row, time, is_addition):
+                writer.writerow(
+                    [row[c] for c in column_names] + [time, 1 if is_addition else -1]
+                )
+
+        else:
+
+            def on_change(key, row, time, is_addition):
+                obj = {c: _jsonable(row[c]) for c in column_names}
+                obj["time"] = time
+                obj["diff"] = 1 if is_addition else -1
+                fh.write(json.dumps(obj) + "\n")
+
+        def on_end():
+            fh.flush()
+            fh.close()
+
+        SubscribeNode(
+            ctx.engine,
+            node,
+            on_change=on_change,
+            on_end=on_end,
+            column_names=column_names,
+        )
+
+    G.add_sink([table], attach)
+
+
+def _jsonable(v):
+    import numpy as np
+
+    from pathway_tpu.engine.value import Json, Pointer
+
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, Pointer):
+        return repr(v)
+    if isinstance(v, bytes):
+        return v.decode(errors="replace")
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    import datetime
+
+    if isinstance(v, (datetime.datetime,)):
+        return v.isoformat()
+    if isinstance(v, datetime.timedelta):
+        return v.total_seconds()
+    return v

@@ -1,0 +1,1 @@
+"""XLA/Pallas kernels for the data plane (KNN, similarity, top-k)."""

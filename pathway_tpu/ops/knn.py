@@ -1,0 +1,240 @@
+"""Brute-force KNN as XLA matmul + top_k, mesh-shardable.
+
+TPU-native replacement for the reference's per-worker-replicated CPU kernel
+(reference: src/external_integration/brute_force_knn_integration.rs:52-110 —
+O(N·d) f64 ndarray matmul + per-query top-k, full index copy per worker;
+broadcast at src/engine/dataflow/operators/external_index.rs:70).
+
+Design departures, deliberate:
+  * scores are computed in bfloat16/f32 on the MXU, not f64;
+  * the index lives in a device buffer padded to bucketed capacities so
+    adds/removes don't trigger recompiles (dynamic shapes are hostile to
+    XLA; see SURVEY.md §7 'hard parts');
+  * across a mesh the index is *sharded* on the row axis; each shard
+    computes a local top-k and results are merged — an all-gather of
+    [Q, k_local] beats gathering [N, d] by orders of magnitude.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _next_bucket(n: int, minimum: int = 8) -> int:
+    """Round up to the next power of two (compile-cache friendly)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_search(n_pad: int, q_pad: int, d: int, k: int, metric: str):
+    import jax
+    import jax.numpy as jnp
+
+    def search(index, valid, queries):
+        # index: [n_pad, d] f32, valid: [n_pad] bool, queries: [q_pad, d]
+        if metric == "cos":
+            index_n = index / (
+                jnp.linalg.norm(index, axis=1, keepdims=True) + 1e-30
+            )
+            queries_n = queries / (
+                jnp.linalg.norm(queries, axis=1, keepdims=True) + 1e-30
+            )
+            scores = queries_n @ index_n.T  # [q, n] on the MXU
+        elif metric == "ip":
+            scores = queries @ index.T
+        elif metric == "l2sq":
+            # -||q - x||^2 = 2 q·x - ||x||^2 - ||q||^2 ; rank by negated dist
+            sq_i = jnp.sum(index * index, axis=1)
+            sq_q = jnp.sum(queries * queries, axis=1, keepdims=True)
+            scores = 2.0 * (queries @ index.T) - sq_i[None, :] - sq_q
+        else:
+            raise ValueError(f"unknown metric {metric!r}")
+        scores = jnp.where(valid[None, :], scores, -jnp.inf)
+        top_scores, top_idx = jax.lax.top_k(scores, k)
+        return top_scores, top_idx
+
+    return jax.jit(search)
+
+
+class DeviceKnnIndex:
+    """Mutable KNN index with a bucketed device buffer.
+
+    Adds/removes mutate a host-side free-list and are flushed to the device
+    buffer lazily before the next search (reference mutates a grow/shrink
+    ndarray: brute_force_knn_integration.rs:113-140).
+    """
+
+    def __init__(
+        self,
+        dimensions: int,
+        *,
+        metric: str = "cos",
+        reserved_space: int = 512,
+    ):
+        self.d = dimensions
+        self.metric = metric
+        self.capacity = _next_bucket(max(reserved_space, 8))
+        self._vectors = np.zeros((self.capacity, self.d), dtype=np.float32)
+        self._valid = np.zeros((self.capacity,), dtype=bool)
+        self._slot_of_key: dict = {}
+        self._key_of_slot: dict = {}
+        self._free: list[int] = list(range(self.capacity))
+        self._device_dirty = True
+        self._dev_vectors = None
+        self._dev_valid = None
+
+    def __len__(self) -> int:
+        return len(self._slot_of_key)
+
+    def add(self, key, vector) -> None:
+        vector = np.asarray(vector, dtype=np.float32).reshape(-1)
+        if vector.shape[0] != self.d:
+            raise ValueError(
+                f"vector dim {vector.shape[0]} != index dim {self.d}"
+            )
+        if key in self._slot_of_key:
+            slot = self._slot_of_key[key]
+        else:
+            if not self._free:
+                self._grow()
+            slot = self._free.pop()
+            self._slot_of_key[key] = slot
+            self._key_of_slot[slot] = key
+        self._vectors[slot] = vector
+        self._valid[slot] = True
+        self._device_dirty = True
+
+    def remove(self, key) -> None:
+        slot = self._slot_of_key.pop(key, None)
+        if slot is None:
+            return
+        del self._key_of_slot[slot]
+        self._valid[slot] = False
+        self._free.append(slot)
+        self._device_dirty = True
+
+    def _grow(self) -> None:
+        new_capacity = self.capacity * 2
+        vectors = np.zeros((new_capacity, self.d), dtype=np.float32)
+        valid = np.zeros((new_capacity,), dtype=bool)
+        vectors[: self.capacity] = self._vectors
+        valid[: self.capacity] = self._valid
+        self._free.extend(range(self.capacity, new_capacity))
+        self.capacity = new_capacity
+        self._vectors = vectors
+        self._valid = valid
+        self._device_dirty = True
+
+    def _sync_device(self) -> None:
+        if not self._device_dirty:
+            return
+        import jax.numpy as jnp
+
+        self._dev_vectors = jnp.asarray(self._vectors)
+        self._dev_valid = jnp.asarray(self._valid)
+        self._device_dirty = False
+
+    def search(
+        self, queries, k: int
+    ) -> Tuple[np.ndarray, np.ndarray, list]:
+        """Return (scores [Q,k], slot indices [Q,k], keys_per_slot lookup).
+
+        Scores are similarity-like: higher is better for every metric
+        (l2sq scores are negated squared distances)."""
+        queries = np.asarray(queries, dtype=np.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        q = queries.shape[0]
+        if q == 0 or not self._slot_of_key:
+            return (
+                np.zeros((q, 0), dtype=np.float32),
+                np.zeros((q, 0), dtype=np.int64),
+                [],
+            )
+        self._sync_device()
+        q_pad = _next_bucket(q, 1)
+        k_eff = min(k, self.capacity)
+        padded = np.zeros((q_pad, self.d), dtype=np.float32)
+        padded[:q] = queries
+        fn = _compiled_search(self.capacity, q_pad, self.d, k_eff, self.metric)
+        top_scores, top_idx = fn(self._dev_vectors, self._dev_valid, padded)
+        top_scores = np.asarray(top_scores)[:q]
+        top_idx = np.asarray(top_idx)[:q]
+        return top_scores, top_idx, self._key_of_slot
+
+    def search_keys(self, queries, k: int) -> list:
+        """Per query: list of (key, score) with invalid slots dropped."""
+        top_scores, top_idx, key_of_slot = self.search(queries, k)
+        out = []
+        for scores_row, idx_row in zip(top_scores, top_idx):
+            row = []
+            for s, i in zip(scores_row, idx_row):
+                if not np.isfinite(s):
+                    continue
+                key = key_of_slot.get(int(i))
+                if key is not None:
+                    row.append((key, float(s)))
+            out.append(row)
+        return out
+
+
+def sharded_knn_search(mesh, index, valid, queries, k: int, metric: str = "cos"):
+    """Mesh-sharded search: index rows sharded over the mesh's first axis,
+    per-shard top-k, then a global merge (the all-gather of [Q, k] per shard
+    rides ICI; reference instead broadcast-replicates the whole index,
+    external_index.rs:70)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    axis = mesh.axis_names[0]
+    n_dev = mesh.shape[axis]
+
+    def local_search(index_shard, valid_shard, queries_rep):
+        if metric == "cos":
+            ix = index_shard / (
+                jnp.linalg.norm(index_shard, axis=1, keepdims=True) + 1e-30
+            )
+            qx = queries_rep / (
+                jnp.linalg.norm(queries_rep, axis=1, keepdims=True) + 1e-30
+            )
+            scores = qx @ ix.T
+        elif metric == "ip":
+            scores = queries_rep @ index_shard.T
+        else:
+            sq_i = jnp.sum(index_shard * index_shard, axis=1)
+            sq_q = jnp.sum(queries_rep * queries_rep, axis=1, keepdims=True)
+            scores = 2.0 * (queries_rep @ index_shard.T) - sq_i[None, :] - sq_q
+        scores = jnp.where(valid_shard[None, :], scores, -jnp.inf)
+        local_scores, local_idx = jax.lax.top_k(scores, k)
+        # globalize slot ids, then gather candidates from every shard
+        shard_id = jax.lax.axis_index(axis)
+        shard_size = index_shard.shape[0]
+        global_idx = local_idx + shard_id * shard_size
+        all_scores = jax.lax.all_gather(local_scores, axis)  # [n_dev, Q, k]
+        all_idx = jax.lax.all_gather(global_idx, axis)
+        all_scores = jnp.transpose(all_scores, (1, 0, 2)).reshape(
+            queries_rep.shape[0], n_dev * k
+        )
+        all_idx = jnp.transpose(all_idx, (1, 0, 2)).reshape(
+            queries_rep.shape[0], n_dev * k
+        )
+        merged_scores, merged_pos = jax.lax.top_k(all_scores, k)
+        merged_idx = jnp.take_along_axis(all_idx, merged_pos, axis=1)
+        return merged_scores, merged_idx
+
+    fn = shard_map(
+        local_search,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis), P(None, None)),
+        out_specs=(P(None, None), P(None, None)),
+        check_rep=False,
+    )
+    return jax.jit(fn)(index, valid, queries)

@@ -1,0 +1,11 @@
+"""Device mesh + sharding helpers (TPU-native; replaces the reference's
+worker/process config, src/engine/dataflow/config.rs)."""
+
+from pathway_tpu.parallel.mesh import (
+    default_mesh,
+    get_mesh,
+    local_device_count,
+    with_mesh,
+)
+
+__all__ = ["default_mesh", "get_mesh", "local_device_count", "with_mesh"]

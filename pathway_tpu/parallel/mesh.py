@@ -1,0 +1,60 @@
+"""Device mesh management.
+
+The reference scales by timely workers exchanging rows over TCP
+(src/engine/dataflow/config.rs: PATHWAY_THREADS × PATHWAY_PROCESSES). The
+TPU-native design instead lays computation over a `jax.sharding.Mesh`:
+data-parallel batch work on the `dp` axis, model/index sharding on `tp`.
+XLA inserts the collectives (all_gather / psum / reduce_scatter) that ride
+ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def local_device_count() -> int:
+    import jax
+
+    return len(jax.devices())
+
+
+_active_mesh = None
+
+
+def get_mesh(
+    axis_shapes: Sequence[int] | None = None,
+    axis_names: Sequence[str] = ("dp", "tp"),
+):
+    """Build a Mesh over the available devices. With no shapes, all devices
+    land on the first axis."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices())
+    if axis_shapes is None:
+        axis_shapes = [len(devices)] + [1] * (len(axis_names) - 1)
+    devices = devices.reshape(tuple(axis_shapes))
+    return Mesh(devices, tuple(axis_names))
+
+
+def default_mesh():
+    global _active_mesh
+    if _active_mesh is None:
+        _active_mesh = get_mesh()
+    return _active_mesh
+
+
+@contextlib.contextmanager
+def with_mesh(mesh):
+    global _active_mesh
+    prev = _active_mesh
+    _active_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _active_mesh = prev

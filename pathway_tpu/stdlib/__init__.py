@@ -1,0 +1,21 @@
+"""Standard library (reference: python/pathway/stdlib)."""
+
+from pathway_tpu.stdlib import (
+    indexing,
+    ml,
+    ordered,
+    stateful,
+    statistical,
+    temporal,
+    utils,
+)
+
+__all__ = [
+    "indexing",
+    "ml",
+    "ordered",
+    "stateful",
+    "statistical",
+    "temporal",
+    "utils",
+]

@@ -1,0 +1,52 @@
+"""pw.indexing — KNN / BM25 / hybrid retrieval (reference:
+python/pathway/stdlib/indexing/). Filled by the TPU data plane:
+BruteForceKnn runs as a sharded XLA matmul+top_k (see pathway_tpu/ops/knn.py).
+"""
+
+from pathway_tpu.stdlib.indexing.data_index import (
+    DataIndex,
+    InnerIndex,
+    IdScoreSchema,
+)
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    BruteForceKnnFactory,
+    BruteForceKnnMetricKind,
+    LshKnn,
+    USearchKnn,
+    UsearchKnnFactory,
+    USearchMetricKind,
+)
+from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25, TantivyBM25Factory
+from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndex, HybridIndexFactory
+from pathway_tpu.stdlib.indexing.vector_document_index import (
+    default_brute_force_knn_document_index,
+    default_lsh_knn_document_index,
+    default_usearch_knn_document_index,
+    default_vector_document_index,
+)
+from pathway_tpu.stdlib.indexing.full_text_document_index import (
+    default_full_text_document_index,
+)
+
+__all__ = [
+    "DataIndex",
+    "InnerIndex",
+    "IdScoreSchema",
+    "BruteForceKnn",
+    "BruteForceKnnFactory",
+    "BruteForceKnnMetricKind",
+    "USearchKnn",
+    "UsearchKnnFactory",
+    "USearchMetricKind",
+    "LshKnn",
+    "TantivyBM25",
+    "TantivyBM25Factory",
+    "HybridIndex",
+    "HybridIndexFactory",
+    "default_vector_document_index",
+    "default_brute_force_knn_document_index",
+    "default_lsh_knn_document_index",
+    "default_usearch_knn_document_index",
+    "default_full_text_document_index",
+]

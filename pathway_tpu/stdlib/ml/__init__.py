@@ -1,0 +1,6 @@
+"""pw.ml (reference: python/pathway/stdlib/ml/). Populated progressively:
+index (legacy KNNIndex), classifiers, smart_table_ops."""
+
+from pathway_tpu.stdlib.ml import index
+
+__all__ = ["index"]

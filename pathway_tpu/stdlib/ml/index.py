@@ -1,0 +1,72 @@
+"""Legacy KNNIndex API (reference: python/pathway/stdlib/ml/index.py:9 —
+LSH-based; here backed by the XLA brute-force kernel)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    BruteForceKnnMetricKind,
+)
+
+
+class KNNIndex:
+    """reference: ml/index.py KNNIndex — thin wrapper over DataIndex."""
+
+    def __init__(
+        self,
+        data_embedding,
+        data: Table,
+        n_dimensions: int,
+        n_or: int = 20,
+        n_and: int = 10,
+        bucket_length: float = 10.0,
+        distance_type: str = "euclidean",
+        metadata=None,
+    ):
+        metric = (
+            BruteForceKnnMetricKind.COS
+            if distance_type == "cosine"
+            else BruteForceKnnMetricKind.L2SQ
+        )
+        inner = BruteForceKnn(
+            data_embedding,
+            metadata,
+            dimensions=n_dimensions,
+            metric=metric,
+        )
+        self._index = DataIndex(data, inner)
+        self._data = data
+
+    def get_nearest_items(
+        self,
+        query_embedding,
+        k: int = 3,
+        collapse_rows: bool = True,
+        with_distances: bool = False,
+        metadata_filter=None,
+    ) -> Table:
+        return self._index.query(
+            query_embedding,
+            number_of_matches=k,
+            collapse_rows=collapse_rows,
+            metadata_filter=metadata_filter,
+        )
+
+    def get_nearest_items_asof_now(
+        self,
+        query_embedding,
+        k: int = 3,
+        collapse_rows: bool = True,
+        with_distances: bool = False,
+        metadata_filter=None,
+    ) -> Table:
+        return self._index.query_as_of_now(
+            query_embedding,
+            number_of_matches=k,
+            collapse_rows=collapse_rows,
+            metadata_filter=metadata_filter,
+        )

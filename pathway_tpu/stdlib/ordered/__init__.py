@@ -1,0 +1,24 @@
+"""pw.ordered — order-aware helpers (reference:
+python/pathway/stdlib/ordered/diff.py)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals import thisclass
+from pathway_tpu.internals.desugaring import desugar
+
+
+def diff(table, timestamp, *values, instance=None):
+    """Difference with the previous row in `timestamp` order (reference:
+    stdlib/ordered/diff.py — built on sort's prev pointers)."""
+    mapping = {thisclass.this: table}
+    ts = desugar(timestamp, mapping)
+    sorted_t = table.sort(key=ts, instance=instance)
+    prev_rows = table.ix(sorted_t.prev, optional=True)
+    cols = {}
+    for v in values:
+        ref = desugar(v, mapping)
+        cols[f"diff_{ref.name}"] = ref - prev_rows[ref.name]
+    return table.select(**cols)
+
+
+__all__ = ["diff"]

@@ -1,0 +1,28 @@
+"""pw.stateful (reference: python/pathway/stdlib/stateful/deduplicate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def deduplicate(
+    table,
+    *,
+    value=None,
+    col=None,
+    instance=None,
+    acceptor: Callable[[Any, Any], bool] | None = None,
+    name: str | None = None,
+    persistent_id: str | None = None,
+):
+    """Keep the latest accepted value per instance (reference:
+    stdlib/stateful/deduplicate.py)."""
+    return table.deduplicate(
+        value=value if value is not None else col,
+        instance=instance,
+        acceptor=acceptor,
+        name=name,
+    )
+
+
+__all__ = ["deduplicate"]

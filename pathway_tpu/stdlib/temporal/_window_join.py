@@ -1,0 +1,121 @@
+"""window_join — join rows sharing a window (reference:
+python/pathway/stdlib/temporal/_window_join.py). Composed from window
+assignment + the regular equi-join."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import thisclass
+from pathway_tpu.internals.desugaring import desugar
+from pathway_tpu.internals.expression import ApplyExpression
+from pathway_tpu.internals.joins import JoinMode, JoinResult
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.temporal._window import SlidingWindow, TumblingWindow, Window
+
+
+def _with_windows(table: Table, time_expr, window: Window, prefix: str) -> Table:
+    mapping = {thisclass.this: table}
+    time_e = desugar(time_expr, mapping)
+    if not isinstance(window, (TumblingWindow, SlidingWindow)):
+        raise TypeError("window_join supports tumbling/sliding windows")
+    assign = window.assign
+    assign_expr = ApplyExpression(
+        lambda t: assign(t), dt.ANY_TUPLE, time_e, deterministic=True
+    )
+    with_w = table.with_columns(**{f"{prefix}window": assign_expr})
+    flat = with_w.flatten(with_w[f"{prefix}window"])
+    return flat
+
+
+class WindowJoinResult:
+    def __init__(self, left_flat: Table, right_flat: Table, join_result: JoinResult):
+        self._jr = join_result
+        self._left_flat = left_flat
+        self._right_flat = right_flat
+
+    def select(self, *args, **kwargs) -> Table:
+        return self._jr.select(*args, **kwargs)
+
+
+def window_join(
+    self: Table,
+    other: Table,
+    self_time,
+    other_time,
+    window: Window,
+    *on,
+    how: JoinMode = JoinMode.INNER,
+) -> WindowJoinResult:
+    if isinstance(how, str):
+        how = JoinMode[how.upper()]
+    left_flat = _with_windows(self, self_time, window, "_pw_l")
+    right_flat = _with_windows(other, other_time, window, "_pw_r")
+    conds = [left_flat["_pw_lwindow"] == right_flat["_pw_rwindow"]]
+    mapping = {thisclass.left: left_flat, thisclass.right: right_flat}
+    for cond in on:
+        conds.append(_remap_sides(cond, self, other, left_flat, right_flat))
+    jr = JoinResult(left_flat, right_flat, tuple(conds), mode=how)
+    return WindowJoinResult(left_flat, right_flat, jr)
+
+
+def _remap_sides(cond, left, right, left_flat, right_flat):
+    import copy
+
+    from pathway_tpu.internals.expression import (
+        ColumnExpression,
+        ColumnReference,
+        IdReference,
+        ThisColumnReference,
+    )
+
+    def rec(e):
+        if isinstance(e, ThisColumnReference):
+            if e._this is thisclass.left:
+                return left_flat[e._name]
+            if e._this is thisclass.right:
+                return right_flat[e._name]
+            raise ValueError("window_join conditions use pw.left/pw.right")
+        if isinstance(e, IdReference):
+            return e
+        if isinstance(e, ColumnReference):
+            if e._table is left:
+                return left_flat[e.name]
+            if e._table is right:
+                return right_flat[e.name]
+            return e
+        out = copy.copy(e)
+        for attr, value in list(vars(e).items()):
+            if isinstance(value, ColumnExpression):
+                setattr(out, attr, rec(value))
+            elif isinstance(value, tuple) and any(
+                isinstance(v, ColumnExpression) for v in value
+            ):
+                setattr(
+                    out,
+                    attr,
+                    tuple(
+                        rec(v) if isinstance(v, ColumnExpression) else v
+                        for v in value
+                    ),
+                )
+        return out
+
+    return rec(cond)
+
+
+def window_join_inner(self, other, self_time, other_time, window, *on, **kw):
+    return window_join(self, other, self_time, other_time, window, *on, how=JoinMode.INNER)
+
+
+def window_join_left(self, other, self_time, other_time, window, *on, **kw):
+    return window_join(self, other, self_time, other_time, window, *on, how=JoinMode.LEFT)
+
+
+def window_join_right(self, other, self_time, other_time, window, *on, **kw):
+    return window_join(self, other, self_time, other_time, window, *on, how=JoinMode.RIGHT)
+
+
+def window_join_outer(self, other, self_time, other_time, window, *on, **kw):
+    return window_join(self, other, self_time, other_time, window, *on, how=JoinMode.OUTER)

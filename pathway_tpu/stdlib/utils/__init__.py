@@ -1,0 +1,5 @@
+"""pw.utils (reference: python/pathway/stdlib/utils/)."""
+
+from pathway_tpu.stdlib.utils import col
+
+__all__ = ["col"]

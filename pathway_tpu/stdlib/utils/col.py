@@ -1,0 +1,57 @@
+"""Column utilities (reference: python/pathway/stdlib/utils/col.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Type
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import thisclass
+from pathway_tpu.internals.desugaring import desugar
+from pathway_tpu.internals.schema import Schema
+
+
+def unpack_col(column, *unpacked_columns, schema: Type[Schema] | None = None):
+    """Expand a tuple column into separate columns (reference:
+    utils/col.py unpack_col)."""
+    from pathway_tpu.internals.expression import collect_tables
+
+    tables = list(collect_tables(column, set()))
+    if len(tables) != 1:
+        raise ValueError("unpack_col expects a single-table column")
+    table = tables[0]
+    if schema is not None:
+        names = list(schema.keys())
+    else:
+        names = [
+            c if isinstance(c, str) else c.name for c in unpacked_columns
+        ]
+    cols = {name: column.get(i) for i, name in enumerate(names)}
+    return table.select(**cols)
+
+
+def flatten_column(column, origin_id: str | None = None):
+    from pathway_tpu.internals.expression import collect_tables
+
+    tables = list(collect_tables(column, set()))
+    table = tables[0]
+    return table.flatten(column)
+
+
+def multiapply_all_rows(*cols, fun, result_col_names):
+    raise NotImplementedError("multiapply_all_rows: use batched UDFs instead")
+
+
+def apply_all_rows(*cols, fun, result_col_name):
+    raise NotImplementedError("apply_all_rows: use batched UDFs instead")
+
+
+def groupby_reduce_majority(column, majority_col_name: str = "majority"):
+    from pathway_tpu.internals.expression import collect_tables
+    from pathway_tpu.internals.reducers import reducers
+
+    tables = list(collect_tables(column, set()))
+    table = tables[0]
+    counted = table.groupby(column).reduce(
+        **{majority_col_name: column, "_pw_count": reducers.count()}
+    )
+    return counted

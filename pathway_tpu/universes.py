@@ -1,0 +1,19 @@
+"""pw.universes — key-set promises (reference:
+python/pathway/internals/universes.py)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals.universe import solver
+
+
+def promise_are_equal(*tables) -> None:
+    for a, b in zip(tables, tables[1:]):
+        solver.register_equal(a._universe, b._universe)
+
+
+def promise_is_subset_of(subset, superset) -> None:
+    solver.register_subset(subset._universe, superset._universe)
+
+
+def promise_are_pairwise_disjoint(*tables) -> None:
+    pass

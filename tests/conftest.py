@@ -1,0 +1,22 @@
+import os
+
+# Force a virtual 8-device CPU mesh before jax initializes: multi-chip
+# sharding paths are validated without TPU hardware (the driver dry-runs the
+# real multichip path separately via __graft_entry__.dryrun_multichip).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _clear_parse_graph():
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    yield
+    G.clear()

@@ -1,0 +1,174 @@
+"""Regression tests for the round-1 code-review findings."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import assert_table_equality_wo_index, table_from_markdown
+from pathway_tpu.internals.runner import run_tables
+
+
+def _rows(table, engine=None):
+    (capture,) = run_tables(table, engine=engine)
+    return list(capture.state.rows.values())
+
+
+def test_windowby_tumbling_works():
+    t = table_from_markdown(
+        """
+        t | v
+        1 | 10
+        2 | 20
+        12 | 5
+        """
+    )
+    res = pw.temporal.windowby(
+        t, t.t, window=pw.temporal.tumbling(duration=10)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    rows = set(_rows(res))
+    assert rows == {(0, 10, 30), (10, 20, 5)}
+
+
+def test_windowby_sliding():
+    t = table_from_markdown(
+        """
+        t | v
+        5 | 1
+        """
+    )
+    res = pw.temporal.windowby(
+        t, t.t, window=pw.temporal.sliding(hop=2, duration=4)
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    starts = sorted(r[0] for r in _rows(res))
+    assert starts == [2, 4]
+
+
+def test_concat_nonowner_retraction_ignored():
+    t1 = table_from_markdown(
+        """
+        id | a
+        1  | 10
+        """
+    )
+    t2 = table_from_markdown(
+        """
+        id | a | __time__ | __diff__
+        1  | 99 | 2       | 1
+        1  | 99 | 4       | -1
+        """
+    )
+    result = t1.concat(t2)
+    rows = _rows(result)
+    assert rows == [(10,)]
+
+
+def test_filter_accepts_numpy_bool():
+    t = table_from_markdown(
+        """
+        a
+        1
+        5
+        """
+    )
+    result = t.filter(pw.apply(lambda x: np.bool_(x > 2), t.a))
+    assert [r[0] for r in _rows(result)] == [5]
+
+
+def test_groupby_sort_by_orders_tuples():
+    t = table_from_markdown(
+        """
+        g | s | v
+        a | 3 | 7
+        a | 1 | 8
+        a | 2 | 9
+        """
+    )
+    res = t.groupby(t.g, sort_by=t.s).reduce(tup=pw.reducers.tuple(t.v))
+    assert _rows(res) == [((8, 9, 7),)]
+
+
+def test_join_id_collision_logged_not_silent():
+    from pathway_tpu.engine.engine import Engine
+
+    left = table_from_markdown(
+        """
+        k | a
+        1 | x
+        """
+    )
+    right = table_from_markdown(
+        """
+        k | b
+        1 | 100
+        1 | 200
+        """
+    )
+    joined = left.join(right, left.k == right.k, id=pw.left.id).select(
+        b=pw.right.b
+    )
+    engine = Engine()
+    rows = _rows(joined, engine=engine)
+    assert len(rows) == 1
+    assert any("duplicate row id" in e.message for e in engine.error_log)
+
+
+def test_rename_collision_raises():
+    t = table_from_markdown(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    with pytest.raises(ValueError):
+        t.rename_columns(b=pw.this.a)
+    with pytest.raises(ValueError):
+        t.rename_by_dict({"a": "b"})
+
+
+def test_join_groupby_with_id():
+    left = table_from_markdown(
+        """
+        k | a
+        1 | 1
+        """
+    )
+    right = table_from_markdown(
+        """
+        k | b
+        1 | 10
+        1 | 20
+        """
+    )
+    res = (
+        left.join(right, left.k == right.k)
+        .groupby(pw.left.k, id=pw.left.id)
+        .reduce(total=pw.reducers.sum(pw.right.b))
+    )
+    (capture,) = run_tables(res)
+    (key,) = capture.state.rows.keys()
+    (left_cap,) = run_tables(left)
+    assert key in left_cap.state.rows  # keyed by the left row's id
+    assert list(capture.state.rows.values()) == [(30,)]
+
+
+def test_multi_input_missing_key_gives_none():
+    t1 = table_from_markdown(
+        """
+        id | a
+        1  | 1
+        2  | 2
+        """
+    )
+    t2 = table_from_markdown(
+        """
+        id | b
+        1  | 10
+        """
+    )
+    result = t1.select(a=t1.a, b=t2.b)
+    rows = set(_rows(result))
+    assert rows == {(1, 10), (2, None)}

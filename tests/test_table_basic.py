@@ -1,0 +1,694 @@
+"""Core Table DSL tests (modeled on the reference's test_common.py static
+patterns: markdown table -> transform -> assert_table_equality)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import (
+    assert_table_equality,
+    assert_table_equality_wo_index,
+    table_from_markdown,
+)
+
+
+def test_select_constant_and_arithmetic():
+    t = table_from_markdown(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        """
+    )
+    result = t.select(s=t.a + t.b, d=t.b - t.a, c=10)
+    expected = table_from_markdown(
+        """
+        s | d | c
+        3 | 1 | 10
+        7 | 1 | 10
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_select_with_this():
+    t = table_from_markdown(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    result = t.select(pw.this.a, doubled=pw.this.b * 2)
+    expected = table_from_markdown(
+        """
+        a | doubled
+        1 | 4
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_filter():
+    t = table_from_markdown(
+        """
+        v
+        1
+        2
+        3
+        4
+        """
+    )
+    result = t.filter(pw.this.v > 2)
+    expected = table_from_markdown(
+        """
+        v
+        3
+        4
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_filter_keeps_ids():
+    t = table_from_markdown(
+        """
+        v
+        1
+        2
+        3
+        """
+    )
+    result = t.filter(pw.this.v >= 2).select(w=pw.this.v * 10)
+    # join back onto the original universe by id arithmetic
+    assert_table_equality_wo_index(
+        result,
+        table_from_markdown(
+            """
+            w
+            20
+            30
+            """
+        ),
+    )
+
+
+def test_with_columns_and_rename():
+    t = table_from_markdown(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    result = t.with_columns(c=pw.this.a + pw.this.b)
+    assert result.column_names() == ["a", "b", "c"]
+    renamed = result.rename_columns(total=pw.this.c)
+    assert set(renamed.column_names()) == {"a", "b", "total"}
+
+    by_dict = result.rename_by_dict({"a": "x"})
+    assert set(by_dict.column_names()) == {"x", "b", "c"}
+
+
+def test_without():
+    t = table_from_markdown(
+        """
+        a | b | c
+        1 | 2 | 3
+        """
+    )
+    result = t.without(pw.this.b)
+    assert result.column_names() == ["a", "c"]
+
+
+def test_boolean_ops_and_comparisons():
+    t = table_from_markdown(
+        """
+        a | b
+        1 | 2
+        2 | 2
+        3 | 2
+        """
+    )
+    result = t.select(
+        eq=t.a == t.b,
+        both=(t.a >= 2) & (t.b >= 2),
+        either=(t.a > 2) | (t.b > 2),
+        inv=~(t.a == t.b),
+    )
+    expected = table_from_markdown(
+        """
+        eq    | both  | either | inv
+        False | False | False  | True
+        True  | True  | False  | False
+        False | True  | True   | True
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_if_else_lazy_guard():
+    t = table_from_markdown(
+        """
+        n | d
+        6 | 2
+        5 | 0
+        """
+    )
+    result = t.select(q=pw.if_else(t.d != 0, t.n // t.d, -1))
+    expected = table_from_markdown(
+        """
+        q
+        3
+        -1
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_division_by_zero_produces_error_row():
+    t = table_from_markdown(
+        """
+        n | d
+        6 | 2
+        5 | 0
+        """
+    )
+    result = t.select(q=pw.fill_error(t.n // t.d, -99))
+    expected = table_from_markdown(
+        """
+        q
+        3
+        -99
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_apply():
+    t = table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+
+    def fmt(x: int) -> str:
+        return f"x{x}"
+
+    result = t.select(s=pw.apply(fmt, t.a))
+    expected = table_from_markdown(
+        """
+        s
+        x1
+        x2
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_udf_decorator_sync():
+    t = table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+
+    @pw.udf
+    def inc(x: int) -> int:
+        return x + 1
+
+    result = t.select(b=inc(t.a))
+    expected = table_from_markdown(
+        """
+        b
+        2
+        3
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_udf_async():
+    t = table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+
+    @pw.udf
+    async def double(x: int) -> int:
+        return x * 2
+
+    result = t.select(b=double(t.a))
+    expected = table_from_markdown(
+        """
+        b
+        2
+        4
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_coalesce_require():
+    t = table_from_markdown(
+        """
+        a | b
+        1 | 2
+          | 5
+        """
+    )
+    result = t.select(c=pw.coalesce(t.a, t.b), r=pw.require(t.b * 10, t.a))
+    rows = sorted(
+        r
+        for r in _rows(result)
+    )
+    assert rows == [(1, 20), (5, None)]
+
+
+def test_str_namespace():
+    t = table_from_markdown(
+        """
+        s
+        Hello
+        World
+        """
+    )
+    result = t.select(
+        lower=t.s.str.lower(),
+        n=t.s.str.len(),
+        swapped=t.s.str.swapcase(),
+        starts=t.s.str.startswith("He"),
+    )
+    rows = set(_rows(result))
+    assert rows == {
+        ("hello", 5, "hELLO", True),
+        ("world", 5, "wORLD", False),
+    }
+
+
+def test_num_namespace():
+    t = table_from_markdown(
+        """
+        x
+        -2
+        3
+        """
+    )
+    result = t.select(a=t.x.num.abs())
+    assert sorted(r[0] for r in _rows(result)) == [2, 3]
+
+
+def test_concat():
+    t1 = table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+    t2 = table_from_markdown(
+        """
+        a
+        2
+        """
+    )
+    result = t1.concat_reindex(t2)
+    expected = table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_update_cells():
+    t = table_from_markdown(
+        """
+        a | b
+        1 | 10
+        2 | 20
+        """
+    )
+    upd = t.filter(t.a == 1).select(b=t.b + 5)
+    result = t.update_cells(upd)
+    expected = table_from_markdown(
+        """
+        a | b
+        1 | 15
+        2 | 20
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_cast_and_to_string():
+    t = table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+    result = t.select(f=pw.cast(float, t.a), s=t.a.to_string())
+    rows = set(_rows(result))
+    assert rows == {(1.0, "1"), (2.0, "2")}
+
+
+def test_make_tuple_and_get():
+    t = table_from_markdown(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    result = t.select(p=pw.make_tuple(t.a, t.b))
+    result2 = result.select(first=result.p[0], second=result.p.get(5, -1))
+    rows = list(_rows(result2))
+    assert rows == [(1, -1)]
+
+
+def test_schema_class():
+    class MySchema(pw.Schema):
+        a: int
+        b: str = pw.column_definition(primary_key=True)
+
+    assert MySchema.column_names() == ["a", "b"]
+    assert MySchema.primary_key_columns() == ["b"]
+
+    t = table_from_markdown(
+        """
+        a | b
+        1 | x
+        """,
+        schema=MySchema,
+    )
+    pw.assert_table_has_schema(t, MySchema)
+
+
+def test_groupby_reduce_sum_count():
+    t = table_from_markdown(
+        """
+        k | v
+        a | 1
+        a | 2
+        b | 5
+        """
+    )
+    result = t.groupby(t.k).reduce(
+        t.k,
+        total=pw.reducers.sum(t.v),
+        n=pw.reducers.count(),
+        avg=pw.reducers.avg(t.v),
+    )
+    expected = table_from_markdown(
+        """
+        k | total | n | avg
+        a | 3     | 2 | 1.5
+        b | 5     | 1 | 5.0
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_groupby_min_max_argmin_tuple():
+    t = table_from_markdown(
+        """
+        k | v
+        a | 3
+        a | 1
+        b | 7
+        """
+    )
+    result = t.groupby(t.k).reduce(
+        t.k,
+        lo=pw.reducers.min(t.v),
+        hi=pw.reducers.max(t.v),
+        vs=pw.reducers.sorted_tuple(t.v),
+    )
+    rows = {r[0]: r[1:] for r in _rows(result)}
+    assert rows == {"a": (1, 3, (1, 3)), "b": (7, 7, (7,))}
+
+
+def test_global_reduce():
+    t = table_from_markdown(
+        """
+        v
+        1
+        2
+        3
+        """
+    )
+    result = t.reduce(total=pw.reducers.sum(t.v))
+    assert list(_rows(result)) == [(6,)]
+
+
+def test_join_inner():
+    left = table_from_markdown(
+        """
+        k | a
+        1 | x
+        2 | y
+        3 | z
+        """
+    )
+    right = table_from_markdown(
+        """
+        k | b
+        1 | 10
+        2 | 20
+        4 | 40
+        """
+    )
+    result = left.join(right, left.k == right.k).select(
+        left.a, right.b, k=pw.left.k
+    )
+    expected = table_from_markdown(
+        """
+        a | b  | k
+        x | 10 | 1
+        y | 20 | 2
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_join_left_outer():
+    left = table_from_markdown(
+        """
+        k | a
+        1 | x
+        3 | z
+        """
+    )
+    right = table_from_markdown(
+        """
+        k | b
+        1 | 10
+        """
+    )
+    result = left.join_left(right, left.k == right.k).select(
+        left.a, b=pw.coalesce(right.b, -1)
+    )
+    expected = table_from_markdown(
+        """
+        a | b
+        x | 10
+        z | -1
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_join_reduce():
+    left = table_from_markdown(
+        """
+        k | a
+        1 | 1
+        2 | 2
+        """
+    )
+    right = table_from_markdown(
+        """
+        k | b
+        1 | 10
+        1 | 20
+        2 | 5
+        """
+    )
+    result = (
+        left.join(right, left.k == right.k)
+        .groupby(pw.left.k)
+        .reduce(k=pw.left.k, total=pw.reducers.sum(pw.right.b))
+    )
+    expected = table_from_markdown(
+        """
+        k | total
+        1 | 30
+        2 | 5
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_ix():
+    target = table_from_markdown(
+        """
+        id | price
+        1  | 100
+        2  | 200
+        """
+    )
+    orders = table_from_markdown(
+        """
+        pid
+        1
+        2
+        2
+        """
+    )
+    keyed = orders.select(ptr=target.pointer_from(orders.pid))
+    looked = target.ix(keyed.ptr)
+    result = orders.select(price=looked.price)
+    expected = table_from_markdown(
+        """
+        price
+        100
+        200
+        200
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_flatten():
+    t = table_from_markdown(
+        """
+        k
+        a
+        b
+        """
+    ).select(k=pw.this.k, parts=pw.apply_with_type(lambda s: (s, s + "!"), tuple, pw.this.k))
+    flat = t.flatten(t.parts)
+    expected = table_from_markdown(
+        """
+        k | parts
+        a | a
+        a | a!
+        b | b
+        b | b!
+        """
+    )
+    assert_table_equality_wo_index(flat, expected)
+
+
+def test_sort_prev_next():
+    t = table_from_markdown(
+        """
+        v
+        30
+        10
+        20
+        """
+    )
+    sorted_t = t.sort(key=t.v)
+    prev_vals = t.ix(sorted_t.prev, optional=True)
+    result = t.select(v=t.v, prev_v=prev_vals.v)
+    rows = set(_rows(result))
+    assert rows == {(10, None), (20, 10), (30, 20)}
+
+
+def test_difference_intersect():
+    t1 = table_from_markdown(
+        """
+        id | v
+        1  | 1
+        2  | 2
+        3  | 3
+        """
+    )
+    t2 = table_from_markdown(
+        """
+        id | w
+        2  | 0
+        3  | 0
+        """
+    )
+    assert sorted(r[0] for r in _rows(t1.intersect(t2))) == [2, 3]
+    assert sorted(r[0] for r in _rows(t1.difference(t2))) == [1]
+
+
+def test_update_rows():
+    t1 = table_from_markdown(
+        """
+        id | v
+        1  | 1
+        2  | 2
+        """
+    )
+    t2 = table_from_markdown(
+        """
+        id | v
+        2  | 20
+        3  | 30
+        """
+    )
+    result = t1.update_rows(t2)
+    expected = table_from_markdown(
+        """
+        id | v
+        1  | 1
+        2  | 20
+        3  | 30
+        """
+    )
+    assert_table_equality(result, expected)
+
+
+def test_iterate_collatz():
+    def collatz_step(t):
+        return t.select(
+            a=pw.if_else(
+                t.a == 1,
+                1,
+                pw.if_else(t.a % 2 == 0, t.a // 2, 3 * t.a + 1),
+            )
+        )
+
+    t = table_from_markdown(
+        """
+        a
+        3
+        5
+        1
+        """
+    )
+    result = pw.iterate(collatz_step, t=t)
+    assert [r[0] for r in _rows(result)] == [1, 1, 1]
+
+
+def test_deduplicate():
+    t = table_from_markdown(
+        """
+        v
+        1
+        2
+        3
+        2
+        """
+    )
+    result = t.deduplicate(
+        value=pw.this.v, acceptor=lambda new, old: new > old
+    )
+    assert [r[0] for r in _rows(result)] == [3]
+
+
+def _rows(table):
+    from pathway_tpu.internals.runner import run_tables
+
+    (capture,) = run_tables(table)
+    return list(capture.state.rows.values())
