@@ -163,7 +163,9 @@ class _QueueSink:
 
     def push_row(self, row: dict, diff: int = 1) -> None:
         values = tuple(row.get(c) for c in self.names)
-        if self.pk:
+        if "_pw_key" in row:
+            key = row["_pw_key"]
+        elif self.pk:
             key = ref_scalar(*(row.get(c) for c in self.pk))
         else:
             self._counter += 1

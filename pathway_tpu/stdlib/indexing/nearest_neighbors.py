@@ -108,6 +108,8 @@ class BruteForceKnn(InnerIndex):
         return query_column
 
     def _data_preprocess(self, data_column):
+        if self.embedder is not None:
+            return self.embedder(data_column)
         return data_column
 
 

@@ -1,0 +1,5 @@
+"""Extension packs (reference: python/pathway/xpacks)."""
+
+from pathway_tpu.xpacks import llm
+
+__all__ = ["llm"]

@@ -1,0 +1,36 @@
+"""LLM xpack: embedders, chats, splitters, parsers, rerankers, document
+store, vector store, RAG question answering, servers (reference:
+python/pathway/xpacks/llm/)."""
+
+from pathway_tpu.xpacks.llm import (
+    embedders,
+    llms,
+    parsers,
+    prompts,
+    rerankers,
+    splitters,
+)
+
+__all__ = [
+    "embedders",
+    "llms",
+    "parsers",
+    "prompts",
+    "rerankers",
+    "splitters",
+]
+
+
+def __getattr__(name):
+    import importlib
+
+    known = {
+        "document_store",
+        "vector_store",
+        "question_answering",
+        "servers",
+        "mcp_server",
+    }
+    if name in known:
+        return importlib.import_module(f"pathway_tpu.xpacks.llm.{name}")
+    raise AttributeError(name)

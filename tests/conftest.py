@@ -1,14 +1,20 @@
 import os
 
-# Force a virtual 8-device CPU mesh before jax initializes: multi-chip
-# sharding paths are validated without TPU hardware (the driver dry-runs the
-# real multichip path separately via __graft_entry__.dryrun_multichip).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force a virtual 8-device CPU mesh before jax initializes its backends:
+# multi-chip sharding paths are validated without TPU hardware (the driver
+# dry-runs the real multichip path separately via
+# __graft_entry__.dryrun_multichip). NOTE: this environment pins
+# jax_platforms to the axon TPU plugin at import, so the env var alone is
+# not enough — the config update below is what actually wins.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

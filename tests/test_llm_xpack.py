@@ -1,0 +1,303 @@
+"""LLM xpack tests with mocks — no network, no real models needed
+(mirrors the reference pattern: xpacks/llm/tests/mocks.py fake chat +
+fake_embeddings_model returning [1,1,0]-style vectors; servers tested
+in-process by calling endpoint handler tables directly)."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.value import Json
+from pathway_tpu.internals.runner import run_tables
+from pathway_tpu.internals.udfs import UDF
+from pathway_tpu.stdlib.indexing.nearest_neighbors import BruteForceKnnFactory
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.question_answering import (
+    AdaptiveRAGQuestionAnswerer,
+    BaseRAGQuestionAnswerer,
+    answer_with_geometric_rag_strategy,
+)
+from pathway_tpu.xpacks.llm.splitters import (
+    NullSplitter,
+    RecursiveSplitter,
+    TokenCountSplitter,
+)
+
+
+class FakeEmbedder(UDF):
+    """Characteristic one-hot-ish embeddings so KNN results are exact."""
+
+    def __init__(self):
+        super().__init__(return_type=np.ndarray, deterministic=True)
+
+        def embed(text: str) -> np.ndarray:
+            import hashlib
+
+            first = text.split()[0] if text.split() else ""
+            bucket = hashlib.blake2b(first.encode(), digest_size=2).digest()
+            v = np.zeros(8, dtype=np.float32)
+            v[int.from_bytes(bucket, "little") % 8] = 1.0
+            v[0] += 0.01  # break exact ties deterministically
+            return v
+
+        self.func = embed
+
+    def get_embedding_dimension(self) -> int:
+        return 8
+
+
+class FakeChatModel(UDF):
+    def __init__(self, reply_fn=None):
+        super().__init__(return_type=str, deterministic=True)
+        reply_fn = reply_fn or (lambda messages: "the answer is 42")
+
+        def chat(messages) -> str:
+            return reply_fn(messages)
+
+        self.func = chat
+
+
+def _docs_table():
+    return pw.debug.table_from_markdown(
+        """
+        data
+        apple pie recipe
+        banana bread recipe
+        cherry cake recipe
+        """
+    ).select(
+        data=pw.this.data,
+        _metadata=pw.apply_with_type(
+            lambda d: Json({"path": f"/docs/{d.split()[0]}.txt", "modified_at": 1}),
+            Json,
+            pw.this.data,
+        ),
+    )
+
+
+def _store(embedder=None):
+    embedder = embedder or FakeEmbedder()
+    factory = BruteForceKnnFactory(
+        dimensions=embedder.get_embedding_dimension(), embedder=embedder
+    )
+    return DocumentStore(_docs_table(), retriever_factory=factory)
+
+
+def _retrieve(store, query, k=2, globpattern=None):
+    queries = pw.debug.table_from_rows(
+        DocumentStore.RetrieveQuerySchema,
+        [(query, k, None, globpattern)],
+    )
+    result = store.retrieve_query(queries)
+    (capture,) = run_tables(result)
+    (row,) = capture.state.rows.values()
+    return row[0].value
+
+
+def test_document_store_retrieve():
+    store = _store()
+    results = _retrieve(store, "apple tart", k=1)
+    assert len(results) == 1
+    assert results[0]["text"] == "apple pie recipe"
+    assert "score" in results[0]
+
+
+def test_document_store_glob_filter():
+    store = _store()
+    results = _retrieve(store, "apple tart", k=3, globpattern="/docs/banana*")
+    texts = [r["text"] for r in results]
+    assert texts == ["banana bread recipe"]
+
+
+def test_document_store_statistics():
+    store = _store()
+    queries = pw.debug.table_from_rows(DocumentStore.StatisticsQuerySchema, [()])
+    result = store.statistics_query(queries)
+    (capture,) = run_tables(result)
+    (row,) = capture.state.rows.values()
+    stats = row[0].value
+    assert stats["file_count"] == 3
+    assert stats["last_modified"] == 1
+
+
+def test_document_store_inputs():
+    store = _store()
+    queries = pw.debug.table_from_rows(
+        DocumentStore.InputsQuerySchema, [(None, None)]
+    )
+    result = store.inputs_query(queries)
+    (capture,) = run_tables(result)
+    (row,) = capture.state.rows.values()
+    inputs = row[0].value
+    assert len(inputs) == 3
+    assert {i["path"] for i in inputs} == {
+        "/docs/apple.txt",
+        "/docs/banana.txt",
+        "/docs/cherry.txt",
+    }
+
+
+def test_rag_answer_query():
+    store = _store()
+    rag = BaseRAGQuestionAnswerer(FakeChatModel(), store)
+    queries = pw.debug.table_from_rows(
+        BaseRAGQuestionAnswerer.AnswerQuerySchema,
+        [("what is in the apple pie?", None, None, None, None, True)],
+    )
+    result = rag.answer_query(queries)
+    (capture,) = run_tables(result)
+    (row,) = capture.state.rows.values()
+    packed = row[0].value
+    assert packed["response"] == "the answer is 42"
+    assert len(packed["context_docs"]) >= 1
+
+
+def test_adaptive_rag_escalates():
+    calls = []
+
+    def reply(messages):
+        prompt = messages[0]["content"] if isinstance(messages, list) else str(messages)
+        calls.append(prompt)
+        # only answer once enough docs are provided
+        if prompt.count("recipe") >= 2:
+            return "plenty of fruit"
+        return "No information found."
+
+    store = _store()
+    rag = AdaptiveRAGQuestionAnswerer(
+        FakeChatModel(reply),
+        store,
+        n_starting_documents=1,
+        factor=2,
+        max_iterations=3,
+    )
+    queries = pw.debug.table_from_rows(
+        BaseRAGQuestionAnswerer.AnswerQuerySchema,
+        [("fruit?", None, None, None, None, False)],
+    )
+    result = rag.answer_query(queries)
+    (capture,) = run_tables(result)
+    (row,) = capture.state.rows.values()
+    assert row[0].value["response"] == "plenty of fruit"
+    assert len(calls) == 2  # escalated once
+
+
+def test_geometric_strategy_function():
+    class M:
+        def func(self, messages):
+            if "doc2" in messages[0]["content"]:
+                return "found"
+            return "No information found."
+
+    answers = answer_with_geometric_rag_strategy(
+        ["q"], [["doc1", "doc2", "doc3"]], M(), n_starting_documents=1, factor=2
+    )
+    assert answers == ["found"]
+
+
+def test_token_count_splitter():
+    s = TokenCountSplitter(min_tokens=2, max_tokens=4)
+    chunks = s.func("one two three four five six seven", Json({"k": "v"}))
+    assert all(isinstance(c, tuple) for c in chunks)
+    texts = [c[0] for c in chunks]
+    assert " ".join(texts) == "one two three four five six seven"
+    assert all(c[1] == {"k": "v"} for c in chunks)
+
+
+def test_recursive_splitter():
+    s = RecursiveSplitter(chunk_size=20)
+    chunks = s.func("aaa bbb. ccc ddd. eee fff. ggg hhh.", Json({}))
+    assert len(chunks) >= 2
+    assert all(len(c[0]) <= 20 for c in chunks)
+
+
+def test_null_splitter():
+    s = NullSplitter()
+    assert s.func("hello", Json({})) == [("hello", {})]
+
+
+def test_sentence_transformer_embedder_shape():
+    from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+
+    emb = SentenceTransformerEmbedder()
+    assert emb.get_embedding_dimension() == 384
+    vecs = emb.func(["hello world", "goodbye"])
+    assert len(vecs) == 2
+    assert vecs[0].shape == (384,)
+    # deterministic
+    again = emb.func(["hello world"])[0]
+    assert np.allclose(vecs[0], again, atol=1e-5)
+    # L2 normalized
+    assert abs(np.linalg.norm(vecs[0]) - 1.0) < 1e-3
+
+
+def test_cross_encoder_reranker_batch():
+    from pathway_tpu.xpacks.llm.rerankers import CrossEncoderReranker
+
+    rr = CrossEncoderReranker()
+    scores = rr.func(
+        ["doc one text", "doc two text"], ["query", "query"]
+    )
+    assert len(scores) == 2
+    assert all(isinstance(s, float) for s in scores)
+
+
+def test_rerank_topk_filter():
+    from pathway_tpu.xpacks.llm.rerankers import rerank_topk_filter
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(docs=tuple, scores=tuple),
+        [((("a", "b", "c"), (1.0, 3.0, 2.0)))],
+    )
+    # rows: docs tuple + scores tuple in one row
+    t2 = t.select(kept=rerank_topk_filter(pw.this.docs, pw.this.scores, 2))
+    (capture,) = run_tables(t2)
+    (row,) = capture.state.rows.values()
+    assert row[0] == (("b", "c"), (3.0, 2.0))
+
+
+def test_hf_pipeline_chat_generates():
+    from pathway_tpu.xpacks.llm.llms import HFPipelineChat
+
+    chat = HFPipelineChat(model="tiny-decoder", max_new_tokens=4)
+    out = chat.func([[{"role": "user", "content": "hello"}]])
+    assert len(out) == 1
+    assert isinstance(out[0], str)
+
+
+def test_bm25_and_hybrid():
+    from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25Factory
+    from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndexFactory
+
+    docs = _docs_table().select(
+        text=pw.apply_with_type(
+            lambda b: b if isinstance(b, str) else b.decode(), str, pw.this.data
+        ),
+        _metadata=pw.this._metadata,
+    )
+    bm25 = TantivyBM25Factory()
+    index = bm25.build_index(docs.text, docs, metadata_column=docs._metadata)
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(q=str), [("banana bread",)]
+    )
+    res = index.query_as_of_now(queries.q, number_of_matches=1).select(
+        m=pw.this.text
+    )
+    (capture,) = run_tables(res)
+    (row,) = capture.state.rows.values()
+    assert row[0] == ("banana bread recipe",)
+
+    emb = FakeEmbedder()
+    hybrid = HybridIndexFactory(
+        [
+            TantivyBM25Factory(),
+            BruteForceKnnFactory(dimensions=8, embedder=emb),
+        ]
+    )
+    h_index = hybrid.build_index(docs.text, docs, metadata_column=docs._metadata)
+    res2 = h_index.query_as_of_now(queries.q, number_of_matches=2).select(
+        m=pw.this.text
+    )
+    (capture2,) = run_tables(res2)
+    (row2,) = capture2.state.rows.values()
+    assert "banana bread recipe" in row2[0]
