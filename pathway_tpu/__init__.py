@@ -69,6 +69,7 @@ from pathway_tpu.internals.schema import (
     schema_from_pandas,
     schema_from_types,
 )
+from pathway_tpu.internals.async_transformer import AsyncTransformer
 from pathway_tpu.internals.table import Table, TableSlice
 from pathway_tpu.internals.thisclass import left, right, this
 from pathway_tpu.engine.value import (
@@ -128,6 +129,19 @@ def __getattr__(name):
         from pathway_tpu.internals.sql import sql as s
 
         return s
+    if name == "graphs":
+        from pathway_tpu.stdlib import graphs as g
+
+        return g
+    if name == "MonitoringLevel":
+        from pathway_tpu.internals.monitoring import MonitoringLevel as m
+
+        return m
+    if name == "load_yaml":
+        # lazy: keeps PyYAML an optional dependency
+        from pathway_tpu.internals.yaml_loader import load_yaml as ly
+
+        return ly
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

@@ -1,0 +1,87 @@
+"""Command-line interface (reference: python/pathway/cli.py —
+`pathway spawn` multi-process launcher :53-205, `replay` :265,
+`spawn-from-env` :297)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def _spawn(args) -> int:
+    """Launch a program across N processes with worker env vars set
+    (reference: cli.py spawn — PATHWAY_PROCESSES/PROCESS_ID/FIRST_PORT)."""
+    env_base = dict(os.environ)
+    env_base["PATHWAY_THREADS"] = str(args.threads)
+    env_base["PATHWAY_PROCESSES"] = str(args.processes)
+    env_base["PATHWAY_FIRST_PORT"] = str(args.first_port)
+    if args.record:
+        env_base["PATHWAY_REPLAY_STORAGE"] = args.record_path
+        env_base["PATHWAY_REPLAY_MODE"] = "record"
+    program = list(args.program)
+    if program and program[0] == "--":
+        program = program[1:]
+    if program and program[0].endswith(".py"):
+        program = [sys.executable, *program]
+    procs = []
+    for pid in range(args.processes):
+        env = dict(env_base)
+        env["PATHWAY_PROCESS_ID"] = str(pid)
+        procs.append(subprocess.Popen(program, env=env))
+    code = 0
+    for p in procs:
+        code = p.wait() or code
+    return code
+
+
+def _replay(args) -> int:
+    env = dict(os.environ)
+    env["PATHWAY_REPLAY_STORAGE"] = args.record_path
+    env["PATHWAY_REPLAY_MODE"] = args.mode
+    program = list(args.program)
+    if program and program[0] == "--":
+        program = program[1:]
+    if program and program[0].endswith(".py"):
+        program = [sys.executable, *program]
+    return subprocess.call(program, env=env)
+
+
+def _spawn_from_env(args) -> int:
+    spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS", "")
+    argv = spawn_args.split() + list(args.program)
+    return main(["spawn", *argv])
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="pathway")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    spawn = sub.add_parser("spawn", help="run a program on multiple workers")
+    spawn.add_argument("--threads", "-t", type=int, default=1)
+    spawn.add_argument("--processes", "-n", type=int, default=1)
+    spawn.add_argument("--first-port", type=int, default=10000)
+    spawn.add_argument("--record", action="store_true")
+    spawn.add_argument("--record-path", default="record")
+    spawn.add_argument("program", nargs=argparse.REMAINDER)
+    spawn.set_defaults(func=_spawn)
+
+    replay = sub.add_parser("replay", help="replay recorded inputs")
+    replay.add_argument("--record-path", default="record")
+    replay.add_argument(
+        "--mode", choices=["batch", "speedrun"], default="batch"
+    )
+    replay.add_argument("program", nargs=argparse.REMAINDER)
+    replay.set_defaults(func=_replay)
+
+    sfe = sub.add_parser("spawn-from-env")
+    sfe.add_argument("program", nargs=argparse.REMAINDER)
+    sfe.set_defaults(func=_spawn_from_env)
+
+    args = parser.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,6 +84,10 @@ class Node:
     def on_time_end(self, time: int) -> None:
         pass
 
+    def on_flush(self) -> None:
+        """End-of-stream flush hook: runs (and drains) BEFORE on_end, so
+        buffered rows reach the sinks before their completion callbacks."""
+
     def on_end(self) -> None:
         pass
 
@@ -146,18 +150,19 @@ class Engine:
             self.process_time(t)
         self.finish()
 
+    def _drain(self) -> None:
+        for _ in range(len(self.nodes) + 1):
+            if not any(n.has_pending() for n in self.nodes):
+                break
+            self.process_time(self.current_time + 1)
+
     def finish(self) -> None:
         for node in self.nodes:
+            node.on_flush()
+        self._drain()
+        for node in self.nodes:
             node.on_end()
-        # on_end may emit flush deltas (e.g. buffers at end-of-stream);
-        # process them at a final time
-        if any(n.has_pending() for n in self.nodes):
-            self.process_time(self.current_time + 1)
-            # one more drain round for cascading flushes
-            for _ in range(len(self.nodes)):
-                if not any(n.has_pending() for n in self.nodes):
-                    break
-                self.process_time(self.current_time + 1)
+        self._drain()
 
 
 # ---------------------------------------------------------------------------

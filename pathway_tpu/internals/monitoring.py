@@ -1,0 +1,145 @@
+"""Monitoring: probe stats, console dashboard, Prometheus endpoint.
+
+TPU-native rebuild of the reference observability stack (reference:
+python/pathway/internals/monitoring.py StatsMonitor:186 (rich dashboard),
+src/engine/dataflow/monitoring.rs ProberStats, src/engine/http_server.rs:22
+(Prometheus per worker on port 20000+process_id))."""
+
+from __future__ import annotations
+
+import enum
+import http.server
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+class MonitoringLevel(enum.Enum):
+    AUTO = "auto"
+    NONE = "none"
+    IN_OUT = "in_out"
+    ALL = "all"
+
+
+@dataclass
+class ProberStats:
+    """reference: dataflow/monitoring.rs ProberStats."""
+
+    rows_processed: int = 0
+    batches_processed: int = 0
+    current_time: int = 0
+    input_latency_ms: float | None = None
+    started_at: float = field(default_factory=time.time)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "rows_processed": self.rows_processed,
+            "batches_processed": self.batches_processed,
+            "current_time": self.current_time,
+            "uptime_s": round(time.time() - self.started_at, 1),
+        }
+
+
+class StatsMonitor:
+    """Console dashboard over engine stats (reference: monitoring.py
+    StatsMonitor:186 — rich Live table)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.stats = ProberStats()
+        self._live = None
+
+    def refresh(self) -> None:
+        self.stats.rows_processed = self.engine.stats_rows
+        self.stats.current_time = self.engine.current_time
+
+    def render(self):
+        from rich.table import Table as RichTable
+
+        self.refresh()
+        table = RichTable(title="pathway_tpu")
+        table.add_column("metric")
+        table.add_column("value")
+        for k, v in self.stats.snapshot().items():
+            table.add_row(k, str(v))
+        return table
+
+    def start_live(self, refresh_per_second: float = 2.0):
+        from rich.live import Live
+
+        self._live = Live(
+            self.render(), refresh_per_second=refresh_per_second
+        )
+        self._live.start()
+
+        def updater():
+            while self._live is not None:
+                try:
+                    self._live.update(self.render())
+                except Exception:  # noqa: BLE001
+                    break
+                time.sleep(1.0 / refresh_per_second)
+
+        threading.Thread(target=updater, daemon=True).start()
+        return self._live
+
+    def stop(self):
+        if self._live is not None:
+            self._live.stop()
+            self._live = None
+
+
+class PrometheusServer:
+    """OpenMetrics endpoint per worker, port 20000+process_id (reference:
+    src/engine/http_server.rs:22)."""
+
+    def __init__(self, engine, process_id: int = 0, port: int | None = None):
+        self.engine = engine
+        self.port = port if port is not None else 20000 + process_id
+        self._httpd = None
+
+    def metrics_text(self) -> str:
+        e = self.engine
+        lines = [
+            "# TYPE pathway_rows_processed counter",
+            f"pathway_rows_processed {e.stats_rows}",
+            "# TYPE pathway_engine_time gauge",
+            f"pathway_engine_time {e.current_time}",
+            "# TYPE pathway_error_count counter",
+            f"pathway_error_count {len(e.error_log)}",
+        ]
+        return "\n".join(lines) + "\n"
+
+    def start(self) -> None:
+        monitor = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path not in ("/metrics", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = monitor.metrics_text().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", self.port), Handler
+        )
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        ).start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None

@@ -55,6 +55,7 @@ def run(
     debug: bool = False,
     monitoring_level=None,
     with_http_server: bool = False,
+    persistence_config=None,
     **kwargs,
 ) -> None:
     """pw.run — execute every registered sink (reference:
@@ -66,7 +67,7 @@ def run(
         sink.attach(ctx, nodes)
     _attach_monitoring(engine)
     if G.sources:
-        _run_streaming(engine, ctx)
+        _run_streaming(engine, ctx, persistence_config)
     else:
         engine.run_static()
 
@@ -86,10 +87,14 @@ def _attach_monitoring(engine: Engine) -> None:
     engine.on_error = on_error
 
 
-def _run_streaming(engine: Engine, ctx: RunContext) -> None:
+def _run_streaming(
+    engine: Engine, ctx: RunContext, persistence_config=None
+) -> None:
     """Drive streaming sources: start connector threads, advance engine time
     as batches arrive (reference: Connector::run, src/connectors/mod.rs:523)."""
     from pathway_tpu.io._connector_runtime import StreamingDriver
 
-    driver = StreamingDriver(engine, ctx)
+    driver = StreamingDriver(
+        engine, ctx, persistence_config=persistence_config
+    )
     driver.run(G.sources)

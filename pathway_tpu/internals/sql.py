@@ -1,0 +1,435 @@
+"""pw.sql — SQL queries over tables (reference:
+python/pathway/internals/sql/processing.py; sqlglot there, a self-contained
+recursive-descent translator here).
+
+Supported: SELECT projections/expressions with aliases, WHERE, GROUP BY +
+HAVING, aggregate functions (SUM/COUNT/MIN/MAX/AVG), INNER/LEFT JOIN ... ON,
+UNION ALL. Example::
+
+    result = pw.sql("SELECT k, SUM(v) AS total FROM t GROUP BY k", t=t)
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from pathway_tpu.internals import reducers as red
+from pathway_tpu.internals.api import if_else
+from pathway_tpu.internals.expression import (
+    BinaryOpExpression,
+    ColumnConstExpression,
+    ColumnExpression,
+    IsNoneExpression,
+    UnaryOpExpression,
+)
+from pathway_tpu.internals.table import Table
+
+_TOKEN = re.compile(
+    r"\s*(?:(?P<num>\d+\.\d*|\d+)|(?P<str>'[^']*')|(?P<op><>|!=|<=|>=|=|<|>|"
+    r"\(|\)|,|\*|\+|-|/|%|\.)|(?P<word>[A-Za-z_][A-Za-z_0-9]*))"
+)
+
+_KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "as", "join",
+    "inner", "left", "right", "outer", "on", "and", "or", "not", "union",
+    "all", "order", "asc", "desc", "limit", "is", "null", "case", "when",
+    "then", "else", "end", "like", "in", "distinct",
+}
+
+_AGGREGATES = {
+    "sum": red.sum_,
+    "count": red.count,
+    "min": red.min_,
+    "max": red.max_,
+    "avg": red.avg,
+}
+
+
+class _Tokens:
+    def __init__(self, text: str):
+        self.tokens: List[Tuple[str, str]] = []
+        pos = 0
+        while pos < len(text):
+            m = _TOKEN.match(text, pos)
+            if m is None:
+                if text[pos:].strip():
+                    raise ValueError(f"cannot tokenize SQL near {text[pos:pos+20]!r}")
+                break
+            pos = m.end()
+            if m.group("num"):
+                self.tokens.append(("num", m.group("num")))
+            elif m.group("str"):
+                self.tokens.append(("str", m.group("str")[1:-1]))
+            elif m.group("op"):
+                self.tokens.append(("op", m.group("op")))
+            elif m.group("word"):
+                word = m.group("word")
+                kind = "kw" if word.lower() in _KEYWORDS else "ident"
+                self.tokens.append((kind, word.lower() if kind == "kw" else word))
+        self.pos = 0
+
+    def peek(self) -> Tuple[str, str] | None:
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def next(self) -> Tuple[str, str]:
+        tok = self.peek()
+        if tok is None:
+            raise ValueError("unexpected end of SQL")
+        self.pos += 1
+        return tok
+
+    def accept(self, kind: str, value: str | None = None) -> bool:
+        tok = self.peek()
+        if tok and tok[0] == kind and (value is None or tok[1] == value):
+            self.pos += 1
+            return True
+        return False
+
+    def expect(self, kind: str, value: str | None = None) -> str:
+        tok = self.next()
+        if tok[0] != kind or (value is not None and tok[1] != value):
+            raise ValueError(f"expected {value or kind}, got {tok}")
+        return tok[1]
+
+
+class _SqlTranslator:
+    def __init__(self, tables: Dict[str, Table]):
+        self.tables = tables
+
+    def query(self, tk: _Tokens) -> Table:
+        result = self.select_statement(tk)
+        while tk.accept("kw", "union"):
+            tk.accept("kw", "all")
+            other = self.select_statement(tk)
+            result = result.concat_reindex(other)
+        return result
+
+    def select_statement(self, tk: _Tokens) -> Table:
+        tk.expect("kw", "select")
+        projections: List[Tuple[Optional[str], Any]] = []
+        if tk.accept("op", "*"):
+            projections.append((None, "*"))
+        else:
+            while True:
+                expr = self.expr(tk)
+                alias = None
+                if tk.accept("kw", "as"):
+                    alias = tk.expect("ident")
+                elif tk.peek() and tk.peek()[0] == "ident" and not _next_is_clause(tk):
+                    alias = tk.expect("ident")
+                projections.append((alias, expr))
+                if not tk.accept("op", ","):
+                    break
+        tk.expect("kw", "from")
+        table, scope = self.from_clause(tk)
+        where_expr = None
+        if tk.accept("kw", "where"):
+            where_expr = self.expr(tk)
+        group_by: List[Any] = []
+        if tk.accept("kw", "group"):
+            tk.expect("kw", "by")
+            while True:
+                group_by.append(self.expr(tk))
+                if not tk.accept("op", ","):
+                    break
+        having_expr = None
+        if tk.accept("kw", "having"):
+            having_expr = self.expr(tk)
+
+        return self.build(
+            table, scope, projections, where_expr, group_by, having_expr
+        )
+
+    def from_clause(self, tk: _Tokens):
+        """Returns (combined_table, scope) where scope maps each table
+        alias to {original column -> column name on the combined table},
+        so qualified refs (t2.v) stay correct after joins merge columns."""
+        name = tk.expect("ident")
+        if name not in self.tables:
+            raise ValueError(f"unknown table {name!r}")
+        table = self.tables[name]
+        scope: Dict[str, Dict[str, str]] = {
+            name: {c: c for c in table.column_names()}
+        }
+        while True:
+            how = None
+            if tk.accept("kw", "join") or (
+                tk.accept("kw", "inner") and tk.expect("kw", "join")
+            ):
+                how = "inner"
+            elif tk.peek() and tk.peek() == ("kw", "left"):
+                tk.next()
+                tk.accept("kw", "outer")
+                tk.expect("kw", "join")
+                how = "left"
+            elif tk.peek() and tk.peek() == ("kw", "right"):
+                tk.next()
+                tk.accept("kw", "outer")
+                tk.expect("kw", "join")
+                how = "right"
+            else:
+                break
+            other_name = tk.expect("ident")
+            other = self.tables[other_name]
+            tk.expect("kw", "on")
+            join_scope = dict(scope)
+            join_scope[other_name] = {c: c for c in other.column_names()}
+            cond = self._resolve_joined(
+                self.expr(tk), scope, table, other_name, other
+            )
+            jr = table.join(other, cond, how=how)
+            # materialize the join; collision columns from the right side
+            # get a disambiguated name tracked through the scope map
+            cols: Dict[str, Any] = {}
+            taken = set()
+            for _alias, mapping in scope.items():
+                for _orig, combined_name in mapping.items():
+                    if combined_name not in taken:
+                        cols[combined_name] = table[combined_name]
+                        taken.add(combined_name)
+            other_mapping: Dict[str, str] = {}
+            for c in other.column_names():
+                out_name = c if c not in taken else f"_{other_name}_{c}"
+                while out_name in taken:
+                    out_name = "_" + out_name
+                cols[out_name] = other[c]
+                taken.add(out_name)
+                other_mapping[c] = out_name
+            table = jr.select(**cols)
+            scope[other_name] = other_mapping
+        return table, scope
+
+    # -- expression parsing (returns an AST of ('kind', ...) tuples) ------
+    def expr(self, tk: _Tokens):
+        return self.or_expr(tk)
+
+    def or_expr(self, tk):
+        left = self.and_expr(tk)
+        while tk.accept("kw", "or"):
+            left = ("binop", "|", left, self.and_expr(tk))
+        return left
+
+    def and_expr(self, tk):
+        left = self.not_expr(tk)
+        while tk.accept("kw", "and"):
+            left = ("binop", "&", left, self.not_expr(tk))
+        return left
+
+    def not_expr(self, tk):
+        if tk.accept("kw", "not"):
+            return ("not", self.not_expr(tk))
+        return self.cmp_expr(tk)
+
+    def cmp_expr(self, tk):
+        left = self.add_expr(tk)
+        tok = tk.peek()
+        if tok and tok[0] == "op" and tok[1] in ("=", "<>", "!=", "<", "<=", ">", ">="):
+            tk.next()
+            op = {"=": "==", "<>": "!="}.get(tok[1], tok[1])
+            return ("binop", op, left, self.add_expr(tk))
+        if tk.accept("kw", "is"):
+            negate = tk.accept("kw", "not")
+            tk.expect("kw", "null")
+            return ("isnull", left, negate)
+        return left
+
+    def add_expr(self, tk):
+        left = self.mul_expr(tk)
+        while True:
+            tok = tk.peek()
+            if tok and tok[0] == "op" and tok[1] in ("+", "-"):
+                tk.next()
+                left = ("binop", tok[1], left, self.mul_expr(tk))
+            else:
+                return left
+
+    def mul_expr(self, tk):
+        left = self.unary_expr(tk)
+        while True:
+            tok = tk.peek()
+            if tok and tok[0] == "op" and tok[1] in ("*", "/", "%"):
+                tk.next()
+                left = ("binop", tok[1], left, self.unary_expr(tk))
+            else:
+                return left
+
+    def unary_expr(self, tk):
+        if tk.accept("op", "-"):
+            return ("neg", self.unary_expr(tk))
+        return self.atom(tk)
+
+    def atom(self, tk):
+        tok = tk.next()
+        if tok[0] == "num":
+            text = tok[1]
+            return ("const", float(text) if "." in text else int(text))
+        if tok[0] == "str":
+            return ("const", tok[1])
+        if tok == ("kw", "null"):
+            return ("const", None)
+        if tok == ("op", "("):
+            inner = self.expr(tk)
+            tk.expect("op", ")")
+            return inner
+        if tok == ("kw", "case"):
+            branches = []
+            while tk.accept("kw", "when"):
+                cond = self.expr(tk)
+                tk.expect("kw", "then")
+                branches.append((cond, self.expr(tk)))
+            default = ("const", None)
+            if tk.accept("kw", "else"):
+                default = self.expr(tk)
+            tk.expect("kw", "end")
+            return ("case", branches, default)
+        if tok[0] == "ident":
+            name = tok[1]
+            if tk.accept("op", "("):
+                if name.lower() in _AGGREGATES:
+                    if tk.accept("op", "*"):
+                        arg = None
+                    else:
+                        arg = self.expr(tk)
+                    tk.expect("op", ")")
+                    return ("agg", name.lower(), arg)
+                args = []
+                if not tk.accept("op", ")"):
+                    while True:
+                        args.append(self.expr(tk))
+                        if not tk.accept("op", ","):
+                            break
+                    tk.expect("op", ")")
+                return ("func", name.lower(), args)
+            if tk.accept("op", "."):
+                col = tk.expect("ident")
+                return ("qualified", name, col)
+            return ("ident", name)
+        raise ValueError(f"unexpected token {tok}")
+
+    # -- AST -> ColumnExpression -----------------------------------------
+    def _resolve_joined(self, ast, scope, table, other_name, other):
+        """Resolve an ON condition: the in-progress right side resolves
+        against its own table, everything else against the combined one."""
+
+        def override(node):
+            kind = node[0]
+            if kind == "qualified" and node[1] == other_name:
+                return other[node[2]]
+            if kind == "ident" and node[1] in other.column_names():
+                found_left = any(
+                    node[1] in m for m in scope.values()
+                )
+                if not found_left:
+                    return other[node[1]]
+            return None
+
+        return self._resolve(ast, scope, table, override=override)
+
+    def _resolve(self, ast, scope, table, override=None):
+        def rec(node):
+            kind = node[0]
+            if override is not None:
+                hit = override(node)
+                if hit is not None:
+                    return hit
+            if kind == "const":
+                return ColumnConstExpression(node[1])
+            if kind == "ident":
+                for mapping in scope.values():
+                    if node[1] in mapping:
+                        return table[mapping[node[1]]]
+                if node[1] in table.column_names():
+                    return table[node[1]]
+                raise ValueError(f"unknown column {node[1]!r}")
+            if kind == "qualified":
+                tname, col = node[1], node[2]
+                if tname in scope and col in scope[tname]:
+                    return table[scope[tname][col]]
+                raise ValueError(
+                    f"unknown column {tname}.{col}"
+                )
+            if kind == "binop":
+                return BinaryOpExpression(node[1], rec(node[2]), rec(node[3]))
+            if kind == "neg":
+                return UnaryOpExpression("-", rec(node[1]))
+            if kind == "not":
+                return UnaryOpExpression("~", rec(node[1]))
+            if kind == "isnull":
+                inner = IsNoneExpression(rec(node[1]), positive=not node[2])
+                return inner
+            if kind == "case":
+                result = rec(node[2]) if node[2] else ColumnConstExpression(None)
+                for cond, value in reversed(node[1]):
+                    result = if_else(rec(cond), rec(value), result)
+                return result
+            if kind == "agg":
+                reducer = _AGGREGATES[node[1]]
+                if node[2] is None:
+                    return reducer() if node[1] == "count" else reducer
+                return reducer(rec(node[2]))
+            if kind == "func":
+                raise ValueError(f"unsupported SQL function {node[1]!r}")
+            raise ValueError(f"bad AST node {node!r}")
+
+        return rec(ast)
+
+    def build(self, table, scope, projections, where_ast, group_asts, having_ast):
+        if where_ast is not None:
+            # filtering keeps column names, so the scope maps stay valid
+            table = table.filter(self._resolve(where_ast, scope, table))
+        if group_asts:
+            group_exprs = [
+                self._resolve(a, scope, table) for a in group_asts
+            ]
+            cols = {}
+            for i, (alias, ast) in enumerate(projections):
+                if ast == "*":
+                    raise ValueError("SELECT * with GROUP BY is not supported")
+                expr = self._resolve(ast, scope, table)
+                name = alias or _default_name(ast, i)
+                cols[name] = expr
+            if having_ast is not None:
+                cols["__having__"] = self._resolve(having_ast, scope, table)
+            grouped = table.groupby(*group_exprs).reduce(**cols)
+            if having_ast is not None:
+                grouped = grouped.filter(grouped["__having__"]).without(
+                    "__having__"
+                )
+            return grouped
+        cols = {}
+        for i, (alias, ast) in enumerate(projections):
+            if ast == "*":
+                for c in table.column_names():
+                    cols[c] = table[c]
+                continue
+            expr = self._resolve(ast, scope, table)
+            cols[alias or _default_name(ast, i)] = expr
+        return table.select(**cols)
+
+
+def _default_name(ast, i: int) -> str:
+    if isinstance(ast, tuple):
+        if ast[0] == "ident":
+            return ast[1]
+        if ast[0] == "qualified":
+            return ast[2]
+        if ast[0] == "agg" and isinstance(ast[2], tuple) and ast[2][0] == "ident":
+            return ast[2][1]
+    return f"col_{i}"
+
+
+def _next_is_clause(tk: _Tokens) -> bool:
+    tok = tk.peek()
+    return tok is not None and tok[0] == "kw"
+
+
+def sql(query: str, **tables: Table) -> Table:
+    """Run a SQL query over the given tables (reference: pw.sql,
+    internals/sql/processing.py)."""
+    translator = _SqlTranslator(tables)
+    tk = _Tokens(query)
+    result = translator.query(tk)
+    if tk.peek() is not None:
+        raise ValueError(f"unparsed SQL from token {tk.peek()!r}")
+    return result

@@ -139,6 +139,15 @@ class ConnectorSubjectBase:
             self._closed = True
             self._sink.close()
 
+    # -- persistence hooks (reference: ConnectorSubject seek/snapshot,
+    # io/python/__init__.py:47) -------------------------------------------
+    def _persisted_state(self):
+        """Cursor state saved at each commit; restored on resume."""
+        return None
+
+    def _restore_persisted_state(self, state) -> None:
+        pass
+
     # -- to override ------------------------------------------------------
     def run(self) -> None:
         raise NotImplementedError
@@ -160,6 +169,9 @@ class _QueueSink:
         self.names = list(live.schema.keys())
         self.pk = live.schema.primary_key_columns()
         self._counter = 0
+        self.subject = None  # bound by the driver
+
+    persistence_enabled = False
 
     def push_row(self, row: dict, diff: int = 1) -> None:
         values = tuple(row.get(c) for c in self.names)
@@ -170,34 +182,73 @@ class _QueueSink:
         else:
             self._counter += 1
             key = ref_scalar(self.live.name, self._counter)
-        self.queue.put(("data", self.live, (key, values, diff)))
+        # the counter rides every data message so autocommit-flushed
+        # batches persist a correct resume point even without commit()
+        self.queue.put(("data", self.live, (key, values, diff), self._counter))
 
     def commit(self) -> None:
-        self.queue.put(("commit", self.live, None))
+        state = None
+        if self.persistence_enabled and self.subject is not None:
+            state = {"subject": self.subject._persisted_state()}
+        self.queue.put(("commit", self.live, state, self._counter))
 
     def close(self) -> None:
-        self.queue.put(("close", self.live, None))
+        self.queue.put(("close", self.live, None, self._counter))
 
 
 class StreamingDriver:
     """Main streaming loop: collects source events, advances engine time
     (reference: worker main loop, dataflow.rs:6552-6620)."""
 
-    def __init__(self, engine, ctx, *, autocommit_ms: float = 100.0):
+    def __init__(
+        self,
+        engine,
+        ctx,
+        *,
+        autocommit_ms: float = 100.0,
+        persistence_config=None,
+    ):
         self.engine = engine
         self.ctx = ctx
         self.autocommit_s = autocommit_ms / 1000.0
         self.queue: queue_mod.SimpleQueue = queue_mod.SimpleQueue()
+        self.persistence_config = persistence_config
+        self._writers: Dict[LiveSource, Any] = {}
+
+    def _snapshot_writer(self, live: LiveSource):
+        if self.persistence_config is None:
+            return None
+        from pathway_tpu.persistence import InputSnapshotWriter
+
+        writer = self._writers.get(live)
+        if writer is None:
+            writer = InputSnapshotWriter(
+                self.persistence_config.backend._backend, live.name
+            )
+            self._writers[live] = writer
+        return writer
 
     def run(self, sources: List[LiveSource]) -> None:
         threads = []
         active = 0
+        replayed: Dict[LiveSource, List] = {}
         for live in sources:
             if live.node is None:
                 continue  # source never built (tree-shaken)
             subject = live.subject_factory()
             sink = _QueueSink(self.queue, live)
+            sink.subject = subject
+            sink.persistence_enabled = self.persistence_config is not None
             subject._bind(sink)
+            writer = self._snapshot_writer(live)
+            if writer is not None:
+                events = writer.read_events()
+                if events:
+                    replayed[live] = events
+                state = writer.read_state()
+                if state is not None:
+                    sink._counter = state.get("counter", 0)
+                    subject._restore_persisted_state(state.get("subject"))
 
             def runner(subject=subject):
                 try:
@@ -211,11 +262,19 @@ class StreamingDriver:
             active += 1
         # initial time 0 processes static parts of the graph
         self.engine.process_time(0)
+        # replay persisted input snapshots as the first batch (reference:
+        # rewind_from_disk_snapshot, connectors/mod.rs:256)
+        if replayed:
+            for live, events in replayed.items():
+                live.node.push(2, events)
+            self.engine.process_time(2)
         for t in threads:
             t.start()
 
-        time = 2
+        time = 4 if replayed else 2
         pending: Dict[LiveSource, List] = {}
+        states: Dict[LiveSource, Any] = {}
+        counters: Dict[LiveSource, int] = {}
         last_flush = time_mod.monotonic()
 
         def flush():
@@ -223,6 +282,11 @@ class StreamingDriver:
             flushed = False
             for live, deltas in pending.items():
                 if deltas:
+                    writer = self._snapshot_writer(live)
+                    if writer is not None:
+                        state = states.pop(live, None) or {}
+                        state["counter"] = counters.get(live, 0)
+                        writer.write_batch(deltas, state)
                     live.node.push(time, deltas)
                     flushed = True
             pending.clear()
@@ -241,13 +305,18 @@ class StreamingDriver:
                 0.0, self.autocommit_s - (time_mod.monotonic() - last_flush)
             )
             try:
-                kind, live, payload = self.queue.get(timeout=timeout or 0.01)
+                kind, live, payload, counter = self.queue.get(
+                    timeout=timeout or 0.01
+                )
             except queue_mod.Empty:
                 flush()
                 continue
+            counters[live] = max(counters.get(live, 0), counter)
             if kind == "data":
                 pending.setdefault(live, []).append(payload)
             elif kind == "commit":
+                if payload is not None:
+                    states[live] = payload
                 flush()
             elif kind == "close":
                 active -= 1

@@ -142,6 +142,13 @@ class _FsSubject(ConnectorSubjectBase):
                 return
             time_mod.sleep(self.refresh_interval)
 
+    def _persisted_state(self):
+        return {"seen": dict(self._seen)}
+
+    def _restore_persisted_state(self, state) -> None:
+        if state and "seen" in state:
+            self._seen.update(state["seen"])
+
 
 def _parse_csv_value(text, dtype: dt.DType):
     if text is None:

@@ -21,7 +21,9 @@ def read(
     **kwargs,
 ):
     """Read from a user ConnectorSubject."""
-    if isinstance(subject, type):
+    if isinstance(subject, type) or (
+        callable(subject) and not isinstance(subject, ConnectorSubject)
+    ):
         factory = subject
     else:
         # a subject instance can be consumed once

@@ -85,7 +85,20 @@ def encode_batch(
     batch_bucket: bool = True,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Returns (ids [B', L'], mask [B', L']) padded to bucketed shapes; the
-    first len(texts) rows are the real batch."""
+    first len(texts) rows are the real batch. Single-text batches go through
+    the C++ tokenizer when available (pathway_tpu/native/tokenizer.cpp)."""
+    if (
+        pair_texts is None
+        and texts
+        and tokenizer.lowercase
+        and all(t.isascii() for t in texts)
+    ):
+        # the native path matches the python tokenizer exactly only for
+        # lowercased ASCII input; anything else takes the python path so
+        # ids never depend on whether a compiler was available
+        native = _try_native(tokenizer, texts, max_len, batch_bucket)
+        if native is not None:
+            return native
     if pair_texts is not None:
         encoded = [
             tokenizer.encode_pair(a, b, max_len)
@@ -103,4 +116,31 @@ def encode_batch(
         e = e[:seq_len]
         ids[i, : len(e)] = e
         mask[i, : len(e)] = 1
+    return ids, mask
+
+
+def _try_native(tokenizer, texts, max_len, batch_bucket):
+    from pathway_tpu import native
+
+    lib = native.load()
+    if lib is None:
+        return None
+    batch = len(texts)
+    padded_batch = (
+        bucket_length(max(batch, 1), minimum=8, maximum=1 << 16)
+        if batch_bucket
+        else batch
+    )
+    result = native.tokenize_batch_native(
+        list(texts), tokenizer.vocab_size, max_len
+    )
+    if result is None:
+        return None
+    ids_full, mask_full = result
+    longest = int(mask_full.sum(axis=1).max()) if batch else 1
+    seq_len = bucket_length(max(longest, 1), maximum=max_len)
+    ids = np.full((padded_batch, seq_len), PAD_ID, dtype=np.int32)
+    mask = np.zeros((padded_batch, seq_len), dtype=np.int32)
+    ids[:batch] = ids_full[:, :seq_len]
+    mask[:batch] = mask_full[:, :seq_len]
     return ids, mask

@@ -1,0 +1,122 @@
+"""Native (C++) runtime components, built on demand via the system
+toolchain and loaded with ctypes.
+
+The reference keeps its hot runtime in Rust (src/engine, src/connectors);
+here the compute hot path is XLA, and the native layer covers the host-side
+feeding work that would otherwise bottleneck the chip — currently the batch
+tokenizer. Falls back to the pure-python implementations when no compiler
+is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+_lib = None
+_build_failed = False
+
+
+def _source_path(name: str) -> str:
+    return os.path.join(os.path.dirname(__file__), name)
+
+
+def _cache_dir() -> str:
+    root = os.environ.get(
+        "PATHWAY_NATIVE_CACHE",
+        os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "pathway_tpu",
+        ),
+    )
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load the native library; None if unavailable."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed or os.environ.get("PATHWAY_DISABLE_NATIVE"):
+        return None
+    source = _source_path("tokenizer.cpp")
+    try:
+        with open(source, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        so_path = os.path.join(_cache_dir(), f"pw_native_{digest}.so")
+        if not os.path.exists(so_path):
+            tmp = so_path + f".tmp{os.getpid()}"
+            subprocess.run(
+                [
+                    "g++",
+                    "-O3",
+                    "-shared",
+                    "-fPIC",
+                    "-std=c++17",
+                    source,
+                    "-o",
+                    tmp,
+                ],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp, so_path)
+        lib = ctypes.CDLL(so_path)
+        lib.tokenize_batch.restype = ctypes.c_int32
+        lib.tokenize_batch.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.count_tokens.restype = ctypes.c_int32
+        lib.count_tokens.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+        _lib = lib
+        return lib
+    except Exception:  # noqa: BLE001 — fall back to python
+        _build_failed = True
+        return None
+
+
+def tokenize_batch_native(texts, vocab_size: int, seq_len: int):
+    """Returns (ids, mask) int32 [n, seq_len] numpy arrays, or None when
+    the native library is unavailable."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    encoded = [t.encode("utf-8", errors="replace") for t in texts]
+    buffer = b"".join(encoded)
+    offsets = np.zeros(len(texts) + 1, dtype=np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    n = len(texts)
+    ids = np.zeros((n, seq_len), dtype=np.int32)
+    mask = np.zeros((n, seq_len), dtype=np.int32)
+    lib.tokenize_batch(
+        buffer,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        vocab_size,
+        seq_len,
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return ids, mask
+
+
+def count_tokens_native(text: str) -> Optional[int]:
+    lib = load()
+    if lib is None:
+        return None
+    data = text.encode("utf-8", errors="replace")
+    return lib.count_tokens(data, len(data))

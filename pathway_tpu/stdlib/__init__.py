@@ -1,6 +1,7 @@
 """Standard library (reference: python/pathway/stdlib)."""
 
 from pathway_tpu.stdlib import (
+    graphs,
     indexing,
     ml,
     ordered,
@@ -11,6 +12,7 @@ from pathway_tpu.stdlib import (
 )
 
 __all__ = [
+    "graphs",
     "indexing",
     "ml",
     "ordered",

@@ -1,0 +1,9 @@
+"""pw.graphs — graph algorithms (reference: python/pathway/stdlib/graphs/:
+bellman_ford/impl.py, pagerank/impl.py, louvain_communities/impl.py).
+All are fixed-point computations over edge tables via pw.iterate."""
+
+from pathway_tpu.stdlib.graphs.common import Edge, Vertex, Graph
+from pathway_tpu.stdlib.graphs.pagerank import pagerank
+from pathway_tpu.stdlib.graphs.bellman_ford import bellman_ford
+
+__all__ = ["Edge", "Vertex", "Graph", "pagerank", "bellman_ford"]

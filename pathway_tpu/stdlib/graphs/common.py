@@ -1,0 +1,20 @@
+"""Graph data model (reference: stdlib/graphs/common.py)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals.schema import Schema
+
+
+class Vertex(Schema):
+    pass
+
+
+class Edge(Schema):
+    u: object  # Pointer to source vertex
+    v: object  # Pointer to target vertex
+
+
+class Graph:
+    def __init__(self, V, E):
+        self.V = V
+        self.E = E

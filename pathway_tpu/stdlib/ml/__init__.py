@@ -1,6 +1,6 @@
 """pw.ml (reference: python/pathway/stdlib/ml/). Populated progressively:
 index (legacy KNNIndex), classifiers, smart_table_ops."""
 
-from pathway_tpu.stdlib.ml import index
+from pathway_tpu.stdlib.ml import classifiers, index, smart_table_ops
 
-__all__ = ["index"]
+__all__ = ["classifiers", "index", "smart_table_ops"]

@@ -129,6 +129,114 @@ class WindowedTable:
         return grouped.reduce(*new_args, **new_kwargs)
 
 
+def _remap_by_name(expr, target: Table):
+    """Rebind column references onto `target` by column name (columns
+    survive flatten/with_columns under their names)."""
+    import copy as copy_mod
+
+    from pathway_tpu.internals.expression import (
+        ColumnExpression,
+        ColumnReference,
+        IdReference,
+    )
+
+    def rec(e):
+        if isinstance(e, IdReference):
+            return IdReference(target)
+        if isinstance(e, ColumnReference):
+            if e.name in target.column_names():
+                return target[e.name]
+            return e
+        out = copy_mod.copy(e)
+        for attr, value in list(vars(e).items()):
+            if isinstance(value, ColumnExpression):
+                setattr(out, attr, rec(value))
+            elif isinstance(value, tuple) and any(
+                isinstance(v, ColumnExpression) for v in value
+            ):
+                setattr(
+                    out,
+                    attr,
+                    tuple(
+                        rec(v) if isinstance(v, ColumnExpression) else v
+                        for v in value
+                    ),
+                )
+        return out
+
+    return rec(expr)
+
+
+def _wrap_temporal(table: Table, node_cls, threshold_expr, time_expr, **kw) -> Table:
+    from pathway_tpu.internals.table import _compile_on
+
+    def build(ctx):
+        node = ctx.node(table)
+        return node_cls(
+            ctx.engine,
+            node,
+            _compile_on(ctx, [table], threshold_expr),
+            _compile_on(ctx, [table], time_expr),
+            **kw,
+        )
+
+    return Table(schema=table._schema, universe=Universe(), build=build)
+
+
+def _apply_behavior(flat2: Table, time_on_flat, behavior) -> Table:
+    """Wrap the flattened window-assignment table with buffer/freeze/forget
+    per the behavior (reference: temporal_behavior.py applied in _window.py
+    _apply; engine ops time_column.rs)."""
+    from pathway_tpu.engine.temporal_nodes import BufferNode, ForgetNode, FreezeNode
+    from pathway_tpu.stdlib.temporal.temporal_behavior import (
+        CommonBehavior,
+        ExactlyOnceBehavior,
+    )
+
+    out = flat2
+
+    def wrap(node_cls, threshold_of, **kw):
+        nonlocal out
+        # expressions must rebind onto the current (possibly already
+        # wrapped) table — columns keep their names through the chain
+        out = _wrap_temporal(
+            out,
+            node_cls,
+            threshold_of(out),
+            _remap_by_name(time_on_flat, out),
+            **kw,
+        )
+
+    if isinstance(behavior, ExactlyOnceBehavior):
+        shift = behavior.shift
+
+        def threshold(t):
+            end = t["_pw_window_end"]
+            return end + shift if shift is not None else end
+
+        wrap(FreezeNode, threshold)
+        wrap(BufferNode, threshold)
+        return out
+    if isinstance(behavior, CommonBehavior):
+        if behavior.delay is not None:
+            wrap(
+                BufferNode,
+                lambda t: t["_pw_window_start"] + behavior.delay,
+            )
+        if behavior.cutoff is not None:
+            wrap(
+                FreezeNode,
+                lambda t: t["_pw_window_end"] + behavior.cutoff,
+            )
+            if not behavior.keep_results:
+                wrap(
+                    ForgetNode,
+                    lambda t: t["_pw_window_end"] + behavior.cutoff,
+                )
+        return out
+    return out
+
+
 def windowby(
     table: Table,
     time_expr,
@@ -161,6 +269,10 @@ def windowby(
             # instance columns survive flatten under their original name
             cols["_pw_instance"] = desugar(instance, {thisclass.this: flat})
         flat2 = flat.with_columns(**cols)
+        if behavior is not None:
+            flat2 = _apply_behavior(
+                flat2, _remap_by_name(time_e, flat2), behavior
+            )
         grouping = ["_pw_window_start", "_pw_window_end"]
         if instance_e is not None:
             grouping.append("_pw_instance")
@@ -176,6 +288,10 @@ def windowby(
         if instance_e is not None:
             flat2_cols["_pw_instance"] = instance_e
         flat2 = table.select(**flat2_cols)
+        if behavior is not None:
+            flat2 = _apply_behavior(
+                flat2, _remap_by_name(time_e, flat2), behavior
+            )
         grouping = ["_pw_window_start", "_pw_window_end"]
         if instance_e is not None:
             grouping.append("_pw_instance")

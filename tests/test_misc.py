@@ -1,0 +1,238 @@
+"""sql / yaml loader / graphs / cli / monitoring / demo tests."""
+
+import json
+import textwrap
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import (
+    assert_table_equality_wo_index,
+    table_from_markdown,
+)
+from pathway_tpu.internals.runner import run_tables
+
+
+def _rows(table):
+    (capture,) = run_tables(table)
+    return list(capture.state.rows.values())
+
+
+def test_sql_select_where():
+    t = table_from_markdown(
+        """
+        a | b
+        1 | 10
+        2 | 20
+        3 | 30
+        """
+    )
+    result = pw.sql("SELECT a, b + 1 AS c FROM t WHERE a >= 2", t=t)
+    expected = table_from_markdown(
+        """
+        a | c
+        2 | 21
+        3 | 31
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_sql_group_by():
+    t = table_from_markdown(
+        """
+        k | v
+        a | 1
+        a | 2
+        b | 5
+        """
+    )
+    result = pw.sql(
+        "SELECT k, SUM(v) AS total, COUNT(*) AS n FROM t GROUP BY k", t=t
+    )
+    expected = table_from_markdown(
+        """
+        k | total | n
+        a | 3     | 2
+        b | 5     | 1
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_sql_join():
+    t1 = table_from_markdown(
+        """
+        k | a
+        1 | x
+        2 | y
+        """
+    )
+    t2 = table_from_markdown(
+        """
+        k2 | b
+        1  | 10
+        """
+    )
+    result = pw.sql(
+        "SELECT a, b FROM t1 JOIN t2 ON t1.k = t2.k2", t1=t1, t2=t2
+    )
+    assert _rows(result) == [("x", 10)]
+
+
+def test_sql_having_and_case():
+    t = table_from_markdown(
+        """
+        k | v
+        a | 1
+        a | 2
+        b | 9
+        """
+    )
+    result = pw.sql(
+        "SELECT k, SUM(v) AS s FROM t GROUP BY k HAVING SUM(v) > 4", t=t
+    )
+    assert _rows(result) == [("b", 9)]
+
+    r2 = pw.sql(
+        "SELECT CASE WHEN v > 5 THEN 'big' ELSE 'small' END AS size FROM t",
+        t=t,
+    )
+    assert sorted(r[0] for r in _rows(r2)) == ["big", "small", "small"]
+
+
+def test_yaml_loader():
+    manifest = textwrap.dedent(
+        """
+        $splitter: !pw.xpacks.llm.splitters.NullSplitter
+
+        config:
+          chunk_size: 100
+          splitter: $splitter
+        """
+    )
+    out = pw.load_yaml(manifest)
+    assert set(out) == {"config"}
+    from pathway_tpu.xpacks.llm.splitters import NullSplitter
+
+    assert isinstance(out["config"]["splitter"], NullSplitter)
+    assert out["config"]["chunk_size"] == 100
+
+
+def test_bellman_ford():
+    import math
+
+    vertices = table_from_markdown(
+        """
+        id | is_source
+        1  | True
+        2  | False
+        3  | False
+        4  | False
+        """
+    )
+    from pathway_tpu.engine.value import ref_scalar
+
+    def vid(n):
+        return vertices.pointer_from(n)
+
+    edges = table_from_markdown(
+        """
+        a | b | dist
+        1 | 2 | 1.0
+        2 | 3 | 2.0
+        1 | 3 | 10.0
+        """
+    )
+    edges = edges.select(
+        u=vertices.pointer_from(edges.a),
+        v=vertices.pointer_from(edges.b),
+        dist=edges.dist,
+    )
+    # the markdown `id` column already keys vertices by ref_scalar(id),
+    # matching pointer_from(edges.a)
+    result = pw.graphs.bellman_ford(vertices, edges)
+    dists = sorted(r[0] for r in _rows(result))
+    assert dists == [0.0, 1.0, 3.0, math.inf]
+
+
+def test_pagerank_runs():
+    t = table_from_markdown(
+        """
+        a | b
+        1 | 2
+        2 | 3
+        3 | 1
+        """
+    )
+    anchor = table_from_markdown(
+        """
+        id | x
+        1  | 0
+        2  | 0
+        3  | 0
+        """
+    )
+    edges = t.select(
+        u=anchor.pointer_from(t.a), v=anchor.pointer_from(t.b)
+    )
+    ranks = pw.graphs.pagerank(edges, steps=3)
+    rows = _rows(ranks)
+    assert len(rows) == 3
+    assert all(r[0] > 0 for r in rows)
+
+
+def test_prometheus_server():
+    from pathway_tpu.engine.engine import Engine
+    from pathway_tpu.internals.monitoring import PrometheusServer
+
+    engine = Engine()
+    engine.stats_rows = 42
+    server = PrometheusServer(engine, port=29123)
+    server.start()
+    try:
+        with urllib.request.urlopen(
+            "http://127.0.0.1:29123/metrics", timeout=5
+        ) as resp:
+            body = resp.read().decode()
+        assert "pathway_rows_processed 42" in body
+    finally:
+        server.stop()
+
+
+def test_cli_spawn(tmp_path):
+    prog = tmp_path / "prog.py"
+    prog.write_text(
+        "import os\n"
+        "print('worker', os.environ['PATHWAY_PROCESS_ID'], "
+        "os.environ['PATHWAY_PROCESSES'])\n"
+    )
+    from pathway_tpu.cli import main
+
+    code = main(["spawn", "-n", "2", str(prog)])
+    assert code == 0
+
+
+def test_fuzzy_match():
+    left = table_from_markdown(
+        """
+        name
+        apple inc
+        banana corp
+        """
+    )
+    right = table_from_markdown(
+        """
+        title
+        Apple Incorporated
+        Banana Company
+        """
+    )
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_match_tables
+
+    scores = fuzzy_match_tables(left, right)
+    rows = _rows(scores)
+    # apple<->Apple and banana<->Banana pairs found with positive weight
+    assert len(rows) >= 2
+    assert all(r[2] > 0 for r in rows)

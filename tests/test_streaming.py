@@ -1,0 +1,251 @@
+"""Streaming semantics: behaviors (buffer/freeze/forget), AsyncTransformer,
+persistence resume (modeled on the reference's *_stream.py temporal tests and
+the wordcount recovery harness, integration_tests/wordcount)."""
+
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+from pathway_tpu.internals.runner import run_tables
+
+
+def _stream_of(table):
+    (capture,) = run_tables(table, record_stream=True)
+    return capture.stream, capture.state.rows
+
+
+def test_exactly_once_behavior_single_emission():
+    # rows of window [0, 10) arrive at engine times 2 and 4; with
+    # exactly_once the window result must be emitted once, not updated
+    t = table_from_markdown(
+        """
+        t  | v | __time__
+        1  | 1 | 2
+        2  | 2 | 4
+        12 | 5 | 6
+        """
+    )
+    res = pw.temporal.windowby(
+        t,
+        t.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.exactly_once_behavior(),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    stream, rows = _stream_of(res)
+    # final state correct
+    assert set(rows.values()) == {(0, 3), (10, 5)}
+    # window [0,10) emitted exactly once (no retraction/update)
+    w0_events = [d for _t, d in stream if d[1][0] == 0]
+    assert len(w0_events) == 1
+    assert w0_events[0][2] == 1
+
+
+def test_common_behavior_cutoff_drops_late_rows():
+    # late row (t=1 arriving after the stream clock reached 25) is ignored
+    t = table_from_markdown(
+        """
+        t  | v | __time__
+        1  | 1 | 2
+        25 | 9 | 4
+        2  | 7 | 6
+        """
+    )
+    res = pw.temporal.windowby(
+        t,
+        t.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.common_behavior(cutoff=5),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    _stream, rows = _stream_of(res)
+    # the t=2 row arrived after window [0,10)+cutoff passed → ignored
+    assert set(rows.values()) == {(0, 1), (20, 9)}
+
+
+def test_common_behavior_keep_results_false_forgets():
+    t = table_from_markdown(
+        """
+        t  | v | __time__
+        1  | 1 | 2
+        30 | 9 | 4
+        """
+    )
+    res = pw.temporal.windowby(
+        t,
+        t.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.common_behavior(cutoff=5, keep_results=False),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    _stream, rows = _stream_of(res)
+    # window [0,10) closed and was forgotten; only the live window remains
+    assert set(rows.values()) == {(30, 9)}
+
+
+def test_async_transformer():
+    class OutSchema(pw.Schema):
+        ret: int
+
+    class Doubler(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value: int) -> dict:
+            return {"ret": value * 2}
+
+    t = table_from_markdown(
+        """
+        value
+        1
+        2
+        3
+        """
+    )
+    result = Doubler(input_table=t).successful
+    (capture,) = run_tables(result)
+    assert sorted(r[0] for r in capture.state.rows.values()) == [2, 4, 6]
+
+
+def test_async_transformer_failure_routed():
+    class OutSchema(pw.Schema):
+        ret: int
+
+    class Flaky(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value: int) -> dict:
+            if value == 2:
+                raise ValueError("boom")
+            return {"ret": value}
+
+    t = table_from_markdown(
+        """
+        value
+        1
+        2
+        """
+    )
+    tf = Flaky(input_table=t)
+    ok_cap, fail_cap = run_tables(tf.successful, tf.failed)
+    assert [r[0] for r in ok_cap.state.rows.values()] == [1]
+    assert len(fail_cap.state.rows) == 1
+
+
+class _CountSubject(pw.io.python.ConnectorSubject):
+    """Emits integers start..end, then closes; persists its cursor."""
+
+    def __init__(self, end):
+        super().__init__()
+        self.start = 1
+        self.end = end
+
+    def run(self):
+        for i in range(self.start, self.end + 1):
+            self.next(value=i)
+            self.commit()
+
+    def _persisted_state(self):
+        return {"next_start": self.end + 1}
+
+    def _restore_persisted_state(self, state):
+        if state and "next_start" in state:
+            self.start = state["next_start"]
+
+
+def test_persistence_resume(tmp_path):
+    backend = pw.persistence.Backend.filesystem(str(tmp_path))
+    config = pw.persistence.Config(backend)
+
+    class InSchema(pw.Schema):
+        value: int
+
+    def run_once(end):
+        pw.G.clear()
+        t = pw.io.python.read(
+            lambda: _CountSubject(end), schema=InSchema, name="counter"
+        )
+        doubled = t.select(d=pw.this.value * 2)
+        seen = []
+        pw.io.subscribe(
+            doubled,
+            on_change=lambda key, row, time, is_addition: seen.append(
+                (row["d"], is_addition)
+            ),
+        )
+        pw.run(persistence_config=config)
+        return seen
+
+    first = run_once(3)
+    assert sorted(v for v, add in first if add) == [2, 4, 6]
+
+    second = run_once(6)
+    values = sorted(v for v, add in second if add)
+    # replayed 1-3 from the snapshot + fresh 4-6; no duplicates
+    assert values == [2, 4, 6, 8, 10, 12]
+
+
+def test_persistence_resume_autocommit_only(tmp_path):
+    """A subject that never calls commit() must still resume with a correct
+    key counter (autocommit batches persist the counter)."""
+    backend = pw.persistence.Backend.filesystem(str(tmp_path))
+    config = pw.persistence.Config(backend)
+
+    class InSchema(pw.Schema):
+        value: int
+
+    class NoCommit(pw.io.python.ConnectorSubject):
+        def __init__(self, start, end):
+            super().__init__()
+            self.start, self.end = start, end
+
+        def run(self):
+            for i in range(self.start, self.end + 1):
+                self.next(value=i)
+                time.sleep(0.02)  # let autocommit flush between rows
+
+    def run_once(start, end):
+        pw.G.clear()
+        t = pw.io.python.read(
+            lambda: NoCommit(start, end), schema=InSchema, name="nocommit"
+        )
+        seen = []
+        pw.io.subscribe(
+            t,
+            on_change=lambda key, row, time, is_addition: seen.append(
+                row["value"]
+            ),
+        )
+        pw.run(persistence_config=config)
+        return seen
+
+    first = run_once(1, 3)
+    assert sorted(first) == [1, 2, 3]
+    second = run_once(4, 6)
+    assert sorted(second) == [1, 2, 3, 4, 5, 6]
+
+
+def test_streaming_join_updates():
+    left = table_from_markdown(
+        """
+        k | a | __time__
+        1 | x | 2
+        """
+    )
+    right = table_from_markdown(
+        """
+        k | b | __time__
+        1 | 5 | 4
+        1 | 5 | 6
+        """,
+        id_from=["k"],
+    )
+    # right row appears at t=4 (id from k so t=6 row is an update no-op)
+    res = left.join(right, left.k == right.k).select(a=left.a, b=right.b)
+    stream, rows = _stream_of(res)
+    assert list(rows.values()) == [("x", 5)]
+    # join result appeared only after the right side arrived
+    assert stream[0][0] >= 4
