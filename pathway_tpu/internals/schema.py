@@ -75,8 +75,28 @@ class SchemaMetaclass(type):
     __columns__: Dict[str, ColumnSchema]
     __universe_properties__: SchemaProperties
 
-    def __init__(cls, name, bases, namespace, append_only: bool | None = None):
+    def __new__(
+        mcls,
+        name,
+        bases,
+        namespace,
+        append_only: bool | None = None,
+        primary_key=None,
+    ):
+        return super().__new__(mcls, name, bases, namespace)
+
+    def __init__(
+        cls,
+        name,
+        bases,
+        namespace,
+        append_only: bool | None = None,
+        primary_key=None,
+    ):
         super().__init__(name, bases, namespace)
+        # class-level primary_key=["col", ...] kwarg (reference:
+        # pw.Schema class syntax, internals/schema.py)
+        pk_cols = set(primary_key or ())
         columns: Dict[str, ColumnSchema] = {}
         for base in bases:
             if hasattr(base, "__columns__"):
@@ -111,13 +131,19 @@ class SchemaMetaclass(type):
             columns[out_name] = ColumnSchema(
                 name=out_name,
                 dtype=dtype,
-                primary_key=definition.primary_key,
+                primary_key=definition.primary_key or out_name in pk_cols,
                 default_value=definition.default_value,
                 append_only=bool(
                     definition.append_only
                     if definition.append_only is not None
                     else append_only
                 ),
+            )
+        unknown_pk = pk_cols - set(columns)
+        if unknown_pk:
+            raise ValueError(
+                f"primary_key columns {sorted(unknown_pk)} are not columns "
+                f"of schema {name} (has {sorted(columns)})"
             )
         cls.__columns__ = columns
         cls.__column_definitions__ = {
@@ -216,6 +242,11 @@ class SchemaMetaclass(type):
 
 class Schema(metaclass=SchemaMetaclass):
     """Base class for user schemas (reference: pw.Schema)."""
+
+    def __init_subclass__(cls, **kwargs):
+        # class kwargs (append_only, primary_key) are consumed by the
+        # metaclass; swallow them here so type.__init_subclass__ is happy
+        super().__init_subclass__()
 
 
 def schema_from_columns(

@@ -8,11 +8,13 @@ io/python/__init__.py:47).
 from __future__ import annotations
 
 from pathway_tpu.io._subscribe import subscribe
+from pathway_tpu.io._synchronization import register_input_synchronization_group
 
 from pathway_tpu.io import csv, fs, jsonlines, null, plaintext, python
 
 __all__ = [
     "subscribe",
+    "register_input_synchronization_group",
     "csv",
     "fs",
     "jsonlines",

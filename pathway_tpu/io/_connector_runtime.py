@@ -26,6 +26,18 @@ from pathway_tpu.internals.universe import Universe
 _source_ids = itertools.count()
 
 
+def _hashable(values: tuple) -> tuple:
+    """Values tuple -> dict key (Json and arrays are unhashable)."""
+    out = []
+    for v in values:
+        try:
+            hash(v)
+            out.append(v)
+        except TypeError:
+            out.append(repr(v))
+    return tuple(out)
+
+
 class LiveSource:
     """One streaming input: a subject factory + the engine node it feeds."""
 
@@ -34,6 +46,8 @@ class LiveSource:
         self.schema = schema
         self.name = name
         self.node = None  # set at build time
+        self.sync_group = None  # set by register_input_synchronization_group
+        self.sync_column = None
 
 
 def connector_table(
@@ -71,7 +85,9 @@ def connector_table(
             G.add_source(live)
         return node
 
-    return Table(schema=schema, universe=Universe(), build=build_streaming)
+    table = Table(schema=schema, universe=Universe(), build=build_streaming)
+    table._live_source = live  # for input synchronization groups
+    return table
 
 
 class _StaticCollector:
@@ -83,14 +99,23 @@ class _StaticCollector:
         self.pk = schema.primary_key_columns()
         self.rows: Dict[Pointer, tuple] = {}
         self._counter = 0
+        self._keys_by_values: Dict[tuple, List] = {}
 
     def push_row(self, row: dict, diff: int = 1) -> None:
         values = tuple(row.get(c) for c in self.names)
         if self.pk:
             key = ref_scalar(*(row.get(c) for c in self.pk))
-        else:
+        elif diff > 0:
             self._counter += 1
             key = ref_scalar(self.schema.__name__, self._counter)
+            self._keys_by_values.setdefault(_hashable(values), []).append(key)
+        else:
+            # retraction without a primary key: cancel the key assigned to
+            # an earlier insert of the same values
+            stack = self._keys_by_values.get(_hashable(values))
+            if not stack:
+                return
+            key = stack.pop()
         if diff > 0:
             self.rows[key] = values
         else:
@@ -169,19 +194,34 @@ class _QueueSink:
         self.names = list(live.schema.keys())
         self.pk = live.schema.primary_key_columns()
         self._counter = 0
+        self._keys_by_values: Dict[tuple, List] = {}
         self.subject = None  # bound by the driver
 
     persistence_enabled = False
 
     def push_row(self, row: dict, diff: int = 1) -> None:
+        if self.live.sync_group is not None and diff > 0:
+            # throttle until the group's other sources catch up (reference:
+            # src/connectors/synchronization.rs)
+            self.live.sync_group.wait_for(
+                self.live, row.get(self.live.sync_column)
+            )
         values = tuple(row.get(c) for c in self.names)
         if "_pw_key" in row:
             key = row["_pw_key"]
         elif self.pk:
             key = ref_scalar(*(row.get(c) for c in self.pk))
-        else:
+        elif diff > 0:
             self._counter += 1
             key = ref_scalar(self.live.name, self._counter)
+            self._keys_by_values.setdefault(_hashable(values), []).append(key)
+        else:
+            # retraction on a keyless schema must reuse the insert's key,
+            # or it never cancels anything (negative multiplicity)
+            stack = self._keys_by_values.get(_hashable(values))
+            if not stack:
+                return
+            key = stack.pop()
         # the counter rides every data message so autocommit-flushed
         # batches persist a correct resume point even without commit()
         self.queue.put(("data", self.live, (key, values, diff), self._counter))
@@ -193,6 +233,8 @@ class _QueueSink:
         self.queue.put(("commit", self.live, state, self._counter))
 
     def close(self) -> None:
+        if self.live.sync_group is not None:
+            self.live.sync_group.source_closed(self.live)
         self.queue.put(("close", self.live, None, self._counter))
 
 

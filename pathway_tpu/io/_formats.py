@@ -1,0 +1,93 @@
+"""Shared object-payload parsing for file-like connectors (fs, s3, minio,
+gdrive-adjacent). One implementation of the reference's parser dispatch
+(src/connectors/data_format.rs: DsvParser:522, JsonLinesParser:1630,
+IdentityParser:894) over whole-object byte payloads.
+"""
+
+from __future__ import annotations
+
+import csv as csv_mod
+import io as io_mod
+import json
+from typing import Any, Dict, Iterator
+
+from pathway_tpu.internals import dtype as dt
+
+
+def parse_csv_value(text, dtype: dt.DType):
+    if text is None:
+        return None
+    core = dt.unoptionalize(dtype)
+    try:
+        if core is dt.INT:
+            return int(text)
+        if core is dt.FLOAT:
+            return float(text)
+        if core is dt.BOOL:
+            return text.strip().lower() in ("true", "1", "yes", "on")
+    except ValueError:
+        return None
+    return text
+
+
+def coerce_json_value(v, dtype: dt.DType):
+    core = dt.unoptionalize(dtype)
+    if core is dt.JSON:
+        from pathway_tpu.engine.value import Json
+
+        return Json(v)
+    if core is dt.FLOAT and isinstance(v, int):
+        return float(v)
+    if isinstance(v, (dict, list)):
+        from pathway_tpu.engine.value import Json
+
+        return Json(v)
+    return v
+
+
+def parse_object(
+    payload: bytes, format: str, schema
+) -> Iterator[Dict[str, Any]]:
+    """Parse one object's bytes into rows.
+
+    formats: binary (one row, raw bytes), plaintext (row per line),
+    plaintext_by_object (one row, whole text), json/jsonlines (row per JSON
+    line), csv (header row + DictReader).
+    """
+    if format == "binary":
+        yield {"data": payload}
+        return
+    if format in ("plaintext", "plaintext_by_object", "plaintext_by_file"):
+        text = payload.decode(errors="replace")
+        if format == "plaintext":
+            for line in text.splitlines():
+                yield {"data": line}
+        else:
+            yield {"data": text}
+        return
+    if format in ("json", "jsonlines"):
+        names = set(schema.keys())
+        for line in payload.decode(errors="replace").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            yield {
+                k: coerce_json_value(v, schema[k].dtype)
+                for k, v in obj.items()
+                if k in names
+            }
+        return
+    if format == "csv":
+        names = set(schema.keys())
+        reader = csv_mod.DictReader(
+            io_mod.StringIO(payload.decode(errors="replace"))
+        )
+        for rec in reader:
+            yield {
+                k: parse_csv_value(v, schema[k].dtype)
+                for k, v in rec.items()
+                if k in names
+            }
+        return
+    raise ValueError(f"unknown format {format!r}")

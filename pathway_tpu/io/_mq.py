@@ -1,0 +1,261 @@
+"""Shared message-queue connector machinery (kafka / redpanda / nats / mqtt).
+
+TPU-native equivalent of the reference's Rust MQ reader/writer layer
+(reference: src/connectors/data_storage.rs — Kafka via rdkafka, NATS via
+async-nats, MQTT via rumqttc; topic routing at data_storage.rs:193). The
+broker client is abstracted behind `MessageQueueClient`, so each backend
+module supplies a thin adapter over its (optional, gated) client library,
+and unit tests inject an in-memory fake broker.
+
+Message payload parsing follows the reference's Parser taxonomy
+(src/connectors/data_format.rs): raw (bytes), plaintext (utf-8 line),
+json (JsonLinesParser:1630), dsv (DsvParser:522).
+"""
+
+from __future__ import annotations
+
+import csv as csv_mod
+import io as io_mod
+import json
+import time as time_mod
+from typing import Any, Dict, Iterable, Optional, Sequence, Tuple
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.schema import ColumnSchema, schema_from_columns
+from pathway_tpu.io._connector_runtime import (
+    ConnectorSubjectBase,
+    connector_table,
+)
+from pathway_tpu.io._writer import OutputWriter, RowEvent, attach_writer, jsonable
+
+
+class MessageQueueClient:
+    """Minimal broker-client interface.
+
+    poll() -> iterable of (key: bytes|None, payload: bytes, meta: dict)
+    messages available now (may block briefly); None when the stream is
+    finished (static mode / closed broker).
+    """
+
+    def poll(self, timeout: float) -> Optional[Iterable[Tuple[Optional[bytes], bytes, dict]]]:
+        raise NotImplementedError
+
+    def produce(self, topic: str, key: Optional[bytes], payload: bytes) -> None:
+        raise NotImplementedError
+
+    def commit(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    # persistence hooks: opaque resume cursor
+    def position(self):
+        return None
+
+    def seek(self, position) -> None:
+        pass
+
+
+def raw_schema():
+    return schema_from_columns(
+        {"data": ColumnSchema(name="data", dtype=dt.BYTES)}, name="MQRawSchema"
+    )
+
+
+def plaintext_schema():
+    return schema_from_columns(
+        {"data": ColumnSchema(name="data", dtype=dt.STR)}, name="MQPlaintextSchema"
+    )
+
+
+def _coerce(v, dtype):
+    core = dt.unoptionalize(dtype)
+    if core is dt.JSON:
+        from pathway_tpu.engine.value import Json
+
+        return v if isinstance(v, Json) else Json(v)
+    if core is dt.FLOAT and isinstance(v, int):
+        return float(v)
+    if isinstance(v, (dict, list)):
+        from pathway_tpu.engine.value import Json
+
+        return Json(v)
+    return v
+
+
+def parse_payload(
+    payload: bytes,
+    format: str,
+    schema,
+    *,
+    delimiter: str = ",",
+) -> Iterable[Dict[str, Any]]:
+    """Parse one message payload into zero-or-more rows (reference parser
+    dispatch: data_format.rs JsonLinesParser:1630 / DsvParser:522 /
+    IdentityParser:894)."""
+    if format == "raw":
+        yield {"data": payload}
+        return
+    if format == "plaintext":
+        yield {"data": payload.decode(errors="replace").rstrip("\n")}
+        return
+    if format == "json":
+        names = set(schema.keys())
+        for line in payload.decode(errors="replace").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            yield {
+                k: _coerce(v, schema[k].dtype) for k, v in obj.items() if k in names
+            }
+        return
+    if format in ("dsv", "csv"):
+        names = list(schema.keys())
+        text = payload.decode(errors="replace")
+        reader = csv_mod.reader(io_mod.StringIO(text), delimiter=delimiter)
+        for rec in reader:
+            if not rec:
+                continue
+            yield {
+                k: _parse_text(v, schema[k].dtype)
+                for k, v in zip(names, rec)
+            }
+        return
+    raise ValueError(f"unknown message format {format!r}")
+
+
+def _parse_text(text, dtype):
+    core = dt.unoptionalize(dtype)
+    try:
+        if core is dt.INT:
+            return int(text)
+        if core is dt.FLOAT:
+            return float(text)
+        if core is dt.BOOL:
+            return text.strip().lower() in ("true", "1", "yes", "on")
+    except ValueError:
+        return None
+    return text
+
+
+class MessageQueueSubject(ConnectorSubjectBase):
+    """Reader thread: polls the broker client, parses, pushes rows
+    (reference: Connector::run reader loop, src/connectors/mod.rs:523)."""
+
+    def __init__(
+        self,
+        client_factory,
+        format: str,
+        schema,
+        mode: str = "streaming",
+        poll_timeout: float = 0.2,
+        delimiter: str = ",",
+    ):
+        super().__init__()
+        self.client_factory = client_factory
+        self.format = format
+        self.schema = schema
+        self.mode = mode
+        self.poll_timeout = poll_timeout
+        self.delimiter = delimiter
+        self._client = None
+        self._resume_position = None
+
+    def run(self) -> None:
+        self._client = self.client_factory()
+        if self._resume_position is not None:
+            # resume from the persisted cursor instead of replaying the
+            # stream (reference: Reader::seek, data_storage.rs:398)
+            self._client.seek(self._resume_position)
+        try:
+            while True:
+                batch = self._client.poll(self.poll_timeout)
+                if batch is None:
+                    return  # stream finished
+                got = False
+                for key, payload, meta in batch:
+                    got = True
+                    for row in parse_payload(
+                        payload,
+                        self.format,
+                        self.schema,
+                        delimiter=self.delimiter,
+                    ):
+                        self.next(**row)
+                if got:
+                    self.commit()
+                    self._client.commit()
+                elif self.mode == "static":
+                    return
+        finally:
+            self._client.close()
+
+    def _persisted_state(self):
+        if self._client is None:
+            return None
+        return {"position": self._client.position()}
+
+    def _restore_persisted_state(self, state) -> None:
+        if state and state.get("position") is not None:
+            # applied when the client is created
+            self._resume_position = state["position"]
+
+
+def mq_read(
+    client_factory,
+    *,
+    schema=None,
+    format: str = "raw",
+    mode: str = "streaming",
+    name: str | None = None,
+    delimiter: str = ",",
+):
+    if schema is None:
+        schema = plaintext_schema() if format == "plaintext" else raw_schema()
+
+    def factory():
+        return MessageQueueSubject(
+            client_factory, format, schema, mode=mode, delimiter=delimiter
+        )
+
+    return connector_table(schema, factory, mode=mode, name=name)
+
+
+class MessageQueueOutputWriter(OutputWriter):
+    """Formats each delta as a message and produces to a topic (reference:
+    Kafka/NATS/MQTT writers in data_storage.rs; JsonLines formatter
+    data_format.rs:2059)."""
+
+    def __init__(self, client, topic: str, *, format: str = "json", key_column: str | None = None):
+        self.client = client
+        self.topic = topic
+        self.format = format
+        self.key_column = key_column
+
+    def write_batch(self, events: Sequence[RowEvent]) -> None:
+        for ev in events:
+            obj = {k: jsonable(v) for k, v in ev.values.items()}
+            obj["time"] = ev.time
+            obj["diff"] = ev.diff
+            payload = json.dumps(obj).encode()
+            key = None
+            if self.key_column is not None:
+                kv = ev.values.get(self.key_column)
+                key = str(jsonable(kv)).encode() if kv is not None else None
+            self.client.produce(self.topic, key, payload)
+
+    def flush(self) -> None:
+        self.client.commit()
+
+    def close(self) -> None:
+        self.client.close()
+
+
+def mq_write(table, client, topic: str, *, format: str = "json", key_column: str | None = None, name: str | None = None) -> None:
+    attach_writer(
+        table,
+        MessageQueueOutputWriter(client, topic, format=format, key_column=key_column),
+        name=name,
+    )

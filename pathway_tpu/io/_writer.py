@@ -1,0 +1,123 @@
+"""Shared output-writer machinery for io sinks.
+
+TPU-native equivalent of the reference Writer trait + ConsolidateForOutput
+(reference: src/connectors/data_storage.rs:660 `trait Writer`,
+src/engine/dataflow/operators/output.rs — updates grouped into per-time
+batches before hitting the backend). Every DB/MQ writer module builds on
+`attach_writer`, which batches the change stream per engine time and hands
+`RowEvent` batches to a backend-specific `OutputWriter`.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+from dataclasses import dataclass
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from pathway_tpu.internals.parse_graph import G
+
+
+@dataclass
+class RowEvent:
+    """One change-stream delta (reference: FormatterContext values+diff,
+    src/connectors/data_format.rs:474)."""
+
+    key: Any
+    values: Dict[str, Any]
+    time: int
+    diff: int  # +1 insert / -1 delete
+
+
+class OutputWriter:
+    """Backend writer interface (reference: data_storage.rs:660).
+
+    `write_batch` receives all deltas of one closed engine time, in order.
+    """
+
+    def write_batch(self, events: Sequence[RowEvent]) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:  # called after each time
+        pass
+
+    def close(self) -> None:  # called at end of stream
+        pass
+
+
+def attach_writer(table, writer: OutputWriter, *, name: str | None = None) -> None:
+    """Route `table`'s change stream into `writer`, batched per engine time
+    (reference: ConsolidateForOutput grouping, operators/output.rs)."""
+    column_names = table.column_names()
+
+    def attach(ctx, nodes):
+        from pathway_tpu.engine.engine import SubscribeNode
+
+        (node,) = nodes
+        pending: List[RowEvent] = []
+
+        def on_change(key, row, time, is_addition):
+            pending.append(
+                RowEvent(
+                    key=key,
+                    values={c: row[c] for c in column_names},
+                    time=time,
+                    diff=1 if is_addition else -1,
+                )
+            )
+
+        def on_time_end(time):
+            if pending:
+                writer.write_batch(list(pending))
+                pending.clear()
+            writer.flush()
+
+        def on_end():
+            if pending:
+                writer.write_batch(list(pending))
+                pending.clear()
+            writer.close()
+
+        SubscribeNode(
+            ctx.engine,
+            node,
+            on_change=on_change,
+            on_time_end=on_time_end,
+            on_end=on_end,
+            column_names=column_names,
+        )
+
+    G.add_sink([table], attach)
+
+
+def jsonable(v):
+    """Engine Value -> plain JSON-serializable (reference: JsonLinesFormatter
+    value conversion, data_format.rs:2059)."""
+    from pathway_tpu.engine.value import Json, Pointer
+
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, Pointer):
+        return repr(v)
+    if isinstance(v, bytes):
+        return v.decode(errors="replace")
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, datetime.datetime):
+        return v.isoformat()
+    if isinstance(v, datetime.timedelta):
+        return v.total_seconds()
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v
+
+
+def format_json_event(event: RowEvent, *, time_name: str = "time", diff_name: str = "diff") -> str:
+    obj = {k: jsonable(v) for k, v in event.values.items()}
+    obj[time_name] = event.time
+    obj[diff_name] = event.diff
+    return json.dumps(obj)

@@ -150,35 +150,11 @@ class _FsSubject(ConnectorSubjectBase):
             self._seen.update(state["seen"])
 
 
-def _parse_csv_value(text, dtype: dt.DType):
-    if text is None:
-        return None
-    core = dt.unoptionalize(dtype)
-    try:
-        if core is dt.INT:
-            return int(text)
-        if core is dt.FLOAT:
-            return float(text)
-        if core is dt.BOOL:
-            return text.strip().lower() in ("true", "1", "yes", "on")
-    except ValueError:
-        return None
-    return text
-
-
-def _coerce_json_value(v, dtype: dt.DType):
-    core = dt.unoptionalize(dtype)
-    if core is dt.JSON:
-        from pathway_tpu.engine.value import Json
-
-        return Json(v)
-    if core is dt.FLOAT and isinstance(v, int):
-        return float(v)
-    if isinstance(v, (dict, list)):
-        from pathway_tpu.engine.value import Json
-
-        return Json(v)
-    return v
+# single shared implementation in _formats (also used by s3/minio)
+from pathway_tpu.io._formats import (  # noqa: E402
+    coerce_json_value as _coerce_json_value,
+    parse_csv_value as _parse_csv_value,
+)
 
 
 def read(

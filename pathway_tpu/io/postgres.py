@@ -1,0 +1,168 @@
+"""pw.io.postgres — PostgreSQL writers (reference: python/pathway/io/postgres
+write:22, write_snapshot:141; Rust formatters PsqlUpdates / PsqlSnapshot,
+src/connectors/data_format.rs:1821,1880).
+
+SQL statement generation is pure and unit-testable; execution needs a DBAPI
+connection — psycopg/psycopg2 if installed, or any connection injected via
+`_connection` (e.g. sqlite3 in tests, modulo placeholder style).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+from pathway_tpu.io._writer import OutputWriter, RowEvent, attach_writer, jsonable
+
+
+def _connection_string_from_settings(settings: dict) -> str:
+    return " ".join(f"{k}={v}" for k, v in settings.items())
+
+
+def _connect(postgres_settings: dict):
+    try:
+        import psycopg  # type: ignore
+
+        return psycopg.connect(_connection_string_from_settings(postgres_settings))
+    except ImportError:
+        pass
+    try:
+        import psycopg2  # type: ignore
+
+        return psycopg2.connect(**postgres_settings)
+    except ImportError:
+        raise ImportError(
+            "pw.io.postgres requires psycopg or psycopg2; install one or "
+            "inject a DBAPI connection via _connection"
+        )
+
+
+def build_insert_statement(
+    table_name: str, columns: Sequence[str], *, placeholder: str = "%s"
+) -> str:
+    """INSERT used by the updates writer (reference: PsqlUpdatesFormatter,
+    data_format.rs:1821 — appends time/diff columns)."""
+    cols = ", ".join(list(columns) + ["time", "diff"])
+    ph = ", ".join([placeholder] * (len(columns) + 2))
+    return f"INSERT INTO {table_name} ({cols}) VALUES ({ph})"
+
+
+def build_snapshot_statements(
+    table_name: str,
+    columns: Sequence[str],
+    primary_key: Sequence[str],
+    *,
+    placeholder: str = "%s",
+) -> Tuple[str, str]:
+    """(upsert, delete) used by the snapshot writer (reference:
+    PsqlSnapshotFormatter, data_format.rs:1880)."""
+    cols = ", ".join(columns)
+    ph = ", ".join([placeholder] * len(columns))
+    pk = ", ".join(primary_key)
+    updates = ", ".join(
+        f"{c}=EXCLUDED.{c}" for c in columns if c not in primary_key
+    )
+    upsert = (
+        f"INSERT INTO {table_name} ({cols}) VALUES ({ph}) "
+        f"ON CONFLICT ({pk}) DO UPDATE SET {updates}"
+    )
+    where = " AND ".join(f"{c}={placeholder}" for c in primary_key)
+    delete = f"DELETE FROM {table_name} WHERE {where}"
+    return upsert, delete
+
+
+class PostgresUpdatesWriter(OutputWriter):
+    def __init__(self, connection, table_name: str, columns: Sequence[str], *, placeholder: str = "%s"):
+        self.conn = connection
+        self.columns = list(columns)
+        self.stmt = build_insert_statement(table_name, columns, placeholder=placeholder)
+
+    def write_batch(self, events: Sequence[RowEvent]) -> None:
+        cur = self.conn.cursor()
+        for ev in events:
+            vals = [jsonable(ev.values[c]) for c in self.columns]
+            cur.execute(self.stmt, vals + [ev.time, ev.diff])
+        self.conn.commit()
+
+    def close(self) -> None:
+        self.conn.close()
+
+
+class PostgresSnapshotWriter(OutputWriter):
+    def __init__(self, connection, table_name: str, columns: Sequence[str], primary_key: Sequence[str], *, placeholder: str = "%s"):
+        self.conn = connection
+        self.columns = list(columns)
+        self.primary_key = list(primary_key)
+        self.upsert, self.delete = build_snapshot_statements(
+            table_name, columns, primary_key, placeholder=placeholder
+        )
+
+    def write_batch(self, events: Sequence[RowEvent]) -> None:
+        cur = self.conn.cursor()
+        # within one time, deletions before insertions so upserts win
+        for ev in sorted(events, key=lambda e: e.diff):
+            if ev.diff > 0:
+                cur.execute(
+                    self.upsert, [jsonable(ev.values[c]) for c in self.columns]
+                )
+            else:
+                cur.execute(
+                    self.delete,
+                    [jsonable(ev.values[c]) for c in self.primary_key],
+                )
+        self.conn.commit()
+
+    def close(self) -> None:
+        self.conn.close()
+
+
+def write(
+    table,
+    postgres_settings: dict,
+    table_name: str,
+    *,
+    max_batch_size: int | None = None,
+    init_mode: str = "default",
+    name: str | None = None,
+    _connection=None,
+    _placeholder: str = "%s",
+    **kwargs,
+) -> None:
+    """Append the change stream (with time/diff columns) to a Postgres table
+    (reference: io/postgres write:22)."""
+    conn = _connection if _connection is not None else _connect(postgres_settings)
+    attach_writer(
+        table,
+        PostgresUpdatesWriter(
+            conn, table_name, table.column_names(), placeholder=_placeholder
+        ),
+        name=name,
+    )
+
+
+def write_snapshot(
+    table,
+    postgres_settings: dict,
+    table_name: str,
+    primary_key: list[str],
+    *,
+    max_batch_size: int | None = None,
+    init_mode: str = "default",
+    name: str | None = None,
+    _connection=None,
+    _placeholder: str = "%s",
+    **kwargs,
+) -> None:
+    """Maintain the table as an up-to-date Postgres snapshot keyed by
+    primary_key (reference: io/postgres write_snapshot:141)."""
+    conn = _connection if _connection is not None else _connect(postgres_settings)
+    attach_writer(
+        table,
+        PostgresSnapshotWriter(
+            conn,
+            table_name,
+            table.column_names(),
+            primary_key,
+            placeholder=_placeholder,
+        ),
+        name=name,
+    )
