@@ -147,6 +147,28 @@ def _layer_norm(x, scale, bias, eps=1e-6):
     return (out * scale + bias).astype(x.dtype)
 
 
+def _attention(q, k, v, mask, causal: bool, use_flash):
+    """Dispatch between the Pallas flash kernel (TPU; O(L) memory) and the
+    dense XLA path. q,k,v: [B,H,L,D]; mask: [B,L]."""
+    import jax
+    import jax.numpy as jnp
+
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash:
+        from pathway_tpu.ops.kernels import flash_attention
+
+        return flash_attention(q, k, v, mask, causal=causal)
+
+    # dense path shares the flash kernel's numerical definition (it is also
+    # the kernel's custom_vjp backward), so the two can't drift apart
+    from pathway_tpu.ops.kernels.flash_attention import _reference_attention
+
+    return _reference_attention(
+        q, k, v, mask, 1.0 / np.sqrt(q.shape[3]), causal
+    )
+
+
 def forward(
     params,
     config: TransformerConfig,
@@ -154,6 +176,7 @@ def forward(
     mask,
     *,
     return_hidden: bool = False,
+    use_flash: Optional[bool] = None,
 ):
     """Encoder/decoder forward. ids, mask: [B, L] int32. Returns pooled
     embeddings [B, H] (pooling != none), else logits [B, L, V]."""
@@ -163,12 +186,6 @@ def forward(
     b, l = ids.shape
     x = params["embed"][ids] + params["pos_embed"][:l][None, :, :]
     x = x.astype(compute_dtype)
-    attn_mask = mask[:, None, None, :].astype(jnp.float32)  # [B,1,1,L]
-    neg = jnp.asarray(-1e9, dtype=jnp.float32)
-    bias = (1.0 - attn_mask) * neg
-    if config.causal:
-        causal = jnp.tril(jnp.ones((l, l), dtype=jnp.float32))
-        bias = bias + (1.0 - causal)[None, None, :, :] * neg
 
     heads, hd = config.heads, config.head_dim
     for layer in params["layers"]:
@@ -181,17 +198,8 @@ def forward(
         q = q.reshape(b, l, heads, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, l, heads, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, l, heads, hd).transpose(0, 2, 1, 3)
-        scores = (
-            jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
-            / np.sqrt(hd)
-            + bias
-        )
-        probs = jnp.exp(
-            scores - scores.max(-1, keepdims=True)
-        )
-        probs = probs / (probs.sum(-1, keepdims=True) + 1e-9)
-        probs = probs.astype(compute_dtype)
-        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        ctx = _attention(q, k, v, mask, config.causal, use_flash)
+        ctx = ctx.astype(compute_dtype)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, l, config.hidden)
         x = x + (
             ctx @ layer["out"].astype(compute_dtype)

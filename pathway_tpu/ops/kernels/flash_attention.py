@@ -1,0 +1,235 @@
+"""Flash attention (forward) as a Pallas TPU kernel.
+
+Blocked online-softmax attention: grid (batch*heads, Lq/bq, Lk/bk) with the
+kv dimension iterated sequentially so running max / normalizer / accumulator
+live in VMEM scratch across kv steps. The [L, L] score matrix never touches
+HBM — the win that lets the decoder (Mistral-7B-class geometry,
+reference llms.py:456 HFPipelineChat) run long contexts.
+
+Backward: custom_vjp whose bwd recomputes standard attention (rematerialized
+— the classic flash trade of FLOPs for HBM).
+
+Off-TPU the same kernel runs in interpreter mode so the CPU test mesh
+exercises the identical code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, kvmask_ref, o_ref, m_scr, l_scr, acc_scr,
+            *, sm_scale: float, causal: bool, block_q: int, block_k: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)  # [bq, d]
+    k = k_ref[0].astype(jnp.float32)  # [bk, d]
+    v = v_ref[0].astype(jnp.float32)  # [bk, d]
+
+    s = jax.lax.dot_general(
+        q, k,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale  # [bq, bk]
+
+    # padding mask on kv positions: kvmask_ref [1, 1, bk] ∈ {0,1}
+    kvm = kvmask_ref[0, 0].astype(jnp.float32)  # [bk]
+    s = s + (1.0 - kvm)[None, :] * NEG_INF
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+    m_prev = m_scr[:, 0:1]                        # [bq, 1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)     # [bq, 1]
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)               # rescale of old state
+    p = jnp.exp(s - m_new)                        # [bq, bk]
+    l_new = alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p, v,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        denom = l_scr[:, 0:1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def _pad_to(x, axis: int, multiple: int, value=0.0):
+    import jax.numpy as jnp
+
+    size = x.shape[axis]
+    rem = (-size) % multiple
+    if rem == 0:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, rem)
+    return jnp.pad(x, pads, constant_values=value)
+
+
+def _flash_fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k,
+               interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    # TPU tiling: pad head_dim to 128 lanes, seq blocks to the block sizes.
+    d_pad = max(128, ((d + 127) // 128) * 128)
+    q = _pad_to(_pad_to(q, 3, d_pad), 2, block_q)
+    k = _pad_to(_pad_to(k, 3, d_pad), 2, block_k)
+    v = _pad_to(_pad_to(v, 3, d_pad), 2, block_k)
+    kv_mask = _pad_to(kv_mask, 1, block_k)  # [b, lk_pad]
+    lq_pad, lk_pad = q.shape[2], k.shape[2]
+
+    qf = q.reshape(b * h, lq_pad, d_pad)
+    kf = k.reshape(b * h, lk_pad, d_pad)
+    vf = v.reshape(b * h, lk_pad, d_pad)
+
+    grid = (b * h, lq_pad // block_q, lk_pad // block_k)
+
+    kernel = functools.partial(
+        _kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, d_pad),
+                lambda bh, qi, ki: (bh, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d_pad),
+                lambda bh, qi, ki: (bh, ki, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d_pad),
+                lambda bh, qi, ki: (bh, ki, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k),
+                lambda bh, qi, ki: (bh // h, 0, ki),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d_pad),
+            lambda bh, qi, ki: (bh, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq_pad, d_pad), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running normalizer
+            pltpu.VMEM((block_q, d_pad), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, kv_mask.reshape(b, 1, lk_pad))
+
+    out = out.reshape(b, h, lq_pad, d_pad)[:, :, :lq, :d]
+    return out
+
+
+def _reference_attention(q, k, v, kv_mask, sm_scale, causal):
+    import jax.numpy as jnp
+
+    lq, lk = q.shape[2], k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    s = s + (1.0 - kv_mask[:, None, None, :].astype(jnp.float32)) * NEG_INF
+    if causal:
+        qp = jnp.arange(lq)[:, None]
+        kp = jnp.arange(lk)[None, :]
+        s = jnp.where((qp >= kp)[None, None], s, NEG_INF)
+    p = jnp.exp(s - s.max(-1, keepdims=True))
+    p = p / (p.sum(-1, keepdims=True) + 1e-30)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_attn(sm_scale: float, causal: bool, block_q: int, block_k: int,
+               interpret: bool):
+    """Cached jitted flash attention for a given static configuration —
+    repeated calls with the same shapes hit the XLA compile cache instead of
+    re-tracing (one device dispatch per call)."""
+    import jax
+
+    @jax.custom_vjp
+    def attn(q, k, v, kv_mask):
+        return _flash_fwd(q, k, v, kv_mask, sm_scale, causal,
+                          block_q, block_k, interpret)
+
+    def attn_fwd(q, k, v, kv_mask):
+        return attn(q, k, v, kv_mask), (q, k, v, kv_mask)
+
+    def attn_bwd(res, g):
+        q, k, v, kv_mask = res
+        _, vjp = jax.vjp(
+            lambda q, k, v: _reference_attention(
+                q, k, v, kv_mask, sm_scale, causal
+            ),
+            q, k, v,
+        )
+        dq, dk, dv = vjp(g)
+        return dq, dk, dv, None
+
+    attn.defvjp(attn_fwd, attn_bwd)
+    return jax.jit(attn)
+
+
+def flash_attention(q, k, v, kv_mask=None, *, causal=False, sm_scale=None,
+                    block_q=128, block_k=128, interpret=None):
+    """Fused attention. q,k,v: [B, H, L, D]; kv_mask: [B, Lk] (1 = valid).
+
+    Differentiable: forward runs the Pallas kernel, backward rematerializes
+    standard attention (flash FLOPs-for-HBM trade).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    if kv_mask is None:
+        kv_mask = jnp.ones((k.shape[0], k.shape[2]), dtype=jnp.int32)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, max(8, q.shape[2]))
+    block_k = min(block_k, max(8, k.shape[2]))
+    attn = _make_attn(float(sm_scale), causal, block_q, block_k, interpret)
+    return attn(q, k, v, kv_mask)

@@ -1,0 +1,145 @@
+"""Streaming KNN similarity + top-k as a Pallas TPU kernel.
+
+The reference computes the full [Q, N] score matrix per query batch in Rust
+ndarray and then sorts each row
+(src/external_integration/brute_force_knn_integration.rs:52-110). Here the
+index is streamed through VMEM block-by-block: for each [block_n, D] slab we
+compute scores on the MXU and reduce them to a per-block top-k with an
+iterative masked-argmax (k is small and static), writing only [Q, 128] per
+block. A final lax.top_k over the (tiny) per-block candidates yields the
+global result — the [Q, N] matrix never exists in HBM, so index capacity is
+bounded by HBM, not by score-matrix scratch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+NEG_INF = -1e30
+
+
+def _block_kernel(q_ref, x_ref, valid_ref, scores_ref, idx_ref,
+                  *, k: int, metric: str, block_n: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ni = pl.program_id(0)
+    q = q_ref[:].astype(jnp.float32)      # [Qp, D]
+    x = x_ref[:].astype(jnp.float32)      # [bn, D]
+    valid = valid_ref[0].astype(jnp.float32)  # [bn]
+
+    s = jax.lax.dot_general(
+        q, x,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [Qp, bn]
+    if metric == "l2sq":
+        # scores = 2 q·x - ||x||^2 - ||q||^2 ; the q term is rank-invariant
+        sq_x = jnp.sum(x * x, axis=1)
+        s = 2.0 * s - sq_x[None, :]
+    s = s + (1.0 - valid)[None, :] * NEG_INF
+
+    qp = q.shape[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, (qp, block_n), 1)
+    global_idx = ni * block_n + col
+
+    out_s = jnp.full((qp, 128), NEG_INF, dtype=jnp.float32)
+    out_i = jnp.zeros((qp, 128), dtype=jnp.int32)
+    # iterative top-k: k rounds of (argmax, record, mask)
+    for j in range(k):
+        m = jnp.max(s, axis=1, keepdims=True)            # [Qp, 1]
+        am = jnp.argmax(s, axis=1)                       # [Qp]
+        sel = col == am[:, None]                         # [Qp, bn] one-hot
+        gi = jnp.sum(jnp.where(sel, global_idx, 0), axis=1)  # [Qp]
+        slot = jax.lax.broadcasted_iota(jnp.int32, (qp, 128), 1) == j
+        out_s = jnp.where(slot, m, out_s)
+        out_i = jnp.where(slot, gi[:, None], out_i)
+        s = jnp.where(sel, NEG_INF, s)
+
+    scores_ref[0] = out_s
+    idx_ref[0] = out_i
+
+
+def _pad2(x, r_mult, c_mult, value=0.0):
+    import jax.numpy as jnp
+
+    r = (-x.shape[0]) % r_mult
+    c = (-x.shape[1]) % c_mult if x.ndim > 1 else 0
+    if r == 0 and c == 0:
+        return x
+    pads = [(0, r)] + ([(0, c)] if x.ndim > 1 else [])
+    return jnp.pad(x, pads, constant_values=value)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_knn(k: int, metric: str, block_n: int, interpret: bool):
+    """Cached jitted streaming-KNN for static (k, metric, block_n)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def run(index, valid, queries):
+        n, d = index.shape
+        qn = queries.shape[0]
+        bn = min(block_n, max(128, n))
+        d_pad = max(128, ((d + 127) // 128) * 128)
+        index_p = _pad2(index, bn, d_pad)
+        valid_f = _pad2(valid.astype(jnp.float32), bn, 1)
+        queries_p = _pad2(queries, 8, d_pad)
+        n_pad, qp = index_p.shape[0], queries_p.shape[0]
+        nb = n_pad // bn
+
+        kernel = functools.partial(
+            _block_kernel, k=k, metric=metric, block_n=bn
+        )
+        scores, idx = pl.pallas_call(
+            kernel,
+            grid=(nb,),
+            in_specs=[
+                pl.BlockSpec((qp, d_pad), lambda ni: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((bn, d_pad), lambda ni: (ni, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bn), lambda ni: (0, ni),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, qp, 128), lambda ni: (ni, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, qp, 128), lambda ni: (ni, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((nb, qp, 128), jnp.float32),
+                jax.ShapeDtypeStruct((nb, qp, 128), jnp.int32),
+            ],
+            interpret=interpret,
+        )(queries_p, index_p, valid_f.reshape(1, n_pad))
+
+        # merge the per-block candidates (tiny): [nb, Q, 128] -> [Q, nb*128]
+        cand_s = scores.transpose(1, 0, 2).reshape(qp, nb * 128)
+        cand_i = idx.transpose(1, 0, 2).reshape(qp, nb * 128)
+        top_s, pos = jax.lax.top_k(cand_s, k)
+        top_i = jnp.take_along_axis(cand_i, pos, axis=1)
+        return top_s[:qn], top_i[:qn]
+
+    return jax.jit(run)
+
+
+def knn_topk(index, valid, queries, k: int, *, metric: str = "cos",
+             block_n: int = 512, interpret=None):
+    """Global top-k of similarity(queries, index) without materializing
+    [Q, N]. index: [N, D]; valid: [N] (1 = live slot); queries: [Q, D].
+    metric: cos | ip | l2sq (cos expects pre-normalized rows — the caller
+    normalizes once at insert time, not per query).
+    Returns (scores [Q, k] f32, idx [Q, k] i32)."""
+    import jax
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    assert k <= 128, "kernel packs per-block candidates into 128 lanes"
+    return _make_knn(k, metric, int(block_n), interpret)(
+        index, valid, queries
+    )

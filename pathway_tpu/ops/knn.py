@@ -60,6 +60,37 @@ def _compiled_search(k: int, metric: str):
     import jax
     import jax.numpy as jnp
 
+    if jax.default_backend() == "tpu" and k <= 128:
+        # fused Pallas path: stream the index through VMEM, never build
+        # [Q,N]. Index rows for cos are normalized once at insert time
+        # (DeviceKnnIndex), so only the [Q,d] query block is normalized here.
+        from pathway_tpu.ops.kernels.knn_topk import knn_topk
+
+        kernel_metric = "ip" if metric == "cos" else metric
+
+        def search(index, valid, queries):
+            if metric == "cos":
+                queries = queries * (
+                    1.0 / (jnp.linalg.norm(queries, axis=1, keepdims=True)
+                           + 1e-30)
+                )
+            top_scores, top_idx = knn_topk(
+                index, valid, queries, k, metric=kernel_metric
+            )
+            if metric == "l2sq":
+                # kernel drops the rank-invariant -||q||^2 term; restore it
+                # so scores match the dense path exactly
+                sq_q = jnp.sum(queries * queries, axis=1, keepdims=True)
+                top_scores = top_scores - sq_q
+            # dead slots carry ~-1e30 sentinels; surface them as -inf so
+            # _format_rows drops them like the dense path does
+            top_scores = jnp.where(
+                top_scores < -1e29, -jnp.inf, top_scores
+            )
+            return top_scores, top_idx
+
+        return jax.jit(search)
+
     def search(index, valid, queries):
         scores = _similarity(index, valid, queries, metric)
         top_scores, top_idx = jax.lax.top_k(scores, k)
@@ -150,6 +181,22 @@ class DeviceKnnIndex:
     def __len__(self) -> int:
         return len(self._slot_of_key)
 
+    def _normalize(self, vectors):
+        """cos rows are normalized ONCE at insert time so searches never
+        re-read the whole buffer just to normalize it."""
+        if self.metric != "cos":
+            return vectors
+        if _is_device_array(vectors):
+            import jax.numpy as jnp
+
+            return vectors * (
+                1.0 / (jnp.linalg.norm(vectors, axis=-1, keepdims=True)
+                       + 1e-30)
+            )
+        return vectors / (
+            np.linalg.norm(vectors, axis=-1, keepdims=True) + 1e-30
+        )
+
     def add(self, key, vector) -> None:
         vector = np.asarray(vector, dtype=np.float32).reshape(-1)
         if vector.shape[0] != self.d:
@@ -157,7 +204,7 @@ class DeviceKnnIndex:
                 f"vector dim {vector.shape[0]} != index dim {self.d}"
             )
         slot = self._assign_slot(key)
-        self._dirty[slot] = vector
+        self._dirty[slot] = self._normalize(vector)
 
     def add_batch(self, keys, vectors) -> None:
         """vectors: [B, d] array (host or device)."""
@@ -175,10 +222,11 @@ class DeviceKnnIndex:
             )
             slot_valid = np.ones((len(slots),), dtype=bool)
             self._buffer, self._valid_dev = _compiled_update()(
-                self._buffer, self._valid_dev, slots, vectors, slot_valid
+                self._buffer, self._valid_dev, slots,
+                self._normalize(vectors), slot_valid
             )
             return
-        vectors = np.asarray(vectors, dtype=np.float32)
+        vectors = self._normalize(np.asarray(vectors, dtype=np.float32))
         for key, vec in zip(keys, vectors):
             slot = self._assign_slot(key)
             self._dirty[slot] = vec
