@@ -229,7 +229,10 @@ def flash_attention(q, k, v, kv_mask=None, *, causal=False, sm_scale=None,
         kv_mask = jnp.ones((k.shape[0], k.shape[2]), dtype=jnp.int32)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    block_q = min(block_q, max(8, q.shape[2]))
-    block_k = min(block_k, max(8, k.shape[2]))
+    # clamp blocks for short sequences, keeping the sublane (8) alignment
+    # Mosaic requires; inputs are padded up to the block size in _flash_fwd
+    round8 = lambda n: ((max(n, 8) + 7) // 8) * 8
+    block_q = min(block_q, round8(q.shape[2]))
+    block_k = min(block_k, round8(k.shape[2]))
     attn = _make_attn(float(sm_scale), causal, block_q, block_k, interpret)
     return attn(q, k, v, kv_mask)

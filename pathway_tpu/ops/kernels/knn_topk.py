@@ -83,7 +83,9 @@ def _make_knn(k: int, metric: str, block_n: int, interpret: bool):
     def run(index, valid, queries):
         n, d = index.shape
         qn = queries.shape[0]
-        bn = min(block_n, max(128, n))
+        # lane-aligned clamp: below 128 rows Mosaic needs the block to equal
+        # the (padded) array dim, so round n up to a 128 multiple
+        bn = min(block_n, ((max(n, 128) + 127) // 128) * 128)
         d_pad = max(128, ((d + 127) // 128) * 128)
         index_p = _pad2(index, bn, d_pad)
         valid_f = _pad2(valid.astype(jnp.float32), bn, 1)

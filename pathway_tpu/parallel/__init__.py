@@ -7,5 +7,16 @@ from pathway_tpu.parallel.mesh import (
     local_device_count,
     with_mesh,
 )
+from pathway_tpu.parallel.ring_attention import (
+    ring_attention,
+    ulysses_attention,
+)
 
-__all__ = ["default_mesh", "get_mesh", "local_device_count", "with_mesh"]
+__all__ = [
+    "default_mesh",
+    "get_mesh",
+    "local_device_count",
+    "with_mesh",
+    "ring_attention",
+    "ulysses_attention",
+]
