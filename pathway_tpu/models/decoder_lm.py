@@ -14,33 +14,40 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from pathway_tpu.models.tokenizer import HashTokenizer, encode_batch
-from pathway_tpu.models.transformer import (
-    MISTRAL_7B,
-    TINY_DECODER,
-    TransformerConfig,
-    TransformerLM,
+from pathway_tpu.models.decoder import (
+    MISTRAL_7B_DECODER,
+    TINY,
+    DecoderConfig,
+    generate_tokens,
+    init_decoder_params,
 )
+from pathway_tpu.models.tokenizer import HashTokenizer, encode_batch
 
 _model_cache: dict = {}
 
 
 class ChatModel:
+    """KV-cached decoder (models/decoder.py): prefill + lax.scan decode in
+    one jit — no host round trip per token (the reference loops a torch
+    pipeline on CPU/GPU, llms.py:456)."""
+
     def __init__(
         self,
         model: str = "tiny-decoder",
         *,
-        config: TransformerConfig | None = None,
+        config: DecoderConfig | None = None,
         seed: int = 2,
         max_len: int = 128,
     ):
+        import jax
+
         if config is None:
-            config = MISTRAL_7B if "mistral" in model.lower() else TINY_DECODER
+            config = MISTRAL_7B_DECODER if "mistral" in model.lower() else TINY
         self.name = model
         self.config = config
         self.max_len = min(max_len, config.max_len)
         self.tokenizer = HashTokenizer(vocab_size=config.vocab_size)
-        self.lm = TransformerLM(config, seed=seed)
+        self.params = init_decoder_params(jax.random.PRNGKey(seed), config)
 
     @classmethod
     def cached(cls, model: str = "tiny-decoder", **kw) -> "ChatModel":
@@ -54,13 +61,21 @@ class ChatModel:
         prompts: Sequence[str],
         *,
         max_new_tokens: int = 16,
+        temperature: float = 0.0,
     ) -> List[str]:
         if not prompts:
             return []
         ids, mask = encode_batch(
             self.tokenizer, list(prompts), max_len=self.max_len
         )
-        tokens = self.lm.generate(ids, mask, max_new_tokens=max_new_tokens)
+        # leave cache room for the new tokens
+        budget = self.config.max_len - max_new_tokens
+        if ids.shape[1] > budget:
+            ids, mask = ids[:, :budget], mask[:, :budget]
+        tokens = generate_tokens(
+            self.params, self.config, ids, mask,
+            max_new_tokens=max_new_tokens, temperature=temperature,
+        )
         return [
             self.tokenizer.decode(row) for row in tokens[: len(prompts)]
         ]
