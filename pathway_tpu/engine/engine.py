@@ -151,10 +151,21 @@ class Engine:
         self.finish()
 
     def _drain(self) -> None:
-        for _ in range(len(self.nodes) + 1):
+        # A delta can traverse at most the full node chain per pass, so a
+        # DAG settles within ~len(nodes) passes; the generous cap exists
+        # only to turn a buggy cyclic graph into a loud error instead of a
+        # hang — never to silently stop while data is still pending.
+        limit = 10 * len(self.nodes) + 100
+        for _ in range(limit):
             if not any(n.has_pending() for n in self.nodes):
-                break
+                return
             self.process_time(self.current_time + 1)
+        if any(n.has_pending() for n in self.nodes):
+            stuck = [n.name for n in self.nodes if n.has_pending()]
+            raise EngineError(
+                f"dataflow failed to settle after {limit} drain passes; "
+                f"nodes still pending: {stuck[:10]}"
+            )
 
     def finish(self) -> None:
         for node in self.nodes:

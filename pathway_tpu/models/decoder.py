@@ -186,8 +186,15 @@ def decoder_forward(params, config: DecoderConfig, ids, mask, *,
     n_rep = qh // kvh
     new_cache = [] if kv_cache is not None else None
 
-    if kv_cache is not None:
-        # [B, L, max_len] attention mask shared by all layers
+    is_prefill = (
+        kv_cache is not None
+        and isinstance(slot_offset, int)
+        and slot_offset == 0
+        and l > 1
+    )
+    if kv_cache is not None and not is_prefill:
+        # [B, L, max_len] attention mask shared by all layers (decode /
+        # chunked-prefill path; initial prefill uses the flash path below)
         slot_idx = jnp.arange(config.max_len)[None, None, :]
         q_slot = slot_offset + jnp.arange(l)[None, :, None]
         attend = (slot_idx <= q_slot) & (
@@ -213,18 +220,32 @@ def decoder_forward(params, config: DecoderConfig, ids, mask, *,
                 (0, 0, slot_offset, 0),
             )
             new_cache.append({"k": ck, "v": cv})
-            s = jnp.einsum(
-                "bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                _repeat_kv(ck.astype(jnp.float32), n_rep),
-                preferred_element_type=jnp.float32,
-            ) / np.sqrt(hd)
-            s = jnp.where(attend[:, None, :, :], s, -1e30)
-            p = jnp.exp(s - s.max(-1, keepdims=True))
-            p = p / (p.sum(-1, keepdims=True) + 1e-30)
-            ctx = jnp.einsum(
-                "bhqk,bhkd->bhqd", p.astype(compute_dtype),
-                _repeat_kv(cv.astype(compute_dtype), n_rep),
-            )
+            if is_prefill:
+                # Prefill: no cache slots beyond this call's L can be
+                # valid, so attention over the cache reduces to causal
+                # attention over this call's own K/V (keys masked by
+                # kv_valid's first L slots, per the cache-mode contract) —
+                # O(L) flash path instead of a dense [B, H, L, max_len]
+                # f32 score matrix.
+                from pathway_tpu.models.transformer import _attention
+
+                ctx = _attention(
+                    q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
+                    kv_valid[:, :l], True, use_flash,
+                ).astype(compute_dtype)
+            else:
+                s = jnp.einsum(
+                    "bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                    _repeat_kv(ck.astype(jnp.float32), n_rep),
+                    preferred_element_type=jnp.float32,
+                ) / np.sqrt(hd)
+                s = jnp.where(attend[:, None, :, :], s, -1e30)
+                p = jnp.exp(s - s.max(-1, keepdims=True))
+                p = p / (p.sum(-1, keepdims=True) + 1e-30)
+                ctx = jnp.einsum(
+                    "bhqk,bhkd->bhqd", p.astype(compute_dtype),
+                    _repeat_kv(cv.astype(compute_dtype), n_rep),
+                )
         else:
             from pathway_tpu.models.transformer import _attention
 
@@ -274,6 +295,7 @@ def _compiled_generate(config: DecoderConfig, max_new_tokens: int,
             [mask, jnp.zeros((b, config.max_len - l), dtype=mask.dtype)],
             axis=1,
         )
+        first_key, scan_rng = jax.random.split(rng)
         # ---- prefill: write the prompt into the cache
         logits, cache = decoder_forward(
             params, config, ids, mask, positions=positions,
@@ -282,7 +304,7 @@ def _compiled_generate(config: DecoderConfig, max_new_tokens: int,
         last_logit = jnp.take_along_axis(
             logits, (lengths - 1)[:, None, None], axis=1
         )[:, 0, :]  # [B, V]
-        first = sample(last_logit, rng)
+        first = sample(last_logit, first_key)
 
         def step(carry, inp):
             cache, kv_valid, tok = carry
@@ -301,7 +323,7 @@ def _compiled_generate(config: DecoderConfig, max_new_tokens: int,
             nxt = sample(logits[:, 0, :], key)
             return (cache, kv_valid, nxt), tok
 
-        keys = jax.random.split(rng, max_new_tokens)
+        keys = jax.random.split(scan_rng, max_new_tokens)
         ts = jnp.arange(max_new_tokens)
         _, toks = lax.scan(step, (cache, kv_valid, first), (ts, keys))
         return toks.T  # [B, max_new_tokens]
@@ -317,6 +339,14 @@ def generate_tokens(params, config: DecoderConfig, ids, mask, *,
     import jax
     import jax.numpy as jnp
 
+    l = int(np.asarray(ids).shape[1])
+    if l + max_new_tokens > config.max_len:
+        raise ValueError(
+            f"prompt_len ({l}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"the cache budget max_len ({config.max_len}); "
+            "lax.dynamic_update_slice would silently clamp and corrupt the "
+            "tail cache slots"
+        )
     fn = _compiled_generate(config, max_new_tokens, float(temperature))
     return np.asarray(
         fn(params, jnp.asarray(ids), jnp.asarray(mask),

@@ -21,7 +21,7 @@ from pathway_tpu.models.decoder import (
     generate_tokens,
     init_decoder_params,
 )
-from pathway_tpu.models.tokenizer import HashTokenizer, encode_batch
+from pathway_tpu.models.tokenizer import HashTokenizer
 
 _model_cache: dict = {}
 
@@ -65,13 +65,26 @@ class ChatModel:
     ) -> List[str]:
         if not prompts:
             return []
-        ids, mask = encode_batch(
-            self.tokenizer, list(prompts), max_len=self.max_len
-        )
-        # leave cache room for the new tokens
-        budget = self.config.max_len - max_new_tokens
-        if ids.shape[1] > budget:
-            ids, mask = ids[:, :budget], mask[:, :budget]
+        # Leave cache room for the new tokens; when a prompt overflows the
+        # budget keep its most recent tokens — the tail is what conditions
+        # the reply (the reference HF pipeline truncates the same end) —
+        # so encode unbounded first, then keep the tail, left-aligned.
+        budget = min(self.max_len, self.config.max_len - max_new_tokens)
+        if budget <= 0:
+            raise ValueError(
+                f"max_new_tokens ({max_new_tokens}) leaves no cache room "
+                f"for any prompt token (model max_len "
+                f"{self.config.max_len})"
+            )
+        encoded = [
+            self.tokenizer.encode(t, None)[-budget:] for t in prompts
+        ]
+        longest = max(len(e) for e in encoded)
+        ids = np.zeros((len(encoded), longest), dtype=np.int32)
+        mask = np.zeros_like(ids)
+        for r, e in enumerate(encoded):
+            ids[r, : len(e)] = e
+            mask[r, : len(e)] = 1
         tokens = generate_tokens(
             self.params, self.config, ids, mask,
             max_new_tokens=max_new_tokens, temperature=temperature,

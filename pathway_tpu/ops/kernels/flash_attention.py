@@ -229,10 +229,15 @@ def flash_attention(q, k, v, kv_mask=None, *, causal=False, sm_scale=None,
         kv_mask = jnp.ones((k.shape[0], k.shape[2]), dtype=jnp.int32)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    # clamp blocks for short sequences, keeping the sublane (8) alignment
-    # Mosaic requires; inputs are padded up to the block size in _flash_fwd
-    round8 = lambda n: ((max(n, 8) + 7) // 8) * 8
-    block_q = min(block_q, round8(q.shape[2]))
-    block_k = min(block_k, round8(k.shape[2]))
+    # Clamp blocks for short sequences; inputs are padded up to the block
+    # size in _flash_fwd. In interpret mode (CPU tests) sublane-aligned (8)
+    # blocks are fine and faster; on compiled TPU Mosaic wants the trailing
+    # block dim 128-lane aligned, so never clamp below 128 there.
+    if interpret:
+        round_up = lambda n: ((max(n, 8) + 7) // 8) * 8
+    else:
+        round_up = lambda n: ((max(n, 128) + 127) // 128) * 128
+    block_q = min(block_q, round_up(q.shape[2]))
+    block_k = min(block_k, round_up(k.shape[2]))
     attn = _make_attn(float(sm_scale), causal, block_q, block_k, interpret)
     return attn(q, k, v, kv_mask)

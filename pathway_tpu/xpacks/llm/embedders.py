@@ -26,7 +26,18 @@ class BaseEmbedder(UDF):
 
         result = self.func(".", **kwargs)
         if inspect.isawaitable(result):
-            result = asyncio.run(result)
+            # asyncio.run would explode if a loop is already running (e.g.
+            # called from inside the aiohttp server) — run the coroutine on
+            # a private loop in a helper thread instead.
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                result = asyncio.run(result)
+            else:
+                import concurrent.futures
+
+                with concurrent.futures.ThreadPoolExecutor(1) as pool:
+                    result = pool.submit(asyncio.run, result).result()
         return len(result)
 
     def __call__(self, input: Any, **kwargs) -> ColumnExpression:

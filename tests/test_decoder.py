@@ -72,3 +72,45 @@ def test_chat_model_generates_text():
                        max_new_tokens=4)
     assert len(outs) == 2
     assert all(isinstance(o, str) for o in outs)
+
+
+def test_generate_rejects_cache_overflow():
+    import pytest
+
+    config = TINY  # max_len=128
+    params = init_decoder_params(jax.random.PRNGKey(0), config)
+    ids = np.ones((1, 120), dtype=np.int32)
+    mask = np.ones_like(ids)
+    with pytest.raises(ValueError, match="cache budget"):
+        generate_tokens(params, config, ids, mask, max_new_tokens=16)
+
+
+def test_chat_model_truncates_keeping_tail():
+    from pathway_tpu.models.decoder_lm import ChatModel
+    from pathway_tpu.models.tokenizer import encode_batch
+
+    cm = ChatModel("tiny-decoder", max_len=128)
+    # budget = 128 - 8 = 120 < prompt tokens; long prompt must still work
+    words = [f"tok{i}" for i in range(300)]
+    long_prompt = " ".join(words)
+    out = cm.generate([long_prompt, "short"], max_new_tokens=8)
+    assert len(out) == 2 and all(isinstance(s, str) for s in out)
+    # truncated generation must equal generating from the explicit tail:
+    # the prompt tokenizes to one token per word, the budget is 120, so
+    # the kept context is exactly the last 120 words
+    ids, mask = encode_batch(cm.tokenizer, [long_prompt], max_len=cm.max_len)
+    assert ids.shape[1] == cm.max_len  # prompt really overflows the budget
+    budget = cm.config.max_len - 8
+    tail_prompt = " ".join(words[-budget:])
+    tail_out = cm.generate([tail_prompt], max_new_tokens=8)
+    assert out[0] == tail_out[0]
+
+
+def test_chat_model_rejects_zero_budget():
+    import pytest
+
+    from pathway_tpu.models.decoder_lm import ChatModel
+
+    cm = ChatModel("tiny-decoder", max_len=128)
+    with pytest.raises(ValueError, match="no cache room"):
+        cm.generate(["x"], max_new_tokens=cm.config.max_len)
