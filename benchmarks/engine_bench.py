@@ -1,0 +1,100 @@
+"""Engine micro-benchmarks (CPU-side dataflow; no TPU involved).
+
+Two claims measured, matching the reference's engine characteristics
+(reference: src/engine/reduce.rs semigroup reducers are O(delta) per group
+update; integration_tests/wordcount/base.py streams millions of lines):
+
+1. group-update flatness — the cost of ONE single-row update to a group must
+   not grow with the group's size (incremental accumulators, not full-group
+   recompute).
+2. wordcount streaming throughput — rows/s through source → groupby(word)
+   → count with per-batch consolidation.
+
+Run: python benchmarks/engine_bench.py   (prints one JSON line per metric)
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time as _time
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_events
+from pathway_tpu.engine.value import ref_scalar
+from pathway_tpu.internals.runner import run_tables
+from pathway_tpu.internals.schema import schema_from_types
+
+
+def _run_reduce(size, n_updates):
+    schema = schema_from_types(g=str, v=int)
+    events = [(2, (ref_scalar(i), ("g", i), 1)) for i in range(size)]
+    for j in range(n_updates):
+        events.append((4 + 2 * j, (ref_scalar(size + j), ("g", j), 1)))
+    t = table_from_events(schema, events)
+    res = t.groupby(t.g).reduce(
+        t.g,
+        cnt=pw.reducers.count(),
+        total=pw.reducers.sum(t.v),
+        mx=pw.reducers.max(t.v),
+    )
+    t0 = _time.perf_counter()
+    (capture,) = run_tables(res, record_stream=True)
+    elapsed = _time.perf_counter() - t0
+    assert list(capture.state.rows.values())[0][1] == size + n_updates
+    return elapsed
+
+
+def bench_group_update_flatness(sizes=(1_000, 10_000, 100_000), n_updates=200):
+    """Build one group of `size` rows at t=2, then apply `n_updates`
+    single-row inserts each at its own engine time. Per-update cost =
+    (run with updates) - (build-only run), isolating the streaming phase."""
+    per_update_ms = {}
+    for size in sizes:
+        build_only = _run_reduce(size, 0)
+        with_updates = _run_reduce(size, n_updates)
+        per_update_ms[size] = max(
+            1000.0 * (with_updates - build_only) / n_updates, 1e-4
+        )
+    flat_ratio = per_update_ms[sizes[-1]] / per_update_ms[sizes[0]]
+    print(json.dumps({
+        "metric": "group_update_ms_per_delta",
+        "value": round(per_update_ms[sizes[-1]], 4),
+        "unit": "ms/update @ group=100k (build-time subtracted)",
+        "per_size": {str(k): round(v, 4) for k, v in per_update_ms.items()},
+        "large_vs_small_ratio": round(flat_ratio, 2),
+    }))
+    return flat_ratio
+
+
+def bench_wordcount(n_rows=1_000_000, vocab=10_000, batch=20_000):
+    rng = random.Random(7)
+    words = [f"w{i}" for i in range(vocab)]
+    schema = schema_from_types(word=str)
+    events = []
+    t = 2
+    for i in range(n_rows):
+        events.append((t, (ref_scalar(i), (rng.choice(words),), 1)))
+        if (i + 1) % batch == 0:
+            t += 2
+    tab = table_from_events(schema, events)
+    res = tab.groupby(tab.word).reduce(tab.word, cnt=pw.reducers.count())
+    t0 = _time.perf_counter()
+    (capture,) = run_tables(res, record_stream=True)
+    elapsed = _time.perf_counter() - t0
+    total = sum(r[1] for r in capture.state.rows.values())
+    assert total == n_rows
+    rps = n_rows / elapsed
+    print(json.dumps({
+        "metric": "wordcount_rows_per_sec",
+        "value": round(rps),
+        "unit": "rows/s",
+        "n_rows": n_rows,
+        "elapsed_s": round(elapsed, 2),
+    }))
+    return rps
+
+
+if __name__ == "__main__":
+    bench_group_update_flatness()
+    bench_wordcount()

@@ -12,6 +12,7 @@ aggregations.
 
 from __future__ import annotations
 
+import heapq
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from pathway_tpu.engine.engine import Engine, Node
@@ -84,6 +85,14 @@ class JoinNode(Node):
         self.left_index: Dict[Any, Dict[Pointer, tuple]] = {}
         self.right_index: Dict[Any, Dict[Pointer, tuple]] = {}
         self.cache = _DiffCache()
+        # Inner joins with hash-pair ids are bilinear: emit
+        # ΔL⋈R_old + L_new⋈ΔR directly, O(delta·match) per batch, no
+        # emitted-output cache. Outer joins and id=left/right (which need
+        # pad-row transitions / duplicate-id detection) keep the
+        # affected-bucket diff path.
+        self._delta_mode = (
+            not left_outer and not right_outer and id_mode == "both"
+        )
 
     def _apply_side(
         self, index: Dict, deltas: List[Delta], key_fn: BatchFn, affected: Set
@@ -114,10 +123,67 @@ class JoinNode(Node):
             return rk
         return ref_scalar(lk, rk)
 
+    def _jvs_of(self, deltas: List[Delta], key_fn: BatchFn) -> List[Any]:
+        if not deltas:
+            return []
+        keys = [d[0] for d in deltas]
+        rows = ([d[1] for d in deltas],)
+        return key_fn(keys, rows)
+
+    @staticmethod
+    def _index_apply(index: Dict, jv: Any, key: Pointer, row: tuple, diff: int) -> None:
+        bucket = index.setdefault(jv, {})
+        if diff > 0:
+            bucket[key] = row
+        else:
+            bucket.pop(key, None)
+            if not bucket:
+                del index[jv]
+
+    def _delta_side(
+        self,
+        deltas: List[Delta],
+        jvs: List[Any],
+        own_index: Dict,
+        other_index: Dict,
+        left_side: bool,
+        out: List[Delta],
+    ) -> None:
+        """Join one side's deltas against the other side's current index,
+        applying each delta to the own index as it streams past."""
+        for (key, row, diff), jv in zip(deltas, jvs):
+            if isinstance(jv, Error):
+                self.log_error("Error value in join condition")
+                continue
+            jv = _freeze(jv)
+            for okey, orow in other_index.get(jv, {}).items():
+                if left_side:
+                    lk, lrow, rk, rrow = key, row, okey, orow
+                else:
+                    lk, lrow, rk, rrow = okey, orow, key, row
+                out.append((ref_scalar(lk, rk), (lk, rk, *lrow, *rrow), diff))
+            self._index_apply(own_index, jv, key, row, diff)
+
+    def _process_delta(self, left_deltas: List[Delta], right_deltas: List[Delta], time: int) -> None:
+        """Bilinear inner-join update: ΔL⋈R_old, then L_new⋈ΔR."""
+        out: List[Delta] = []
+        left_jvs = self._jvs_of(left_deltas, self.left_key_fn)
+        right_jvs = self._jvs_of(right_deltas, self.right_key_fn)
+        self._delta_side(
+            left_deltas, left_jvs, self.left_index, self.right_index, True, out
+        )
+        self._delta_side(
+            right_deltas, right_jvs, self.right_index, self.left_index, False, out
+        )
+        self.emit(time, out)
+
     def process(self, time: int) -> None:
         left_deltas = self.take(0)
         right_deltas = self.take(1)
         if not left_deltas and not right_deltas:
+            return
+        if self._delta_mode:
+            self._process_delta(left_deltas, right_deltas, time)
             return
         affected: Set = set()
         self._apply_side(self.left_index, left_deltas, self.left_key_fn, affected)
@@ -158,12 +224,52 @@ def _freeze(v):
     return _hashable_one(v)
 
 
+class _GroupState:
+    """Per-group reduce state: keyed rows (the correctness fallback and the
+    source of original (args, t, s) for retractions) + one incremental
+    accumulator per reducer (None = permanently on the full-recompute path
+    for this group). `order_heap` lazily tracks the earliest surviving row,
+    whose gvals the emitted group row carries (rows sharing a gkey normally
+    share gvals, but groupby(id=...) can mix them)."""
+
+    __slots__ = ("bucket", "accs", "order_heap")
+
+    def __init__(self, accs: List[Any]):
+        # row_key -> (gvals, args-per-reducer, t, s)
+        self.bucket: Dict[Pointer, tuple] = {}
+        self.accs = accs
+        self.order_heap: list = []  # (t, s, row_key)
+
+    def push_order(self, t, s, row_key) -> None:
+        heapq.heappush(self.order_heap, (t, s, row_key))
+        if len(self.order_heap) > 2 * len(self.bucket) + 16:
+            self.order_heap = [
+                node for node in self.order_heap
+                if self._live(node)
+            ]
+            heapq.heapify(self.order_heap)
+
+    def _live(self, node) -> bool:
+        entry = self.bucket.get(node[2])
+        return entry is not None and entry[2] == node[0] and entry[3] == node[1]
+
+    def gvals(self) -> tuple:
+        while self.order_heap:
+            node = self.order_heap[0]
+            if self._live(node):
+                return self.bucket[node[2]][0]
+            heapq.heappop(self.order_heap)
+        raise KeyError("gvals of empty group")
+
+
 class ReduceNode(Node):
     """groupby().reduce() (reference: group_by_table, src/engine/reduce.rs).
 
     `group_fn` returns (group_key, group_values) per row; `args_fns` yields
-    each reducer's argument tuple per row. Affected groups are recomputed
-    from their keyed row sets on every batch.
+    each reducer's argument tuple per row. Semigroup reducers are maintained
+    incrementally in O(delta) per group (reference: reduce.rs:47-67);
+    reducers without accumulators (tuple/ndarray/custom-without-retract) or
+    groups that hit non-incremental inputs recompute from the keyed row set.
     """
 
     name = "reduce"
@@ -185,10 +291,16 @@ class ReduceNode(Node):
         self.args_fns = args_fns
         self.gval_width = gval_width
         self.sort_fn = sort_fn
-        # gkey -> {row_key: (gvals, [args per reducer], time, seq)}
-        self.groups: Dict[Pointer, Dict[Pointer, tuple]] = {}
+        self.groups: Dict[Pointer, _GroupState] = {}
         self.cache = _DiffCache()
         self._seq = 0
+
+    def _new_group(self) -> _GroupState:
+        accs = [
+            r.make_acc() if getattr(r, "make_acc", None) is not None else None
+            for r in self.reducers
+        ]
+        return _GroupState(accs)
 
     def process(self, time: int) -> None:
         deltas = self.take(0)
@@ -206,40 +318,66 @@ class ReduceNode(Node):
                 self.log_error("Error value in groupby key")
                 continue
             affected.add(gkey)
-            bucket = self.groups.setdefault(gkey, {})
+            st = self.groups.get(gkey)
+            if st is None:
+                st = self._new_group()
+                self.groups[gkey] = st
             if diff > 0:
                 self._seq += 1
                 args = tuple(col[i] for col in per_reducer_args)
                 if sort_vals is not None:
                     # sort_by overrides arrival order for order-sensitive
                     # reducers (tuple/earliest/latest)
-                    bucket[key] = (gvals, args, 0, sort_vals[i])
+                    t, s = 0, sort_vals[i]
                 else:
-                    bucket[key] = (gvals, args, time, self._seq)
+                    t, s = time, self._seq
+                st.bucket[key] = (gvals, args, t, s)
+                st.push_order(t, s, key)
+                for r_idx, acc in enumerate(st.accs):
+                    if acc is None:
+                        continue
+                    try:
+                        acc.insert(key, args[r_idx], t, s)
+                    except Exception:  # noqa: BLE001
+                        st.accs[r_idx] = None  # full-recompute from now on
             else:
-                bucket.pop(key, None)
-                if not bucket:
+                entry = st.bucket.pop(key, None)
+                if entry is not None:
+                    _gv, args, t, s = entry
+                    for r_idx, acc in enumerate(st.accs):
+                        if acc is None:
+                            continue
+                        try:
+                            acc.retract(key, args[r_idx], t, s)
+                        except Exception:  # noqa: BLE001
+                            st.accs[r_idx] = None
+                if not st.bucket:
                     del self.groups[gkey]
         out: List[Delta] = []
         for gkey in affected:
-            bucket = self.groups.get(gkey)
+            st = self.groups.get(gkey)
             new_rows: Dict[Pointer, tuple] = {}
-            if bucket:
-                entries = list(bucket.items())
-                gvals = min(entries, key=lambda kv: (kv[1][2], kv[1][3]))[1][0]
+            if st is not None and st.bucket:
                 results = []
+                entries = None  # materialized lazily, only for fallbacks
                 for r_idx, reducer in enumerate(self.reducers):
-                    r_entries = [
-                        (rk, e[1][r_idx], e[2], e[3]) for rk, e in entries
-                    ]
+                    acc = st.accs[r_idx]
                     try:
-                        results.append(reducer.compute(r_entries))
+                        if acc is not None:
+                            results.append(acc.result())
+                        else:
+                            if entries is None:
+                                entries = list(st.bucket.items())
+                            r_entries = [
+                                (rk, e[1][r_idx], e[2], e[3]) for rk, e in entries
+                            ]
+                            results.append(reducer.compute(r_entries))
                     except Exception as exc:  # noqa: BLE001
                         self.log_error(
                             f"reducer {reducer.name}: {type(exc).__name__}: {exc}"
                         )
                         results.append(ERROR)
-                new_rows[gkey] = (*gvals, *results)
+                new_rows[gkey] = (*st.gvals(), *results)
             self.cache.diff(gkey, new_rows, out)
         self.emit(time, out)
 

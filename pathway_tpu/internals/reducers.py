@@ -2,10 +2,12 @@
 
 TPU-native rebuild of the reference reducer set (reference:
 src/engine/reduce.rs:27-45, python/pathway/internals/reducers.py,
-custom_reducers.py). The engine recomputes a group's aggregate from its keyed
-row set on every change (correct for all reducers, including non-invertible
-min/max/tuple); numeric-column groups are batched into numpy segment
-reductions by the engine where possible.
+custom_reducers.py). Semigroup reducers (count/sum/avg/min/max/arg*/unique/
+earliest/latest/count_distinct) maintain per-group *accumulators* updated in
+O(delta) per change — matching the reference's O(delta) semigroup reducers
+(src/engine/reduce.rs:47-67) — with automatic fallback to full-group
+recomputation for non-invertible cases (mixed/unhashable types, custom
+reducers), which stays correct for everything.
 
 Each engine entry is `(row_key, args_tuple, time, seq)`; `time/seq` give the
 deterministic arrival order that earliest/latest/tuple rely on.
@@ -13,7 +15,8 @@ deterministic arrival order that earliest/latest/tuple rely on.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, List, Tuple
+import heapq
+from typing import Any, Callable, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -29,7 +32,12 @@ Entry = Tuple[Any, tuple, int, int]  # (row_key, args, time, seq)
 
 
 class Reducer:
-    """A reducer spec: name + engine compute function + dtype rule."""
+    """A reducer spec: name + engine compute function + dtype rule.
+
+    `make_acc`, when present, builds an O(delta) incremental accumulator;
+    the engine falls back to `compute` over the full group when the
+    accumulator raises (odd types) or is absent (custom reducers).
+    """
 
     def __init__(
         self,
@@ -37,17 +45,296 @@ class Reducer:
         compute: Callable[[List[Entry]], Any],
         dtype_fn: Callable[[list], dt.DType] | None = None,
         skip_errors: bool = False,
+        make_acc: Callable[[], "Accumulator"] | None = None,
     ):
         self.name = name
         self.compute = compute
         self.dtype_fn = dtype_fn or (lambda arg_dtypes: dt.ANY)
         self.skip_errors = skip_errors
+        self.make_acc = make_acc
 
     def __call__(self, *args, **kwargs) -> ReducerExpression:
         return ReducerExpression(self, *args, **kwargs)
 
     def __repr__(self):
         return f"<reducer {self.name}>"
+
+
+# ---------------------------------------------------------------------------
+# Incremental accumulators (O(delta) per group update)
+# ---------------------------------------------------------------------------
+
+
+class Accumulator:
+    """Incremental per-group aggregate state.
+
+    insert/retract may raise to signal "this input shape is beyond the
+    incremental path" — the engine then permanently switches that group's
+    reducer to full recomputation. result() may raise to signal an error
+    aggregate (engine logs and emits ERROR), mirroring compute()'s behavior.
+    """
+
+    def insert(self, row_key: Any, args: tuple, t: Any, s: Any) -> None:
+        raise NotImplementedError
+
+    def retract(self, row_key: Any, args: tuple, t: Any, s: Any) -> None:
+        raise NotImplementedError
+
+    def result(self) -> Any:
+        raise NotImplementedError
+
+
+class _CountAcc(Accumulator):
+    __slots__ = ("n",)
+
+    def __init__(self):
+        self.n = 0
+
+    def insert(self, row_key, args, t, s):
+        self.n += 1
+
+    def retract(self, row_key, args, t, s):
+        self.n -= 1
+
+    def result(self):
+        return self.n
+
+
+class _SumAcc(Accumulator):
+    """Running total. Exact for ints/bools; floats may accumulate rounding
+    drift under retraction (same trade the reference makes for its semigroup
+    float sums). ndarray totals ride numpy broadcasting; anything that
+    doesn't support +/- (str, tuple, None) raises on update → fallback."""
+
+    __slots__ = ("total", "err")
+
+    def __init__(self):
+        self.total: Any = 0
+        self.err = 0
+
+    def insert(self, row_key, args, t, s):
+        v = args[0]
+        if isinstance(v, Error):
+            self.err += 1
+            return
+        if v is None or isinstance(v, (str, bytes, tuple, list, dict)):
+            raise TypeError("non-numeric sum input")
+        self.total = self.total + v
+
+    def retract(self, row_key, args, t, s):
+        v = args[0]
+        if isinstance(v, Error):
+            self.err -= 1
+            return
+        self.total = self.total - v
+
+    def result(self):
+        if self.err:
+            return ERROR
+        return self.total
+
+
+class _AvgAcc(Accumulator):
+    __slots__ = ("total", "n", "err")
+
+    def __init__(self):
+        self.total: Any = 0
+        self.n = 0
+        self.err = 0
+
+    def insert(self, row_key, args, t, s):
+        v = args[0]
+        if isinstance(v, Error):
+            self.err += 1
+            return
+        if v is None or isinstance(v, (str, bytes, tuple, list, dict)):
+            raise TypeError("non-numeric avg input")
+        self.total = self.total + v
+        self.n += 1
+
+    def retract(self, row_key, args, t, s):
+        v = args[0]
+        if isinstance(v, Error):
+            self.err -= 1
+            return
+        self.total = self.total - v
+        self.n -= 1
+
+    def result(self):
+        if self.err:
+            return ERROR
+        if self.n == 0:
+            return None
+        return self.total / self.n
+
+
+class _Rev:
+    """Reverses comparison so heapq's min-heap acts as a max-heap. __eq__
+    must be real equality, not identity, so tuple comparison falls through
+    to later tie-break elements (e.g. argmax's row_key)."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return other.v == self.v
+
+
+class _ExtremumAcc(Accumulator):
+    """min/max/argmin/argmax via a lazy-deletion heap: O(log n) amortized
+    insert/retract, O(1)+pops result. Heap nodes carry a generation id so
+    stale entries (retracted or overwritten rows) are skipped on read."""
+
+    __slots__ = ("heap", "live", "gen", "err", "mode")
+
+    def __init__(self, mode: str):
+        self.heap: list = []
+        self.live: dict = {}  # row_key -> generation id
+        self.gen = 0
+        self.err = 0
+        self.mode = mode  # 'min' | 'max' | 'argmin' | 'argmax'
+
+    def _heap_key(self, v, row_key):
+        if self.mode == "min":
+            return (v,)
+        if self.mode == "max":
+            return (_Rev(v),)
+        if self.mode == "argmin":
+            return (v, row_key)
+        return (_Rev(v), row_key)  # argmax: max value, min key tie-break
+
+    def insert(self, row_key, args, t, s):
+        v = args[0]
+        if isinstance(v, Error):
+            self.err += 1
+            return
+        self.gen += 1
+        self.live[row_key] = self.gen
+        heapq.heappush(self.heap, (*self._heap_key(v, row_key), self.gen, v, row_key))
+
+    def retract(self, row_key, args, t, s):
+        v = args[0]
+        if isinstance(v, Error):
+            self.err -= 1
+            return
+        self.live.pop(row_key, None)
+        self._maybe_compact()
+
+    def _maybe_compact(self):
+        # lazy-deletion heaps otherwise grow with total inserts ever seen
+        if len(self.heap) > 2 * len(self.live) + 16:
+            self.heap = [
+                node for node in self.heap
+                if self.live.get(node[-1]) == node[-3]
+            ]
+            heapq.heapify(self.heap)
+
+    def result(self):
+        if self.err:
+            return ERROR
+        while self.heap:
+            node = self.heap[0]
+            gen, v, row_key = node[-3], node[-2], node[-1]
+            if self.live.get(row_key) != gen:
+                heapq.heappop(self.heap)
+                continue
+            if self.mode in ("min", "max"):
+                return v
+            return row_key
+        return None
+
+
+class _OrderAcc(Accumulator):
+    """earliest / latest / any: extremum over arrival order (time, seq) —
+    lazy heap like _ExtremumAcc but keyed by (t, s), carrying the value."""
+
+    __slots__ = ("heap", "live", "gen", "latest")
+
+    def __init__(self, latest: bool):
+        self.heap: list = []
+        self.live: dict = {}
+        self.gen = 0
+        self.latest = latest
+
+    def insert(self, row_key, args, t, s):
+        self.gen += 1
+        self.live[row_key] = self.gen
+        key = _Rev((t, s)) if self.latest else (t, s)
+        heapq.heappush(self.heap, (key, self.gen, args[0], row_key))
+
+    def retract(self, row_key, args, t, s):
+        self.live.pop(row_key, None)
+        if len(self.heap) > 2 * len(self.live) + 16:
+            self.heap = [
+                node for node in self.heap if self.live.get(node[3]) == node[1]
+            ]
+            heapq.heapify(self.heap)
+
+    def result(self):
+        while self.heap:
+            _key, gen, v, row_key = self.heap[0]
+            if self.live.get(row_key) != gen:
+                heapq.heappop(self.heap)
+                continue
+            return v
+        return None
+
+
+class _DistinctAcc(Accumulator):
+    """count_distinct / unique over a value→multiplicity map."""
+
+    __slots__ = ("counts", "values", "err", "unique_mode")
+
+    def __init__(self, unique_mode: bool = False):
+        self.counts: dict = {}
+        self.values: dict = {}  # hashable form -> representative original
+        self.err = 0
+        self.unique_mode = unique_mode
+
+    def insert(self, row_key, args, t, s):
+        v = args[0]
+        if isinstance(v, Error):
+            self.err += 1
+            return
+        from pathway_tpu.engine.stream import _hashable_one
+
+        hv = _hashable_one(v)
+        hash(hv)  # unhashable exotic value -> fallback
+        self.counts[hv] = self.counts.get(hv, 0) + 1
+        self.values.setdefault(hv, v)
+
+    def retract(self, row_key, args, t, s):
+        v = args[0]
+        if isinstance(v, Error):
+            self.err -= 1
+            return
+        from pathway_tpu.engine.stream import _hashable_one
+
+        hv = _hashable_one(v)
+        n = self.counts.get(hv, 0) - 1
+        if n <= 0:
+            self.counts.pop(hv, None)
+            self.values.pop(hv, None)
+        else:
+            self.counts[hv] = n
+
+    def result(self):
+        if self.unique_mode:
+            if self.err:
+                return ERROR
+            if len(self.counts) == 1:
+                return next(iter(self.values.values()))
+            if not self.counts:
+                return None
+            return ERROR
+        if self.err:
+            return ERROR
+        return len(self.counts)
 
 
 def _arg0(entries: List[Entry]) -> List[Any]:
@@ -218,18 +505,40 @@ def _numeric_dtype(arg_dtypes: list) -> dt.DType:
     return dt.ANY
 
 
-count = Reducer("count", _compute_count, lambda a: dt.INT)
-sum_ = Reducer("sum", _compute_sum, _numeric_dtype)
-min_ = Reducer("min", _compute_min, lambda a: dt.unoptionalize(a[0]) if a else dt.ANY)
-max_ = Reducer("max", _compute_max, lambda a: dt.unoptionalize(a[0]) if a else dt.ANY)
-argmin = Reducer("argmin", _compute_argmin, lambda a: dt.POINTER)
-argmax = Reducer("argmax", _compute_argmax, lambda a: dt.POINTER)
-avg = Reducer("avg", _compute_avg, lambda a: dt.FLOAT)
+count = Reducer("count", _compute_count, lambda a: dt.INT, make_acc=_CountAcc)
+sum_ = Reducer("sum", _compute_sum, _numeric_dtype, make_acc=_SumAcc)
+min_ = Reducer(
+    "min",
+    _compute_min,
+    lambda a: dt.unoptionalize(a[0]) if a else dt.ANY,
+    make_acc=lambda: _ExtremumAcc("min"),
+)
+max_ = Reducer(
+    "max",
+    _compute_max,
+    lambda a: dt.unoptionalize(a[0]) if a else dt.ANY,
+    make_acc=lambda: _ExtremumAcc("max"),
+)
+argmin = Reducer(
+    "argmin", _compute_argmin, lambda a: dt.POINTER,
+    make_acc=lambda: _ExtremumAcc("argmin"),
+)
+argmax = Reducer(
+    "argmax", _compute_argmax, lambda a: dt.POINTER,
+    make_acc=lambda: _ExtremumAcc("argmax"),
+)
+avg = Reducer("avg", _compute_avg, lambda a: dt.FLOAT, make_acc=_AvgAcc)
 unique = Reducer(
-    "unique", _compute_unique, lambda a: dt.unoptionalize(a[0]) if a else dt.ANY
+    "unique",
+    _compute_unique,
+    lambda a: dt.unoptionalize(a[0]) if a else dt.ANY,
+    make_acc=lambda: _DistinctAcc(unique_mode=True),
 )
 any_ = Reducer(
-    "any", _compute_any, lambda a: dt.unoptionalize(a[0]) if a else dt.ANY
+    "any",
+    _compute_any,
+    lambda a: dt.unoptionalize(a[0]) if a else dt.ANY,
+    make_acc=lambda: _OrderAcc(latest=False),
 )
 tuple_ = Reducer(
     "tuple",
@@ -243,14 +552,24 @@ sorted_tuple = Reducer(
 )
 ndarray = Reducer("ndarray", _compute_ndarray, lambda a: dt.ANY_ARRAY)
 earliest = Reducer(
-    "earliest", _compute_earliest, lambda a: dt.unoptionalize(a[0]) if a else dt.ANY
+    "earliest",
+    _compute_earliest,
+    lambda a: dt.unoptionalize(a[0]) if a else dt.ANY,
+    make_acc=lambda: _OrderAcc(latest=False),
 )
 latest = Reducer(
-    "latest", _compute_latest, lambda a: dt.unoptionalize(a[0]) if a else dt.ANY
+    "latest",
+    _compute_latest,
+    lambda a: dt.unoptionalize(a[0]) if a else dt.ANY,
+    make_acc=lambda: _OrderAcc(latest=True),
 )
-count_distinct = Reducer("count_distinct", _compute_count_distinct, lambda a: dt.INT)
+count_distinct = Reducer(
+    "count_distinct", _compute_count_distinct, lambda a: dt.INT,
+    make_acc=_DistinctAcc,
+)
 count_distinct_approximate = Reducer(
-    "count_distinct_approximate", _compute_count_distinct, lambda a: dt.INT
+    "count_distinct_approximate", _compute_count_distinct, lambda a: dt.INT,
+    make_acc=_DistinctAcc,
 )
 
 
@@ -268,7 +587,9 @@ def infer_reducer_dtype(expr: ReducerExpression, rec) -> dt.DType:
 class BaseCustomAccumulator:
     """User-defined accumulator (reference: custom_reducers.py
     BaseCustomAccumulator:177). Subclass and define from_row / update /
-    compute_result (and optionally retract / neutral)."""
+    compute_result; optionally define retract(other) to unlock the O(delta)
+    incremental path (update must then be commutative + associative, as in
+    the reference's retractable custom reducers)."""
 
     @classmethod
     def from_row(cls, row):
@@ -277,8 +598,42 @@ class BaseCustomAccumulator:
     def update(self, other) -> None:
         raise NotImplementedError
 
+    def retract(self, other) -> None:
+        raise NotImplementedError
+
     def compute_result(self) -> Any:
         raise NotImplementedError
+
+
+class _CustomAcc(Accumulator):
+    """Incremental wrapper over a retract-capable BaseCustomAccumulator."""
+
+    __slots__ = ("cls", "state", "n")
+
+    def __init__(self, cls: type[BaseCustomAccumulator]):
+        self.cls = cls
+        self.state: BaseCustomAccumulator | None = None
+        self.n = 0
+
+    def insert(self, row_key, args, t, s):
+        nxt = self.cls.from_row(list(args))
+        if self.state is None:
+            self.state = nxt
+        else:
+            self.state.update(nxt)
+        self.n += 1
+
+    def retract(self, row_key, args, t, s):
+        self.n -= 1
+        if self.n <= 0:
+            self.state = None
+        else:
+            self.state.retract(self.cls.from_row(list(args)))
+
+    def result(self):
+        if self.state is None:
+            return None
+        return self.state.compute_result()
 
 
 def udf_reducer(accumulator: type[BaseCustomAccumulator]):
@@ -297,7 +652,12 @@ def udf_reducer(accumulator: type[BaseCustomAccumulator]):
             return None
         return acc.compute_result()
 
-    return Reducer(f"udf_{accumulator.__name__}", compute)
+    # A subclass that implements retract (anywhere in its MRO) opts into
+    # the incremental path.
+    make_acc = None
+    if accumulator.retract is not BaseCustomAccumulator.retract:
+        make_acc = lambda: _CustomAcc(accumulator)  # noqa: E731
+    return Reducer(f"udf_{accumulator.__name__}", compute, make_acc=make_acc)
 
 
 def stateful_many(combine_many: Callable):
