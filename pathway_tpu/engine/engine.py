@@ -96,12 +96,31 @@ class Node:
 
 
 class Engine:
-    """One worker's dataflow instance + scheduler."""
+    """One worker's dataflow instance + scheduler.
 
-    def __init__(self, *, worker_id: int = 0, worker_count: int = 1):
+    With a multi-worker coordinator, every `process_time` call is preceded
+    by a global agreement on the time so all workers step the same total
+    order of micro-batches in lockstep (the consistency the reference gets
+    from differential frontiers; reference: src/engine/dataflow/config.rs
+    worker wiring)."""
+
+    def __init__(
+        self,
+        *,
+        worker_id: int = 0,
+        worker_count: int = 1,
+        coord=None,
+    ):
+        if coord is None:
+            from pathway_tpu.engine.exchange import Coordinator
+
+            coord = Coordinator()
+            coord.worker_id = worker_id
+            coord.worker_count = worker_count
+        self.coord = coord
         self.nodes: List[Node] = []
-        self.worker_id = worker_id
-        self.worker_count = worker_count
+        self.worker_id = coord.worker_id
+        self.worker_count = coord.worker_count
         self.error_log: List[ErrorLogEntry] = []
         self.error_log_nodes: List["ErrorLogNode"] = []
         self._scheduled_times: set[int] = set()
@@ -121,6 +140,24 @@ class Engine:
     def next_scheduled_time(self) -> Optional[int]:
         future = [t for t in self._scheduled_times if t > self.current_time]
         return min(future) if future else None
+
+    # -- multi-worker helpers ---------------------------------------------
+    def owns_key(self, key) -> bool:
+        return self.coord.owns(key.shard)
+
+    def global_next_time(self) -> Optional[int]:
+        """Agree on the earliest scheduled time across workers (None = no
+        worker has one)."""
+        local = self.next_scheduled_time()
+        if self.coord.worker_count == 1:
+            return local
+        votes = [v for v in self.coord.agree(local) if v is not None]
+        return min(votes) if votes else None
+
+    def global_any(self, flag: bool) -> bool:
+        if self.coord.worker_count == 1:
+            return flag
+        return any(self.coord.agree(bool(flag)))
 
     def log_error(self, message: str, operator: str = "", trace=None) -> None:
         entry = ErrorLogEntry(message, operator, self.current_time)
@@ -144,7 +181,7 @@ class Engine:
         (temporal buffers flush at +inf on end)."""
         self.process_time(0)
         while True:
-            t = self.next_scheduled_time()
+            t = self.global_next_time()
             if t is None:
                 break
             self.process_time(t)
@@ -155,9 +192,11 @@ class Engine:
         # DAG settles within ~len(nodes) passes; the generous cap exists
         # only to turn a buggy cyclic graph into a loud error instead of a
         # hang — never to silently stop while data is still pending.
+        # Multi-worker: continue while ANY worker has pending data, so
+        # everyone keeps stepping times in lockstep.
         limit = 10 * len(self.nodes) + 100
         for _ in range(limit):
-            if not any(n.has_pending() for n in self.nodes):
+            if not self.global_any(any(n.has_pending() for n in self.nodes)):
                 return
             self.process_time(self.current_time + 1)
         if any(n.has_pending() for n in self.nodes):
@@ -194,7 +233,10 @@ class StaticSource(Node):
     def process(self, time: int) -> None:
         if not self._emitted and time >= 0:
             self._emitted = True
-            self.emit(time, [(k, v, 1) for k, v in self.rows.items()])
+            owns = self.engine.owns_key
+            self.emit(
+                time, [(k, v, 1) for k, v in self.rows.items() if owns(k)]
+            )
 
 
 class TimedSource(Node):
@@ -214,18 +256,27 @@ class TimedSource(Node):
     def process(self, time: int) -> None:
         deltas = self._by_time.pop(time, None)
         if deltas:
-            self.emit(time, deltas)
+            # multi-worker: each worker emits only its shard of the
+            # (identical) event script
+            owns = self.engine.owns_key
+            self.emit(time, [d for d in deltas if owns(d[0])])
 
 
 class InputQueueSource(Node):
     """Streaming source fed externally (connectors push batches tagged with
-    times; the runner routes them here)."""
+    times; the runner routes them here).
+
+    Multi-worker: `shard_filter=True` means a replicated reader (every
+    worker parses the same input, keeps its key shard). Exclusive readers
+    (REST servers, stateful custom subjects running on worker 0 only) set
+    it False and get a scatter ExchangeNode appended instead."""
 
     name = "input"
 
-    def __init__(self, engine: Engine):
+    def __init__(self, engine: Engine, *, shard_filter: bool = True):
         super().__init__(engine, [])
         self._by_time: Dict[int, List[Delta]] = {}
+        self.shard_filter = shard_filter
 
     def push(self, time: int, deltas: List[Delta]) -> None:
         self._by_time.setdefault(time, []).extend(deltas)
@@ -234,6 +285,9 @@ class InputQueueSource(Node):
     def process(self, time: int) -> None:
         deltas = self._by_time.pop(time, None)
         if deltas:
+            if self.shard_filter and self.engine.worker_count > 1:
+                owns = self.engine.owns_key
+                deltas = [d for d in deltas if owns(d[0])]
             self.emit(time, deltas)
 
 

@@ -68,6 +68,13 @@ class ExternalIndexNode(Node):
         data_width: int,
         as_of_now: bool = True,
     ):
+        # multi-worker: the index is a device-resident global structure —
+        # host it on worker 0 (host-level sharded search is a later
+        # optimization; TPU-mesh sharding lives inside ops/knn.py)
+        from pathway_tpu.engine.exchange import exchange_to_worker
+
+        data_node = exchange_to_worker(engine, data_node, 0)
+        query_node = exchange_to_worker(engine, query_node, 0)
         super().__init__(engine, [data_node, query_node])
         self.index = index_impl
         self.data_value_prog = data_value_prog

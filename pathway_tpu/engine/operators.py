@@ -16,6 +16,7 @@ import heapq
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from pathway_tpu.engine.engine import Engine, Node
+from pathway_tpu.engine.exchange import exchange_by_value
 from pathway_tpu.engine.stream import Delta, TableState, values_equal_tuple
 from pathway_tpu.engine.value import ERROR, Error, Pointer, ref_scalar
 
@@ -73,6 +74,10 @@ class JoinNode(Node):
         id_mode: str = "both",
         exact_match: bool = False,
     ):
+        # multi-worker: co-locate rows by join value so each jv bucket is
+        # complete on one worker (reference: shard.rs exchange pact)
+        left = exchange_by_value(engine, left, left_key_fn)
+        right = exchange_by_value(engine, right, right_key_fn)
         super().__init__(engine, [left, right])
         self.left_key_fn = left_key_fn
         self.right_key_fn = right_key_fn
@@ -285,6 +290,11 @@ class ReduceNode(Node):
         gval_width: int,
         sort_fn: Optional[BatchFn] = None,
     ):
+        # multi-worker: co-locate rows by group key (output keys == gkey,
+        # so the result lands on its owner with no output exchange)
+        input_ = exchange_by_value(
+            engine, input_, lambda keys, rows: [gk for gk, _ in group_fn(keys, rows)]
+        )
         super().__init__(engine, [input_])
         self.group_fn = group_fn
         self.reducers = reducers
@@ -402,6 +412,10 @@ class IxNode(Node):
         target_width: int,
         optional: bool = False,
     ):
+        # multi-worker: ship each source row to the worker owning the
+        # pointed-at target key (targets already live at their own keys);
+        # the construction site re-exchanges output back to skey's owner
+        source = exchange_by_value(engine, source, key_fn)
         super().__init__(engine, [source, target])
         self.key_fn = key_fn
         self.target_width = target_width
@@ -482,6 +496,10 @@ class SemijoinNode(Node):
         keep_present: bool = True,
         filter_key_fn: Optional[BatchFn] = None,
     ):
+        if filter_key_fn is not None:
+            # multi-worker: filter rows assert arbitrary keys (`having`) —
+            # ship each assertion to the asserted key's owner
+            filter_ = exchange_by_value(engine, filter_, filter_key_fn)
         super().__init__(engine, [input_, filter_])
         self.keep_present = keep_present
         self.filter_key_fn = filter_key_fn
@@ -646,6 +664,13 @@ class SortNode(Node):
         key_fn: BatchFn,
         instance_fn: Optional[BatchFn] = None,
     ):
+        # multi-worker: a sort instance is a total order — co-locate it
+        # (no instance column = one global order on one worker)
+        input_ = exchange_by_value(
+            engine,
+            input_,
+            instance_fn or (lambda keys, rows: [None] * len(keys)),
+        )
         super().__init__(engine, [input_])
         self.key_fn = key_fn
         self.instance_fn = instance_fn
@@ -701,6 +726,12 @@ class DeduplicateNode(Node):
         instance_fn: Optional[BatchFn],
         acceptor: Callable[[Any, Any], bool],
     ):
+        # multi-worker: deduplication is a per-instance total order
+        input_ = exchange_by_value(
+            engine,
+            input_,
+            instance_fn or (lambda keys, rows: [None] * len(keys)),
+        )
         super().__init__(engine, [input_])
         self.value_fn = value_fn
         self.instance_fn = instance_fn

@@ -127,6 +127,8 @@ class JoinResult:
         right_prog = _compile_on(
             ctx, [self._right], MakeTupleExpression(*self._on_right)
         )
+        from pathway_tpu.engine.exchange import exchange_by_key
+
         node = JoinNode(
             ctx.engine,
             left_node,
@@ -139,6 +141,9 @@ class JoinResult:
             right_outer=self._mode in (JoinMode.RIGHT, JoinMode.OUTER),
             id_mode=self._id_mode,
         )
+        # multi-worker: joined rows (keyed by pair/side ids) go to their
+        # owning worker so downstream keyed operators compose
+        node = exchange_by_key(ctx.engine, node)
         ctx.join_nodes[id(self)] = node
         return node
 

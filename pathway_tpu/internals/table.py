@@ -287,9 +287,12 @@ class Table:
         def build(ctx):
             from pathway_tpu.engine.engine import ReindexNode
 
+            from pathway_tpu.engine.exchange import exchange_by_key
+
             node = ctx.node(self_)
             prog = _compile_on(ctx, [self_], key_expr)
-            return ReindexNode(ctx.engine, node, prog)
+            # multi-worker: new keys must land on their owning worker
+            return exchange_by_key(ctx.engine, ReindexNode(ctx.engine, node, prog))
 
         return Table(schema=self._schema, universe=Universe(), build=build)
 
@@ -348,7 +351,14 @@ class Table:
                 if instance_expr is not None
                 else None
             )
-            return DeduplicateNode(ctx.engine, node, value_prog, instance_prog, acceptor)
+            from pathway_tpu.engine.exchange import exchange_by_key
+
+            return exchange_by_key(
+                ctx.engine,
+                DeduplicateNode(
+                    ctx.engine, node, value_prog, instance_prog, acceptor
+                ),
+            )
 
         return Table(schema=self._schema, universe=Universe(), build=build)
 
@@ -559,7 +569,12 @@ class Table:
         def build(ctx):
             from pathway_tpu.engine.operators import FlattenNode
 
-            return FlattenNode(ctx.engine, ctx.node(self_), flat_idx)
+            from pathway_tpu.engine.exchange import exchange_by_key
+
+            # multi-worker: flattened keys hash (row, pos) — re-own them
+            return exchange_by_key(
+                ctx.engine, FlattenNode(ctx.engine, ctx.node(self_), flat_idx)
+            )
 
         schema_cols = {}
         for name in self.column_names():
@@ -602,7 +617,12 @@ class Table:
                 if instance_expr is not None
                 else None
             )
-            return SortNode(ctx.engine, node, key_prog, inst_prog)
+            from pathway_tpu.engine.exchange import exchange_by_key
+
+            # multi-worker: output rows keep their original keys — re-own
+            return exchange_by_key(
+                ctx.engine, SortNode(ctx.engine, node, key_prog, inst_prog)
+            )
 
         schema = schema_from_columns(
             {
@@ -627,19 +647,22 @@ class Table:
         self_ = self
 
         def build(ctx):
+            from pathway_tpu.engine.exchange import exchange_by_key
             from pathway_tpu.engine.operators import IxNode
 
             src_node = ctx.node(source)
             target_node = ctx.node(self_)
             key_prog = _compile_on(ctx, [source], expr)
-            return IxNode(
+            # multi-worker: lookups compute on the target's owner; results
+            # keyed by the source row go home afterwards
+            return exchange_by_key(ctx.engine, IxNode(
                 ctx.engine,
                 src_node,
                 target_node,
                 key_prog,
                 target_width=len(self_.column_names()),
                 optional=optional,
-            )
+            ))
 
         schema_cols = {}
         for name in self.column_names():

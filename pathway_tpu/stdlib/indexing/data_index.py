@@ -119,11 +119,12 @@ class DataIndex:
         )
 
         def build(ctx):
+            from pathway_tpu.engine.exchange import exchange_by_key
             from pathway_tpu.engine.index_node import ExternalIndexNode
 
             data_node = ctx.node(data_table)
             query_node = ctx.node(query_table)
-            return ExternalIndexNode(
+            return exchange_by_key(ctx.engine, ExternalIndexNode(
                 ctx.engine,
                 data_node,
                 query_node,
@@ -143,7 +144,7 @@ class DataIndex:
                 ),
                 data_width=len(data_table.column_names()),
                 as_of_now=as_of_now,
-            )
+            ))
 
         cols: dict = {
             "_pw_index_reply_id": ColumnSchema(

@@ -35,6 +35,11 @@ class AsofNowJoinNode(Node):
         left_outer: bool,
         id_mode: str = "left",
     ):
+        # multi-worker: co-locate queries with the index shard they probe
+        from pathway_tpu.engine.exchange import exchange_by_value
+
+        left = exchange_by_value(engine, left, left_key_prog)
+        right = exchange_by_value(engine, right, right_key_prog)
         super().__init__(engine, [left, right])
         self.left_key_prog = left_key_prog
         self.right_key_prog = right_key_prog
@@ -104,6 +109,9 @@ class AsofNowJoinResult(JoinResult):
             left_outer=self._mode in (JoinMode.LEFT, JoinMode.OUTER),
             id_mode="left" if self._id_mode_effective == "left" else "both",
         )
+        from pathway_tpu.engine.exchange import exchange_by_key
+
+        node = exchange_by_key(ctx.engine, node)
         ctx.join_nodes[id(self)] = node
         return node
 

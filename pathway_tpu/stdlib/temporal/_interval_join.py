@@ -57,6 +57,11 @@ class IntervalJoinNode(Node):
         left_outer: bool,
         right_outer: bool,
     ):
+        # multi-worker: co-locate rows by join key (empty key = one worker)
+        from pathway_tpu.engine.exchange import exchange_by_value
+
+        left = exchange_by_value(engine, left, left_key_prog)
+        right = exchange_by_value(engine, right, right_key_prog)
         super().__init__(engine, [left, right])
         self.left_time_prog = left_time_prog
         self.right_time_prog = right_time_prog
@@ -174,6 +179,9 @@ class IntervalJoinResult(JoinResult):
             left_outer=self._mode in (JoinMode.LEFT, JoinMode.OUTER),
             right_outer=self._mode in (JoinMode.RIGHT, JoinMode.OUTER),
         )
+        from pathway_tpu.engine.exchange import exchange_by_key
+
+        node = exchange_by_key(ctx.engine, node)
         ctx.join_nodes[id(self)] = node
         return node
 

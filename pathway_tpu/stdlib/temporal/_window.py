@@ -312,9 +312,18 @@ def _session_assign(table: Table, time_e, instance_e, window: SessionWindow) -> 
         inst_prog = (
             _compile_on(ctx, [table], instance_e) if instance_e is not None else None
         )
-        return SessionAssignNode(
-            ctx.engine, node, time_prog, inst_prog, window.predicate, window.max_gap
+        from pathway_tpu.engine.exchange import exchange_by_key, exchange_by_value
+
+        # multi-worker: sessions chain within an instance — co-locate it,
+        # then send the per-row assignments back to their key owners
+        node = exchange_by_value(
+            ctx.engine,
+            node,
+            inst_prog or (lambda keys, rows: [None] * len(keys)),
         )
+        return exchange_by_key(ctx.engine, SessionAssignNode(
+            ctx.engine, node, time_prog, inst_prog, window.predicate, window.max_gap
+        ))
 
     schema = schema_from_columns(
         {
@@ -412,7 +421,15 @@ def _intervals_over_windowby(
         at_node = ctx.node(at_table)
         time_prog = _compile_on(ctx, [table], time_e)
         at_prog = _compile_on(ctx, [at_table], at_expr)
-        return IntervalsOverNode(
+        from pathway_tpu.engine.exchange import (
+            exchange_by_key,
+            exchange_to_worker,
+        )
+
+        # multi-worker: every at-point may touch any data row — gather
+        data_node = exchange_to_worker(ctx.engine, data_node, 0)
+        at_node = exchange_to_worker(ctx.engine, at_node, 0)
+        return exchange_by_key(ctx.engine, IntervalsOverNode(
             ctx.engine,
             data_node,
             at_node,
@@ -422,7 +439,7 @@ def _intervals_over_windowby(
             upper,
             is_outer,
             data_width=len(table.column_names()),
-        )
+        ))
 
     cols = dict(table._schema.columns().items())
     out_cols = {
