@@ -304,23 +304,18 @@ class TcpCoordinator(Coordinator):
 # ---------------------------------------------------------------------------
 
 
-class ExchangeNode:
-    """Re-partitions a delta stream across workers by a routing function.
-
-    Placed before stateful operators so rows that must interact (same group
-    / join key / instance) meet on one worker (reference: shard.rs — the
-    exchange pact on keyed edges). The node index doubles as the wire
-    channel id: graphs build in the same order on every worker, so indices
-    align."""
-
-    # actual class built below to avoid importing engine at module load
-    pass
-
-
 def _make_exchange_node():
     from pathway_tpu.engine.engine import Node
 
     class _ExchangeNode(Node):
+        """Re-partitions a delta stream across workers by a routing function.
+
+        Placed before stateful operators so rows that must interact (same
+        group / join key / instance) meet on one worker (reference:
+        shard.rs — the exchange pact on keyed edges). Channel ids come from
+        a dedicated counter: exchange creation points are SPMD-
+        deterministic, so ids align across workers."""
+
         name = "exchange"
 
         def __init__(self, engine, input_, route_fn):

@@ -33,17 +33,38 @@ class RunContext:
         return n
 
 
+def _make_engine() -> Engine:
+    """Engine wired to the process-wide coordinator when running as one of
+    several worker processes (PATHWAY_PROCESSES > 1; reference:
+    src/engine/dataflow/config.rs:88-120 Config::from_env)."""
+    from pathway_tpu.internals.config import pathway_config as cfg
+
+    if cfg.processes > 1:
+        from pathway_tpu.engine.exchange import global_coordinator
+
+        return Engine(coord=global_coordinator())
+    return Engine()
+
+
 def run_tables(
     *tables,
     record_stream: bool = False,
     engine: Engine | None = None,
 ) -> List[CaptureNode]:
-    """Build and run the graph needed for `tables`; return their captures."""
-    engine = engine or Engine()
+    """Build and run the graph needed for `tables`; return their captures.
+
+    Multi-worker: results are gathered onto worker 0 (workers>0 return
+    empty captures) so `pw.debug.compute_and_print` shows the full table
+    exactly once across the process group."""
+    engine = engine or _make_engine()
     ctx = RunContext(engine)
     captures = []
     for t in tables:
         node = ctx.node(t)
+        if engine.worker_count > 1:
+            from pathway_tpu.engine.exchange import exchange_to_worker
+
+            node = exchange_to_worker(engine, node, 0)
         captures.append(CaptureNode(engine, node, record_stream=record_stream))
     _attach_monitoring(engine)
     engine.run_static()
@@ -60,7 +81,7 @@ def run(
 ) -> None:
     """pw.run — execute every registered sink (reference:
     internals/run.py:11)."""
-    engine = Engine()
+    engine = _make_engine()
     ctx = RunContext(engine)
     for sink in G.sinks:
         nodes = [ctx.node(t) for t in sink.tables]

@@ -39,15 +39,30 @@ def _hashable(values: tuple) -> tuple:
 
 
 class LiveSource:
-    """One streaming input: a subject factory + the engine node it feeds."""
+    """One streaming input: a subject factory + the engine node it feeds.
 
-    def __init__(self, subject_factory, schema, name: str):
+    `exclusive` sources (REST ingress, stateful custom subjects) run their
+    reader on exactly one worker; a scatter exchange after the source node
+    routes rows to shard owners (reference: non-partitioned sources are read
+    by one worker and forwarded, worker-architecture doc :41-42)."""
+
+    def __init__(
+        self,
+        subject_factory,
+        schema,
+        name: str,
+        *,
+        exclusive: bool = False,
+        exclusive_worker: int = 0,
+    ):
         self.subject_factory = subject_factory
         self.schema = schema
         self.name = name
         self.node = None  # set at build time
         self.sync_group = None  # set by register_input_synchronization_group
         self.sync_column = None
+        self.exclusive = exclusive
+        self.exclusive_worker = exclusive_worker
 
 
 def connector_table(
@@ -56,11 +71,30 @@ def connector_table(
     *,
     mode: str = "streaming",
     name: str | None = None,
+    exclusive: bool = False,
+    exclusive_worker: int = 0,
+    partitioned: bool = False,
 ) -> Table:
     """Create a table fed by a connector subject (reference:
-    Graph::connector_table, dataflow.rs:3880)."""
+    Graph::connector_table, dataflow.rs:3880).
+
+    Multi-worker source modes:
+    - default (replicated): every worker runs the reader over the full
+      input and keeps only its key shard — right for local files, demo
+      streams, anything cheap and deterministic to re-read.
+    - ``exclusive``: one worker reads (REST ingress binding a port,
+      stateful custom subjects); rows are scatter-exchanged to owners.
+    - ``partitioned``: every worker reads a disjoint partition subset
+      (kafka consumer groups); rows are scatter-exchanged, nothing is
+      filtered."""
     name = name or f"source_{next(_source_ids)}"
-    live = LiveSource(subject_factory, schema, name)
+    live = LiveSource(
+        subject_factory,
+        schema,
+        name,
+        exclusive=exclusive,
+        exclusive_worker=exclusive_worker,
+    )
 
     if mode == "static":
 
@@ -79,10 +113,16 @@ def connector_table(
     def build_streaming(ctx):
         from pathway_tpu.engine.engine import InputQueueSource
 
-        node = InputQueueSource(ctx.engine)
+        node = InputQueueSource(
+            ctx.engine, shard_filter=not (exclusive or partitioned)
+        )
         live.node = node
         if live not in G.sources:
             G.add_source(live)
+        if (exclusive or partitioned) and ctx.engine.worker_count > 1:
+            from pathway_tpu.engine.exchange import exchange_by_key
+
+            return exchange_by_key(ctx.engine, node)
         return node
 
     table = Table(schema=schema, universe=Universe(), build=build_streaming)
@@ -274,9 +314,15 @@ class StreamingDriver:
         threads = []
         active = 0
         replayed: Dict[LiveSource, List] = {}
+        my_worker = self.engine.worker_id
         for live in sources:
             if live.node is None:
                 continue  # source never built (tree-shaken)
+            if live.exclusive and my_worker != live.exclusive_worker:
+                # exclusive sources (REST ingress, stateful custom subjects)
+                # read on one worker only; a scatter ExchangeNode after the
+                # source routes rows to their shard owners
+                continue
             subject = live.subject_factory()
             sink = _QueueSink(self.queue, live)
             sink.subject = subject
@@ -305,51 +351,79 @@ class StreamingDriver:
         # initial time 0 processes static parts of the graph
         self.engine.process_time(0)
         # replay persisted input snapshots as the first batch (reference:
-        # rewind_from_disk_snapshot, connectors/mod.rs:256)
-        if replayed:
+        # rewind_from_disk_snapshot, connectors/mod.rs:256). Multi-worker:
+        # the replay step happens on every worker if it happens anywhere so
+        # the lockstep time sequence stays identical.
+        if self.engine.global_any(bool(replayed)):
             for live, events in replayed.items():
                 live.node.push(2, events)
             self.engine.process_time(2)
+            time = 4
+        else:
+            time = 2
         for t in threads:
             t.start()
 
-        time = 4 if replayed else 2
         pending: Dict[LiveSource, List] = {}
         states: Dict[LiveSource, Any] = {}
         counters: Dict[LiveSource, int] = {}
         last_flush = time_mod.monotonic()
+        multiworker = self.engine.worker_count > 1
+        done = False
 
         def flush():
-            nonlocal time, last_flush
-            flushed = False
-            for live, deltas in pending.items():
-                if deltas:
-                    writer = self._snapshot_writer(live)
-                    if writer is not None:
-                        state = states.pop(live, None) or {}
-                        state["counter"] = counters.get(live, 0)
-                        writer.write_batch(deltas, state)
-                    live.node.push(time, deltas)
-                    flushed = True
-            pending.clear()
-            if flushed:
+            """One coordinated flush tick. Multi-worker: every worker makes
+            the identical sequence of coordination calls per tick (one
+            agree + the shared-scheduled-time loop), so agreement rounds
+            align across workers; agree() itself blocks until the slowest
+            worker reaches the same tick — that is the frontier protocol."""
+            nonlocal time, last_flush, done
+            has_data = any(bool(d) for d in pending.values())
+            local_done = active <= 0 and not has_data
+            term = self.engine.terminate_flag.is_set()
+            if multiworker:
+                # termination rides the vote so every worker exits at the
+                # same round (a unilateral break would strand peers in
+                # agree() until the dead-peer timeout)
+                votes = self.engine.coord.agree((has_data, local_done, term))
+                any_data = any(v[0] for v in votes)
+                done = all(v[1] for v in votes) or any(v[2] for v in votes)
+            else:
+                any_data = has_data
+                done = local_done or term
+            if any_data:
+                for live, deltas in pending.items():
+                    if deltas:
+                        writer = self._snapshot_writer(live)
+                        if writer is not None:
+                            state = states.pop(live, None) or {}
+                            state["counter"] = counters.get(live, 0)
+                            writer.write_batch(deltas, state)
+                        live.node.push(time, deltas)
+                pending.clear()
                 self.engine.process_time(time)
                 time += 2
-            # run scheduled times that are due
-            nxt = self.engine.next_scheduled_time()
-            while nxt is not None and nxt <= time:
+            # run scheduled times that are due (global_next_time agrees, and
+            # every worker sees the same nxt sequence — lockstep preserved)
+            while True:
+                nxt = self.engine.global_next_time()
+                if nxt is None or nxt > time:
+                    break
                 self.engine.process_time(nxt)
-                nxt = self.engine.next_scheduled_time()
             last_flush = time_mod.monotonic()
 
-        while active > 0:
+        while not done:
             timeout = max(
                 0.0, self.autocommit_s - (time_mod.monotonic() - last_flush)
             )
+            if timeout == 0.0:
+                # autocommit deadline passed — flush even if the queue never
+                # drains (a hot source must not starve the global barrier
+                # that idle peers are blocked on)
+                flush()
+                continue
             try:
-                kind, live, payload, counter = self.queue.get(
-                    timeout=timeout or 0.01
-                )
+                kind, live, payload, counter = self.queue.get(timeout=timeout)
             except queue_mod.Empty:
                 flush()
                 continue
@@ -359,10 +433,14 @@ class StreamingDriver:
             elif kind == "commit":
                 if payload is not None:
                     states[live] = payload
-                flush()
+                # multi-worker: commits buffer until the timer tick so every
+                # worker performs the same number of coordination rounds
+                if not multiworker:
+                    flush()
             elif kind == "close":
                 active -= 1
-            if self.engine.terminate_flag.is_set():
+                if not multiworker:
+                    flush()
+            if not multiworker and self.engine.terminate_flag.is_set():
                 break
-        flush()
         self.engine.finish()

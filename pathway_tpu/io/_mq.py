@@ -211,6 +211,7 @@ def mq_read(
     mode: str = "streaming",
     name: str | None = None,
     delimiter: str = ",",
+    partitioned: bool = False,
 ):
     if schema is None:
         schema = plaintext_schema() if format == "plaintext" else raw_schema()
@@ -220,7 +221,13 @@ def mq_read(
             client_factory, format, schema, mode=mode, delimiter=delimiter
         )
 
-    return connector_table(schema, factory, mode=mode, name=name)
+    # partitioned (kafka/redpanda consumer groups): each worker reads a
+    # disjoint partition subset and rows are scatter-exchanged to owners.
+    # Broadcast subscriptions (nats/mqtt) stay replicated: every worker sees
+    # every message and keeps only its key shard.
+    return connector_table(
+        schema, factory, mode=mode, name=name, partitioned=partitioned
+    )
 
 
 class MessageQueueOutputWriter(OutputWriter):

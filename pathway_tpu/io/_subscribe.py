@@ -17,16 +17,26 @@ def subscribe(
     skip_persisted_batch: bool = True,
     name: str | None = None,
     sort_by=None,
+    on_worker: int | None = None,
 ) -> None:
     """Register callbacks on table changes. on_change(key, row, time,
     is_addition) fires per delta; on_time_end(time) per closed batch;
-    on_end() at end of stream."""
+    on_end() at end of stream.
+
+    ``on_worker``: multi-worker runs gather the stream onto that worker and
+    fire the callbacks only there (REST responders must complete pending
+    futures in the process that holds them); default fires per-shard on
+    every worker."""
     column_names = table.column_names()
 
     def attach(ctx, nodes):
         from pathway_tpu.engine.engine import SubscribeNode
 
         (node,) = nodes
+        if on_worker is not None and ctx.engine.worker_count > 1:
+            from pathway_tpu.engine.exchange import exchange_to_worker
+
+            node = exchange_to_worker(ctx.engine, node, on_worker)
         SubscribeNode(
             ctx.engine,
             node,

@@ -197,6 +197,15 @@ def read(
     return connector_table(out_schema, factory, mode=mode, name=name)
 
 
+def worker_output_path(filename: str, engine) -> str:
+    """Per-worker part file: worker 0 keeps `filename`, worker w>0 writes
+    `filename.w` — each worker emits only the rows it owns, so the union of
+    part files equals the single-worker output exactly (no duplicates)."""
+    if engine.worker_count <= 1 or engine.worker_id == 0:
+        return filename
+    return f"{filename}.{engine.worker_id}"
+
+
 def write(table, filename: str, *, format: str = "json", name: str | None = None, **kwargs) -> None:
     """Write a table's change stream to a file (reference: io/fs write)."""
     column_names = table.column_names()
@@ -205,7 +214,7 @@ def write(table, filename: str, *, format: str = "json", name: str | None = None
         from pathway_tpu.engine.engine import SubscribeNode
 
         (node,) = nodes
-        fh = open(filename, "w", newline="")
+        fh = open(worker_output_path(filename, ctx.engine), "w", newline="")
         if format == "csv":
             writer = csv_mod.writer(fh)
             writer.writerow(column_names + ["time", "diff"])

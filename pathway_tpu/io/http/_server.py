@@ -299,7 +299,7 @@ def rest_connector(
         return subject
 
     queries = connector_table(
-        schema, factory, mode="streaming", name=f"rest:{route}"
+        schema, factory, mode="streaming", name=f"rest:{route}", exclusive=True
     )
 
     def response_writer(result_table, **kwargs) -> None:
@@ -316,7 +316,9 @@ def rest_connector(
                 payload = {c: _jsonable_payload(row[c]) for c in column_names}
             webserver.complete(key, payload)
 
-        subscribe(result_table, on_change=on_change)
+        # gather results to the worker running the webserver — only that
+        # process holds the pending response futures
+        subscribe(result_table, on_change=on_change, on_worker=0)
 
     return queries, response_writer
 

@@ -103,7 +103,12 @@ def read(
             return _ConfluentClient(rdkafka_settings, topics, for_read=True)
 
     return _mq.mq_read(
-        _client_factory, schema=schema, format=format, mode=mode, name=name
+        _client_factory,
+        schema=schema,
+        format=format,
+        mode=mode,
+        name=name,
+        partitioned=True,
     )
 
 

@@ -35,4 +35,6 @@ def read(
             used[0] = True
             return subject
 
-    return connector_table(schema, factory, mode=mode, name=name)
+    return connector_table(
+        schema, factory, mode=mode, name=name, exclusive=True
+    )

@@ -1,0 +1,382 @@
+"""Multi-worker (multi-process) execution tests.
+
+Modeled on the reference's distributed test harness (reference:
+python/pathway/tests/utils.py:674-737 — fork N processes with
+PATHWAY_PROCESSES/PATHWAY_PROCESS_ID/PATHWAY_FIRST_PORT env vars, poll a
+checker on the combined output). Each test writes a small pipeline script,
+launches it once per worker, and asserts the union of per-worker part files
+equals the single-worker result — same rows, no duplicates.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_port_base(n: int) -> int:
+    """Find n consecutive free localhost ports (worker i binds base+i)."""
+    for attempt in range(50):
+        socks = []
+        try:
+            s0 = socket.socket()
+            s0.bind(("127.0.0.1", 0))
+            base = s0.getsockname()[1]
+            socks.append(s0)
+            if base + n >= 65535:
+                continue
+            for i in range(1, n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no consecutive free ports found")
+
+
+def run_workers(
+    script: str, n: int, tmp_path: Path, timeout: float = 120.0
+) -> None:
+    """Launch `script` once per worker with the PATHWAY_* env contract."""
+    path = tmp_path / "pipeline.py"
+    path.write_text(textwrap.dedent(script))
+    base = _free_port_base(n)
+    procs = []
+    for wid in range(n):
+        env = dict(os.environ)
+        env.update(
+            PATHWAY_PROCESSES=str(n),
+            PATHWAY_PROCESS_ID=str(wid),
+            PATHWAY_FIRST_PORT=str(base),
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=str(REPO),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(path), str(tmp_path)],
+                env=env,
+                cwd=tmp_path,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+    failures = []
+    for wid, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError(f"worker {wid} timed out")
+        if p.returncode != 0:
+            failures.append(
+                f"worker {wid} rc={p.returncode}\n{err.decode()[-2000:]}"
+            )
+    assert not failures, "\n".join(failures)
+
+
+def read_parts(tmp_path: Path, name: str) -> list[dict]:
+    """Union of per-worker jsonlines part files."""
+    rows = []
+    for f in sorted(tmp_path.glob(f"{name}*")):
+        for line in f.read_text().splitlines():
+            if line.strip():
+                rows.append(json.loads(line))
+    return rows
+
+
+def final_rows(events: list[dict], keys: list[str]) -> dict:
+    """Collapse a change stream (diff ±1) into final multiset of rows."""
+    counts: dict = {}
+    for e in events:
+        k = tuple(e[c] for c in keys)
+        counts[k] = counts.get(k, 0) + e["diff"]
+    return {k: c for k, c in counts.items() if c != 0}
+
+
+STATIC_GROUPBY = """
+    import sys
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_markdown
+
+    out_dir = sys.argv[1]
+    t = table_from_markdown(
+        '''
+        k | v
+        0 | 1
+        1 | 2
+        0 | 3
+        2 | 4
+        1 | 5
+        2 | 6
+        0 | 7
+        3 | 8
+        '''
+    )
+    grouped = t.groupby(pw.this.k).reduce(
+        pw.this.k, total=pw.reducers.sum(pw.this.v)
+    )
+    pw.io.fs.write(grouped, out_dir + "/out.jsonl", format="json")
+    pw.run(monitoring_level=None)
+"""
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+def test_static_groupby_sharded(n, tmp_path):
+    run_workers(STATIC_GROUPBY, n, tmp_path)
+    rows = read_parts(tmp_path, "out.jsonl")
+    assert final_rows(rows, ["k", "total"]) == {
+        (0, 11): 1,
+        (1, 7): 1,
+        (2, 10): 1,
+        (3, 8): 1,
+    }
+
+
+JOIN_SCRIPT = """
+    import sys
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_markdown
+
+    out_dir = sys.argv[1]
+    left = table_from_markdown(
+        '''
+        k | a
+        1 | 10
+        2 | 20
+        3 | 30
+        4 | 40
+        '''
+    )
+    right = table_from_markdown(
+        '''
+        k | b
+        1 | 100
+        2 | 200
+        4 | 400
+        5 | 500
+        '''
+    )
+    joined = left.join(right, left.k == right.k).select(
+        pw.left.k, pw.this.a, pw.this.b
+    )
+    pw.io.fs.write(joined, out_dir + "/join.jsonl", format="json")
+    pw.run(monitoring_level=None)
+"""
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_join_sharded(n, tmp_path):
+    run_workers(JOIN_SCRIPT, n, tmp_path)
+    rows = read_parts(tmp_path, "join.jsonl")
+    assert final_rows(rows, ["k", "a", "b"]) == {
+        (1, 10, 100): 1,
+        (2, 20, 200): 1,
+        (4, 40, 400): 1,
+    }
+
+
+STREAMING_SCRIPT = """
+    import sys
+    import time
+    import pathway_tpu as pw
+
+    out_dir = sys.argv[1]
+
+    class InSchema(pw.Schema):
+        k: int
+        v: int
+
+    class Numbers(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(60):
+                self.next(k=i % 5, v=i)
+                if i % 10 == 9:
+                    self.commit()
+                    time.sleep(0.01)
+
+    t = pw.io.python.read(Numbers(), schema=InSchema)
+    grouped = t.groupby(pw.this.k).reduce(
+        pw.this.k,
+        total=pw.reducers.sum(pw.this.v),
+        cnt=pw.reducers.count(),
+    )
+    pw.io.fs.write(grouped, out_dir + "/stream.jsonl", format="json")
+    pw.run(monitoring_level=None)
+"""
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+def test_streaming_exclusive_source_sharded(n, tmp_path):
+    run_workers(STREAMING_SCRIPT, n, tmp_path)
+    rows = read_parts(tmp_path, "stream.jsonl")
+    # final state per key k: sum of v for v in 0..59 with v%5==k (12 values)
+    expected = {}
+    for k in range(5):
+        vals = [v for v in range(60) if v % 5 == k]
+        expected[(k, sum(vals), len(vals))] = 1
+    assert final_rows(rows, ["k", "total", "cnt"]) == expected
+
+
+FILTER_SELECT_CONCAT = """
+    import sys
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_markdown
+
+    out_dir = sys.argv[1]
+    t = table_from_markdown(
+        '''
+        v
+        1
+        2
+        3
+        4
+        5
+        6
+        7
+        8
+        '''
+    )
+    evens = t.filter(pw.this.v % 2 == 0).select(v=pw.this.v * 10)
+    odds = t.filter(pw.this.v % 2 == 1).select(v=pw.this.v * 100)
+    both = evens.concat_reindex(odds)
+    pw.io.fs.write(both, out_dir + "/cat.jsonl", format="json")
+    pw.run(monitoring_level=None)
+"""
+
+
+def test_concat_sharded(tmp_path):
+    run_workers(FILTER_SELECT_CONCAT, 2, tmp_path)
+    rows = read_parts(tmp_path, "cat.jsonl")
+    assert final_rows(rows, ["v"]) == {
+        (20,): 1, (40,): 1, (60,): 1, (80,): 1,
+        (100,): 1, (300,): 1, (500,): 1, (700,): 1,
+    }
+
+
+REST_SCRIPT = """
+    import json
+    import sys
+    import threading
+    import time
+    import urllib.request
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals.config import pathway_config
+    from pathway_tpu.io.http import rest_connector
+
+    out_dir, port = sys.argv[1], int(sys.argv[2])
+
+    class QuerySchema(pw.Schema):
+        text: str
+
+    queries, response_writer = rest_connector(
+        host="127.0.0.1", port=port, schema=QuerySchema,
+        autocommit_duration_ms=50,
+    )
+    result = queries.select(result=pw.apply(str.upper, pw.this.text))
+    response_writer(result)
+
+    def client():
+        # only worker 0 runs the webserver; it also drives the requests
+        if pathway_config.process_id != 0:
+            return
+        deadline = time.monotonic() + 30
+        answers = []
+        for q in ["alpha", "bravo", "charlie", "delta", "echo", "foxtrot"]:
+            while True:
+                try:
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{port}/",
+                        data=json.dumps({"text": q}).encode(),
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=10) as resp:
+                        answers.append(json.loads(resp.read()))
+                    break
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.2)
+        with open(out_dir + "/answers.json", "w") as f:
+            json.dump(answers, f)
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+
+    # run until worker 0's client finished; its terminate vote stops the
+    # whole process group in lockstep
+    import pathway_tpu.internals.runner as runner
+    from pathway_tpu.internals.parse_graph import G
+
+    engine = runner._make_engine()
+    ctx = runner.RunContext(engine)
+    for sink in G.sinks:
+        nodes = [ctx.node(tab) for tab in sink.tables]
+        sink.attach(ctx, nodes)
+
+    if pathway_config.process_id == 0:
+        def watchdog():
+            t.join()
+            time.sleep(1.0)
+            engine.terminate_flag.set()
+
+        threading.Thread(target=watchdog, daemon=True).start()
+    from pathway_tpu.io._connector_runtime import StreamingDriver
+
+    StreamingDriver(engine, ctx, autocommit_ms=50.0).run(G.sources)
+"""
+
+
+@pytest.mark.parametrize("n", [2])
+def test_rest_roundtrip_multiworker(n, tmp_path):
+    """REST ingress on worker 0; queries shard across workers; responses
+    gather back to worker 0 (the regression: pending futures live only in
+    the webserver process)."""
+    port = _free_port_base(1)
+    script = REST_SCRIPT
+    path = tmp_path / "pipeline.py"
+    path.write_text(textwrap.dedent(script))
+    base = _free_port_base(n)
+    procs = []
+    for wid in range(n):
+        env = dict(os.environ)
+        env.update(
+            PATHWAY_PROCESSES=str(n),
+            PATHWAY_PROCESS_ID=str(wid),
+            PATHWAY_FIRST_PORT=str(base),
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=str(REPO),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(path), str(tmp_path), str(port)],
+                env=env, cwd=tmp_path,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            )
+        )
+    for wid, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=90)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError(f"rest worker {wid} timed out")
+        assert p.returncode == 0, f"worker {wid}: {err.decode()[-2000:]}"
+    answers = json.loads((tmp_path / "answers.json").read_text())
+    assert answers == [
+        "ALPHA", "BRAVO", "CHARLIE", "DELTA", "ECHO", "FOXTROT",
+    ]
