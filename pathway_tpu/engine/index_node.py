@@ -44,6 +44,14 @@ class IndexImpl:
             self.search(v, k, f) for v, k, f in zip(values, ks, filters)
         ]
 
+    def add_many(
+        self, keys: List[Pointer], values: List[Any], metas: List[Any]
+    ) -> None:
+        """Batched insert — backends override to embed/scatter a whole
+        engine batch in one device dispatch."""
+        for key, value, meta in zip(keys, values, metas):
+            self.add(key, value, meta)
+
 
 class ExternalIndexNode(Node):
     """inputs: [data, queries]. Output universe = query keys; columns =
@@ -105,18 +113,42 @@ class ExternalIndexNode(Node):
                 if self.data_filter_prog is not None
                 else [None] * len(keys)
             )
+            # buffer consecutive inserts so backends get one batched
+            # add_many (one embed+scatter dispatch) per engine batch; a
+            # remove for a buffered key flushes first to keep delta order
+            pend_keys: list = []
+            pend_values: list = []
+            pend_metas: list = []
+
+            def _flush_adds():
+                if pend_keys:
+                    self.index.add_many(
+                        list(pend_keys), list(pend_values), list(pend_metas)
+                    )
+                    pend_keys.clear()
+                    pend_values.clear()
+                    pend_metas.clear()
+
+            pending_set: Set[Pointer] = set()
             for (key, row, diff), value, meta in zip(data_deltas, values, metas):
                 if diff > 0:
                     if isinstance(value, Error) or value is None:
                         self.log_error("index: invalid data value")
                         continue
-                    self.index.add(key, value, meta)
+                    pend_keys.append(key)
+                    pend_values.append(value)
+                    pend_metas.append(meta)
+                    pending_set.add(key)
                     self.data_rows[key] = row
                     index_changed = True
                 else:
+                    if key in pending_set:
+                        _flush_adds()
+                        pending_set.clear()
                     self.index.remove(key)
                     self.data_rows.pop(key, None)
                     index_changed = True
+            _flush_adds()
 
         out = []
         if query_deltas:

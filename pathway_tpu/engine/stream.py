@@ -22,6 +22,17 @@ def consolidate(deltas: Iterable[Delta]) -> List[Delta]:
     """Sum diffs of identical (key, values); drop zero net changes. Keeps
     retractions before insertions per key so single-valued state transitions
     are well-ordered."""
+    if not isinstance(deltas, list):
+        deltas = list(deltas)
+    # fast path: pure insert batches with distinct keys (the bulk-ingest
+    # shape) need no value hashing at all — only key uniqueness matters
+    seen_keys: set = set()
+    for key, _values, diff in deltas:
+        if diff < 0 or key in seen_keys:
+            break
+        seen_keys.add(key)
+    else:
+        return deltas
     acc: dict = {}
     order: list = []
     for key, values, diff in deltas:

@@ -187,7 +187,7 @@ class Json:
     """Wrapper marking a value as a JSON document (reference:
     internals/json.py:31, Value::Json). Provides typed accessors."""
 
-    __slots__ = ("value",)
+    __slots__ = ("value", "_hash")
 
     NULL: "Json"
 
@@ -195,6 +195,7 @@ class Json:
         if isinstance(value, Json):
             value = value.value
         self.value = value
+        self._hash: int | None = None
 
     def __eq__(self, other):
         if isinstance(other, Json):
@@ -202,7 +203,23 @@ class Json:
         return NotImplemented
 
     def __hash__(self):
-        return hash(_json.dumps(self.value, sort_keys=True, default=str))
+        # consolidation hashes every row it groups; serializing the doc
+        # each time made json.dumps the engine's hottest function
+        if self._hash is None:
+            self._hash = hash(
+                _json.dumps(self.value, sort_keys=True, default=str)
+            )
+        return self._hash
+
+    def __getstate__(self):
+        # never ship the cached hash across processes: str hashes are
+        # per-interpreter (PYTHONHASHSEED), so a pickled _hash from worker A
+        # would break hash/eq consistency on worker B
+        return self.value
+
+    def __setstate__(self, state):
+        self.value = state
+        self._hash = None
 
     def __repr__(self):
         return _json.dumps(self.value, default=str)

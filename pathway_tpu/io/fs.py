@@ -128,6 +128,7 @@ class _FsSubject(ConnectorSubjectBase):
 
     def run(self) -> None:
         while True:
+            emitted_any = False
             for f in self._list_files():
                 try:
                     mtime = os.stat(f).st_mtime
@@ -137,7 +138,13 @@ class _FsSubject(ConnectorSubjectBase):
                     continue
                 self._seen[f] = mtime
                 self._emit_file(f)
-            self.commit()
+                # commit per file: downstream batches pipeline host-side
+                # parsing of file N+1 against the (async-dispatched) device
+                # work of file N
+                self.commit()
+                emitted_any = True
+            if not emitted_any:
+                self.commit()
             if self.mode == "static":
                 return
             time_mod.sleep(self.refresh_interval)

@@ -36,10 +36,23 @@ class SentenceEncoder:
         mesh=None,
     ):
         self.name = model
+        params = None
+        tokenizer = None
+        from pathway_tpu.models import hf_loader
+
+        if hf_loader.is_checkpoint_dir(model):
+            # real weights: local HF-checkpoint dir (safetensors/.bin/.npz
+            # + vocab.txt). The random-weight hash-tokenizer path stays the
+            # offline default (reference: embedders.py:342 downloads the
+            # model; this environment has zero egress).
+            config, params = hf_loader.load_hf_encoder(model)
+            tokenizer = hf_loader.load_tokenizer(model)
         self.config = config or MINILM_L6
         self.max_len = min(max_len, self.config.max_len)
-        self.tokenizer = HashTokenizer(vocab_size=self.config.vocab_size)
-        self.lm = TransformerLM(self.config, seed=seed)
+        self.tokenizer = tokenizer or HashTokenizer(
+            vocab_size=self.config.vocab_size
+        )
+        self.lm = TransformerLM(self.config, params=params, seed=seed)
         self.mesh = mesh
 
     @classmethod

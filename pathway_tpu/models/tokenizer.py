@@ -69,6 +69,103 @@ class HashTokenizer:
         return len(self.tokenize(text))
 
 
+class WordPieceTokenizer:
+    """Real WordPiece over a vocab file (reference: the HF tokenizer the
+    reference loads for SentenceTransformer models, embedders.py:342).
+
+    Greedy longest-match-first with `##` continuation pieces — the BERT
+    algorithm — so ids match HuggingFace's BertTokenizer for ASCII text.
+    Special ids come from the vocab ([PAD]/[CLS]/[SEP]/[UNK])."""
+
+    def __init__(self, vocab, lowercase: bool = True):
+        if isinstance(vocab, (str, bytes)):
+            with open(vocab, encoding="utf-8") as f:
+                tokens = [line.rstrip("\n") for line in f]
+            vocab = {tok: i for i, tok in enumerate(tokens) if tok}
+        self.vocab: dict = dict(vocab)
+        self.lowercase = lowercase
+        self.vocab_size = max(self.vocab.values()) + 1 if self.vocab else 0
+        self.pad_id = self.vocab.get("[PAD]", 0)
+        self.cls_id = self.vocab.get("[CLS]", 1)
+        self.sep_id = self.vocab.get("[SEP]", 2)
+        self.unk_id = self.vocab.get("[UNK]", 3)
+        self._inv: dict | None = None
+
+    def tokenize(self, text: str) -> List[str]:
+        if self.lowercase:
+            text = text.lower()
+        pieces: List[str] = []
+        for word in _WORD_RE.findall(text):
+            pieces.extend(self._wordpiece(word))
+        return pieces
+
+    def _wordpiece(self, word: str, max_chars: int = 100) -> List[str]:
+        if len(word) > max_chars:
+            return ["[UNK]"]
+        out: List[str] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return ["[UNK]"]
+            out.append(piece)
+            start = end
+        return out
+
+    def token_id(self, token: str) -> int:
+        return self.vocab.get(token, self.unk_id)
+
+    def encode(self, text: str, max_len: int | None = None) -> List[int]:
+        ids = (
+            [self.cls_id]
+            + [self.token_id(t) for t in self.tokenize(text)]
+            + [self.sep_id]
+        )
+        if max_len is not None and len(ids) > max_len:
+            # HF truncation keeps [SEP] as the final token
+            ids = ids[: max_len - 1] + [self.sep_id]
+        return ids
+
+    def encode_pair(self, a: str, b: str, max_len: int | None = None) -> List[int]:
+        ids = (
+            [self.cls_id]
+            + [self.token_id(t) for t in self.tokenize(a)]
+            + [self.sep_id]
+            + [self.token_id(t) for t in self.tokenize(b)]
+            + [self.sep_id]
+        )
+        if max_len is not None and len(ids) > max_len:
+            ids = ids[: max_len - 1] + [self.sep_id]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        if self._inv is None:
+            self._inv = {i: t for t, i in self.vocab.items()}
+        specials = {self.pad_id, self.cls_id, self.sep_id}
+        words: List[str] = []
+        for i in ids:
+            if i in specials:
+                continue
+            tok = self._inv.get(int(i), "[UNK]")
+            if tok.startswith("##") and words:
+                words[-1] += tok[2:]
+            else:
+                words.append(tok)
+        return " ".join(words)
+
+    def count_tokens(self, text: str) -> int:
+        return len(self.tokenize(text))
+
+
 def bucket_length(n: int, minimum: int = 16, maximum: int = 512) -> int:
     b = minimum
     while b < n and b < maximum:
@@ -90,6 +187,7 @@ def encode_batch(
     if (
         pair_texts is None
         and texts
+        and isinstance(tokenizer, HashTokenizer)
         and tokenizer.lowercase
         and all(t.isascii() for t in texts)
     ):
@@ -110,7 +208,8 @@ def encode_batch(
     seq_len = bucket_length(longest, maximum=max_len)
     batch = len(encoded)
     padded_batch = bucket_length(max(batch, 1), minimum=8, maximum=1 << 16) if batch_bucket else batch
-    ids = np.full((padded_batch, seq_len), PAD_ID, dtype=np.int32)
+    pad_id = getattr(tokenizer, "pad_id", PAD_ID)
+    ids = np.full((padded_batch, seq_len), pad_id, dtype=np.int32)
     mask = np.zeros((padded_batch, seq_len), dtype=np.int32)
     for i, e in enumerate(encoded):
         e = e[:seq_len]

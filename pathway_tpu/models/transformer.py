@@ -35,6 +35,10 @@ class TransformerConfig:
     causal: bool = False
     pooling: str = "mean"  # mean | cls | none
     dtype: str = "bfloat16"
+    # "pre" = GPT-style pre-LN (default, trains stably from scratch);
+    # "post" = BERT/MiniLM layout (embedding LayerNorm, residual-then-LN,
+    # erf GELU) — required for loading real HF encoder checkpoints
+    norm_style: str = "pre"
 
     @property
     def head_dim(self) -> int:
@@ -154,7 +158,11 @@ def _attention(q, k, v, mask, causal: bool, use_flash):
     import jax.numpy as jnp
 
     if use_flash is None:
-        use_flash = jax.default_backend() == "tpu"
+        # flash wins where O(L^2) score materialization hurts; at short L
+        # the dense MXU path is ~2x faster (measured: L=64 MiniLM batch,
+        # 20.6k vs 9.4k docs/s on v5e) and Mosaic small-block tiling is
+        # untested territory — so gate flash to long sequences
+        use_flash = jax.default_backend() == "tpu" and q.shape[2] > 256
     if use_flash:
         from pathway_tpu.ops.kernels import flash_attention
 
@@ -180,16 +188,29 @@ def forward(
 ):
     """Encoder/decoder forward. ids, mask: [B, L] int32. Returns pooled
     embeddings [B, H] (pooling != none), else logits [B, L, V]."""
+    import jax
     import jax.numpy as jnp
 
     compute_dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    post_ln = config.norm_style == "post"
     b, l = ids.shape
     x = params["embed"][ids] + params["pos_embed"][:l][None, :, :]
+    if post_ln and "type_embed" in params:
+        x = x + params["type_embed"][0][None, None, :]
+    if post_ln and "embed_ln" in params:
+        x = _layer_norm(
+            x, params["embed_ln"]["scale"], params["embed_ln"]["bias"],
+            eps=1e-12,
+        )
     x = x.astype(compute_dtype)
+    eps = 1e-12 if post_ln else 1e-6
 
     heads, hd = config.heads, config.head_dim
     for layer in params["layers"]:
-        y = _layer_norm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+        if post_ln:
+            y = x  # BERT: attention reads the residual stream directly
+        else:
+            y = _layer_norm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
         qkv = (
             y @ layer["qkv"].astype(compute_dtype)
             + layer["qkv_b"].astype(compute_dtype)
@@ -201,22 +222,47 @@ def forward(
         ctx = _attention(q, k, v, mask, config.causal, use_flash)
         ctx = ctx.astype(compute_dtype)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, l, config.hidden)
-        x = x + (
+        attn_out = (
             ctx @ layer["out"].astype(compute_dtype)
             + layer["out_b"].astype(compute_dtype)
         )
-        y = _layer_norm(x, layer["ln2"]["scale"], layer["ln2"]["bias"])
+        if post_ln:
+            x = _layer_norm(
+                x + attn_out, layer["ln1"]["scale"], layer["ln1"]["bias"],
+                eps=eps,
+            ).astype(compute_dtype)
+            y = x
+        else:
+            x = x + attn_out
+            y = _layer_norm(x, layer["ln2"]["scale"], layer["ln2"]["bias"])
         y = (
             y @ layer["up"].astype(compute_dtype)
             + layer["up_b"].astype(compute_dtype)
         )
-        y = y * 0.5 * (1.0 + jnp.tanh(0.7978845608 * (y + 0.044715 * y**3)))
-        x = x + (
+        if post_ln:
+            # exact erf GELU (BERT convention), in f32 for checkpoint parity
+            y32 = y.astype(jnp.float32)
+            y = (y32 * 0.5 * (1.0 + jax.scipy.special.erf(
+                y32 * 0.7071067811865476
+            ))).astype(compute_dtype)
+        else:
+            y = y * 0.5 * (
+                1.0 + jnp.tanh(0.7978845608 * (y + 0.044715 * y**3))
+            )
+        mlp_out = (
             y @ layer["down"].astype(compute_dtype)
             + layer["down_b"].astype(compute_dtype)
         )
+        if post_ln:
+            x = _layer_norm(
+                x + mlp_out, layer["ln2"]["scale"], layer["ln2"]["bias"],
+                eps=eps,
+            ).astype(compute_dtype)
+        else:
+            x = x + mlp_out
 
-    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    if not post_ln:
+        x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
     if return_hidden or config.pooling == "none":
         logits = jnp.einsum(
             "blh,vh->blv", x.astype(jnp.float32), params["embed"]

@@ -324,6 +324,28 @@ class DeviceKnnIndex:
         return _format_rows(top_scores, top_idx, key_of_slot)
 
 
+@functools.lru_cache(maxsize=None)
+def _compiled_fused_search(config, metric: str, k: int):
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.transformer import forward
+
+    def fused(params, ids_mask, buffer, valid):
+        # single packed input ([2,B,L]) and single packed output
+        # ([Q, 2k]) — exactly one upload and one fetch per query
+        # batch, which matters when the chip is a network hop away
+        ids, mask = ids_mask[0], ids_mask[1]
+        emb = forward(params, config, ids, mask)
+        scores = _similarity(buffer, valid, emb, metric)
+        top_scores, top_idx = jax.lax.top_k(scores, k)
+        return jnp.concatenate(
+            [top_scores, top_idx.astype(jnp.float32)], axis=1
+        )
+
+    return jax.jit(fused)
+
+
 class FusedEmbedSearch:
     """tokens → encoder → similarity → top_k in ONE jit call.
 
@@ -334,36 +356,14 @@ class FusedEmbedSearch:
     def __init__(self, encoder, index: DeviceKnnIndex):
         self.encoder = encoder
         self.index = index
-        self._fns: dict = {}
 
     def _fn(self, k: int):
-        import jax
-
-        key = k
-        fn = self._fns.get(key)
-        if fn is None:
-            import jax.numpy as jnp
-
-            from pathway_tpu.models.transformer import forward
-
-            config = self.encoder.config
-            metric = self.index.metric
-
-            def fused(params, ids_mask, buffer, valid):
-                # single packed input ([2,B,L]) and single packed output
-                # ([Q, 2k]) — exactly one upload and one fetch per query
-                # batch, which matters when the chip is a network hop away
-                ids, mask = ids_mask[0], ids_mask[1]
-                emb = forward(params, config, ids, mask)
-                scores = _similarity(buffer, valid, emb, metric)
-                top_scores, top_idx = jax.lax.top_k(scores, k)
-                return jnp.concatenate(
-                    [top_scores, top_idx.astype(jnp.float32)], axis=1
-                )
-
-            fn = jax.jit(fused)
-            self._fns[key] = fn
-        return fn
+        # process-global cache keyed on (config, metric, k): a fresh
+        # FusedEmbedSearch (e.g. a rebuilt DocumentStore) reuses the already
+        # compiled executable instead of retracing per instance
+        return _compiled_fused_search(
+            self.encoder.config, self.index.metric, k
+        )
 
     def embed_and_add(self, keys, texts) -> None:
         """Embed a doc batch and scatter into the index, fully device-side

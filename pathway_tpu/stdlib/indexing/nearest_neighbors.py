@@ -77,6 +77,78 @@ class _KnnIndexImpl(IndexImpl):
         return out
 
 
+class _FusedKnnIndexImpl(IndexImpl):
+    """Embed+search fused into one device dispatch per batch.
+
+    When the embedder is a local JAX sentence encoder, documents and queries
+    arrive as raw text and the impl runs tokenize → encoder → similarity →
+    top_k as a single jit call (ops/knn.py FusedEmbedSearch). Document
+    embeddings are computed and scattered into the device index without ever
+    leaving HBM. This is the framework wiring of SURVEY §3.4's hot path."""
+
+    def __init__(self, encoder, metric: str, reserved_space: int):
+        from pathway_tpu.ops.knn import DeviceKnnIndex, FusedEmbedSearch
+
+        self.knn = DeviceKnnIndex(
+            encoder.dimension, metric=metric, reserved_space=reserved_space
+        )
+        self.fused = FusedEmbedSearch(encoder, self.knn)
+        self.metadata: dict = {}
+
+    def add(self, key, value, metadata) -> None:
+        self.add_many([key], [value], [metadata])
+
+    def add_many(self, keys, values, metas) -> None:
+        texts = [v if isinstance(v, str) else str(v) for v in values]
+        self.fused.embed_and_add(keys, texts)
+        for key, meta in zip(keys, metas):
+            if meta is not None:
+                self.metadata[key] = meta
+
+    def remove(self, key) -> None:
+        self.knn.remove(key)
+        self.metadata.pop(key, None)
+
+    def search(self, value, k, metadata_filter):
+        return self.search_many([value], [k], [metadata_filter])[0]
+
+    def search_many(self, values, ks, filters):
+        if not values:
+            return []
+        if len(self.knn) == 0:
+            return [[] for _ in values]
+        k_max = max(int(k) for k in ks) if ks else 3
+        fetch = min(
+            len(self.knn),
+            k_max * 4 if any(f for f in filters) else k_max,
+        )
+        texts = [v if isinstance(v, str) else str(v) for v in values]
+        rows = self.fused.search_texts(texts, fetch)
+        out = []
+        for row, k, filt in zip(rows, ks, filters):
+            if filt:
+                row = [
+                    (key, s)
+                    for key, s in row
+                    if evaluate_filter(filt, self.metadata.get(key))
+                ]
+            out.append(row[: int(k)])
+        return out
+
+
+def _local_jax_encoder(embedder):
+    """The fused path needs a device-resident encoder: a
+    SentenceTransformerEmbedder-style object exposing `.encoder` with
+    tokenizer/params. API-backed embedders (OpenAI etc.) return None and
+    keep the UDF pre-embedding path."""
+    encoder = getattr(embedder, "encoder", None)
+    if encoder is not None and hasattr(encoder, "lm") and hasattr(
+        encoder, "tokenizer"
+    ):
+        return encoder
+    return None
+
+
 class BruteForceKnn(InnerIndex):
     """Exact KNN on the TPU mesh (reference: nearest_neighbors.py
     BruteForceKnn:170; kernel: brute_force_knn_integration.rs → ops/knn.py)."""
@@ -98,17 +170,22 @@ class BruteForceKnn(InnerIndex):
         self.embedder = embedder
 
     def _make_impl(self) -> IndexImpl:
+        encoder = _local_jax_encoder(self.embedder)
+        if encoder is not None:
+            return _FusedKnnIndexImpl(
+                encoder, self.metric.value, self.reserved_space
+            )
         return _KnnIndexImpl(
             self.dimensions, self.metric.value, self.reserved_space
         )
 
     def _query_preprocess(self, query_column):
-        if self.embedder is not None:
+        if self.embedder is not None and _local_jax_encoder(self.embedder) is None:
             return self.embedder(query_column)
         return query_column
 
     def _data_preprocess(self, data_column):
-        if self.embedder is not None:
+        if self.embedder is not None and _local_jax_encoder(self.embedder) is None:
             return self.embedder(data_column)
         return data_column
 

@@ -66,7 +66,7 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         from pathway_tpu.models.minilm import SentenceEncoder
 
         self.model = model
-        self.encoder = SentenceEncoder.cached(model)
+        self.encoder = SentenceEncoder.cached(model, **init_kwargs)
         self.kwargs = dict(init_kwargs)
 
         def embed_batch(texts: List[str]) -> List[np.ndarray]:

@@ -301,3 +301,51 @@ def test_bm25_and_hybrid():
     (capture2,) = run_tables(res2)
     (row2,) = capture2.state.rows.values()
     assert "banana bread recipe" in row2[0]
+
+
+def test_fused_knn_framework_path():
+    """The DocumentStore/DataIndex path with a local JAX embedder must take
+    the fused embed+search route: no UDF pre-embedding, raw text reaches the
+    index impl, and retrieval of an exact duplicate text returns that doc
+    (cos self-similarity = 1)."""
+    from pathway_tpu.models.transformer import TransformerConfig
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+        BruteForceKnn,
+        _FusedKnnIndexImpl,
+    )
+    from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+
+    tiny = TransformerConfig(
+        vocab_size=512, hidden=32, layers=1, heads=2, mlp_dim=64, max_len=32
+    )
+    embedder = SentenceTransformerEmbedder(
+        "tiny-test-model", config=tiny, max_len=16
+    )
+
+    docs = pw.debug.table_from_markdown(
+        """
+        text
+        alpha_bravo_charlie
+        delta_echo_foxtrot
+        golf_hotel_india
+        """
+    )
+    inner = BruteForceKnn(
+        docs.text, dimensions=embedder.get_embedding_dimension(),
+        embedder=embedder,
+    )
+    assert isinstance(inner._make_impl(), _FusedKnnIndexImpl)
+
+    from pathway_tpu.stdlib.indexing.data_index import DataIndex
+
+    index = DataIndex(docs, inner)
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(q=str), [("delta_echo_foxtrot",)]
+    )
+    res = index.query_as_of_now(queries.q, number_of_matches=1).select(
+        m=pw.this.text, s=pw.this._pw_index_reply_score
+    )
+    (capture,) = run_tables(res)
+    (row,) = capture.state.rows.values()
+    assert row[0] == ("delta_echo_foxtrot",)
+    assert abs(row[1][0] - 1.0) < 1e-3
