@@ -70,6 +70,15 @@ from pathway_tpu.internals.schema import (
     schema_from_types,
 )
 from pathway_tpu.internals.async_transformer import AsyncTransformer
+from pathway_tpu.internals.row_transformer import (
+    ClassArg,
+    attribute,
+    input_attribute,
+    input_method,
+    method,
+    output_attribute,
+    transformer,
+)
 from pathway_tpu.internals.table import Table, TableSlice
 from pathway_tpu.internals.thisclass import left, right, this
 from pathway_tpu.engine.value import (

@@ -85,3 +85,8 @@ def main(argv=None) -> int:
 
 if __name__ == "__main__":
     sys.exit(main())
+
+
+def entry() -> None:
+    """console_scripts entry point (pyproject.toml [project.scripts])."""
+    sys.exit(main())

@@ -32,15 +32,21 @@ class EngineError(Exception):
 
 
 class ErrorLogEntry:
-    __slots__ = ("message", "operator", "time")
+    __slots__ = ("message", "operator", "time", "trace")
 
-    def __init__(self, message: str, operator: str = "", time: int = 0):
+    def __init__(
+        self, message: str, operator: str = "", time: int = 0, trace=None
+    ):
         self.message = message
         self.operator = operator
         self.time = time
+        self.trace = trace  # user frame that created the operator
 
     def __repr__(self):
-        return f"ErrorLogEntry({self.message!r}, {self.operator!r}, t={self.time})"
+        base = f"ErrorLogEntry({self.message!r}, {self.operator!r}, t={self.time})"
+        if self.trace is not None:
+            base += f" [{self.trace}]"
+        return base
 
 
 class Node:
@@ -93,6 +99,26 @@ class Node:
 
     def log_error(self, message: str) -> None:
         self.engine.log_error(message, operator=self.name, trace=self.trace)
+
+    # -- operator snapshots (reference: dataflow/persist.rs MaybePersist,
+    # persistence/operator_snapshot.rs:231) ------------------------------
+    # Class lists the attrs that constitute its persistent operator state.
+    # Nodes are snapshot at a quiescent frontier (all queues drained), so
+    # wiring attrs (pending/downstream) are never part of state.
+    snapshot_attrs: tuple = ()
+
+    def snapshot_state(self) -> dict | None:
+        if not self.snapshot_attrs:
+            return None
+        return {a: getattr(self, a) for a in self.snapshot_attrs}
+
+    def restore_state(self, state: dict) -> None:
+        for a, v in state.items():
+            setattr(self, a, v)
+        self._after_restore()
+
+    def _after_restore(self) -> None:
+        """Hook for nodes that must rebuild derived/device structures."""
 
 
 class Engine:
@@ -160,7 +186,16 @@ class Engine:
         return any(self.coord.agree(bool(flag)))
 
     def log_error(self, message: str, operator: str = "", trace=None) -> None:
-        entry = ErrorLogEntry(message, operator, self.current_time)
+        # default attribution to the node being processed right now — this
+        # catches expression/UDF errors logged through bare engine loggers
+        # (reference: OperatorProperties carry the user frame, graph.rs:431)
+        node = getattr(self, "current_node", None)
+        if node is not None:
+            if not operator:
+                operator = node.name
+            if trace is None:
+                trace = node.trace
+        entry = ErrorLogEntry(message, operator, self.current_time, trace)
         self.error_log.append(entry)
         for n in self.error_log_nodes:
             n.push(entry)
@@ -171,8 +206,12 @@ class Engine:
     def process_time(self, time: int) -> None:
         self.current_time = time
         self._scheduled_times.discard(time)
-        for node in self.nodes:
-            node.process(time)
+        try:
+            for node in self.nodes:
+                self.current_node = node
+                node.process(time)
+        finally:
+            self.current_node = None
         for node in self.nodes:
             node.on_time_end(time)
 
@@ -224,6 +263,7 @@ class StaticSource(Node):
     """All rows present at time 0 (reference: static_table, engine.pyi)."""
 
     name = "static"
+    snapshot_attrs = ('_emitted',)
 
     def __init__(self, engine: Engine, rows: Dict[Pointer, tuple]):
         super().__init__(engine, [])
@@ -244,6 +284,7 @@ class TimedSource(Node):
     __time__/__diff__ columns; StreamGenerator)."""
 
     name = "timed_source"
+    snapshot_attrs = ('_by_time',)
 
     def __init__(self, engine: Engine, events: List[Tuple[int, Delta]]):
         super().__init__(engine, [])
@@ -272,6 +313,7 @@ class InputQueueSource(Node):
     it False and get a scatter ExchangeNode appended instead."""
 
     name = "input"
+    snapshot_attrs = ('_by_time',)
 
     def __init__(self, engine: Engine, *, shard_filter: bool = True):
         super().__init__(engine, [])
@@ -320,6 +362,11 @@ class RowwiseNode(Node):
         if self.multi or not deterministic:
             self.in_states = [TableState() for _ in inputs]
             self.out_state: Dict[Pointer, tuple] = {}
+
+    def snapshot_state(self) -> dict | None:
+        if self.multi or not self.deterministic:
+            return {"in_states": self.in_states, "out_state": self.out_state}
+        return None
 
     def process(self, time: int) -> None:
         if not self.multi and self.deterministic:
@@ -443,6 +490,7 @@ class CaptureNode(Node):
     result extraction). Also records the update stream when asked."""
 
     name = "capture"
+    snapshot_attrs = ('state', 'stream')
 
     def __init__(self, engine: Engine, input_: Node, *, record_stream: bool = False):
         super().__init__(engine, [input_])
@@ -464,6 +512,7 @@ class SubscribeNode(Node):
     engine.pyi:714-725)."""
 
     name = "subscribe"
+    snapshot_attrs = ('_saw_data_at',)
 
     def __init__(
         self,
@@ -506,6 +555,7 @@ class ErrorLogNode(Node):
     graph.rs:932)."""
 
     name = "error_log"
+    snapshot_attrs = ('_pending_entries', '_count')
 
     def __init__(self, engine: Engine):
         super().__init__(engine, [])

@@ -334,11 +334,18 @@ def _make_exchange_node():
             me = coord.worker_id
             parts: List[list] = [[] for _ in range(w_count)]
             if deltas:
-                keys = [d[0] for d in deltas]
-                rows = ([d[1] for d in deltas],)
-                shards = self.route_fn(keys, rows)
-                for d, sh in zip(deltas, shards):
-                    parts[sh % w_count].append(d)
+                if self.route_fn is None:
+                    # broadcast: every worker receives every delta
+                    # (reference: timely Broadcast, used for threshold /
+                    # index streams every worker must see in full)
+                    for w in range(w_count):
+                        parts[w] = list(deltas)
+                else:
+                    keys = [d[0] for d in deltas]
+                    rows = ([d[1] for d in deltas],)
+                    shards = self.route_fn(keys, rows)
+                    for d, sh in zip(deltas, shards):
+                        parts[sh % w_count].append(d)
             for w in range(w_count):
                 if w != me and parts[w]:
                     coord.send_data(w, self.channel, time, parts[w])
@@ -369,6 +376,13 @@ def _exchange(engine, node, route_fn):
     if _exchange_node_cls is None:
         _exchange_node_cls = _make_exchange_node()
     return _exchange_node_cls(engine, node, route_fn)
+
+
+def exchange_broadcast(engine, node):
+    """Replicate a (small) delta stream to every worker — each worker sees
+    the full table (reference: timely ``Broadcast`` on the external-index
+    and gradual-broadcast threshold streams)."""
+    return _exchange(engine, node, None)
 
 
 def exchange_by_key(engine, node):

@@ -544,7 +544,7 @@ def _compile_apply(expr: ApplyExpression, ctx: EvalContext) -> BatchProgram:
                     for i, r in zip(chunk, res):
                         out[i] = r
                 except Exception as exc:  # noqa: BLE001
-                    logger.error_logger(f"udf: {type(exc).__name__}: {exc}")
+                    logger.error_logger(_udf_error_message(exc))
                     for i in chunk:
                         out[i] = ERROR
             return out
@@ -555,7 +555,7 @@ def _compile_apply(expr: ApplyExpression, ctx: EvalContext) -> BatchProgram:
             try:
                 out[i] = fun(*args, **kwargs)
             except Exception as exc:  # noqa: BLE001
-                logger.error_logger(f"udf: {type(exc).__name__}: {exc}")
+                logger.error_logger(_udf_error_message(exc))
                 out[i] = ERROR
         return out
 
@@ -634,3 +634,18 @@ def _convert_one(v, default, target: dt.DType, unwrap: bool, logger) -> Any:
     except Exception as exc:  # noqa: BLE001
         logger.error_logger(f"convert: {type(exc).__name__}: {exc}")
         return ERROR
+
+
+def _udf_error_message(exc: BaseException) -> str:
+    """Error text citing the user's own source line (reference:
+    internals/trace.py re-attachment of user frames to engine errors)."""
+    msg = f"udf: {type(exc).__name__}: {exc}"
+    try:
+        from pathway_tpu.internals.trace import trace_from_exception
+
+        tr = trace_from_exception(exc)
+        if tr is not None:
+            msg += f" (at {tr})"
+    except Exception:  # noqa: BLE001
+        pass
+    return msg

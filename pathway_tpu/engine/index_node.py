@@ -98,6 +98,24 @@ class ExternalIndexNode(Node):
         self.cache = _DiffCache()
         self._emitted_asof: Dict[Pointer, tuple] = {}
 
+    # device buffers are not pickled; the host-side row copies are the
+    # operator snapshot, and _after_restore re-embeds/scatters them in one
+    # batched dispatch (cheap: one device round trip per restart)
+    snapshot_attrs = ("data_rows", "query_rows", "cache", "_emitted_asof")
+
+    def _after_restore(self) -> None:
+        if not self.data_rows:
+            return
+        keys = list(self.data_rows.keys())
+        rows = ([self.data_rows[k] for k in keys],)
+        values = self.data_value_prog(keys, rows)
+        metas = (
+            self.data_filter_prog(keys, rows)
+            if self.data_filter_prog is not None
+            else [None] * len(keys)
+        )
+        self.index.add_many(keys, values, metas)
+
     def process(self, time: int) -> None:
         data_deltas = self.take(0)
         query_deltas = self.take(1)

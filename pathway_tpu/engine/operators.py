@@ -58,6 +58,7 @@ class JoinNode(Node):
     """
 
     name = "join"
+    snapshot_attrs = ('left_index', 'right_index', 'cache')
 
     def __init__(
         self,
@@ -278,6 +279,7 @@ class ReduceNode(Node):
     """
 
     name = "reduce"
+    snapshot_attrs = ('groups', 'cache', '_seq')
 
     def __init__(
         self,
@@ -401,6 +403,7 @@ class IxNode(Node):
     """
 
     name = "ix"
+    snapshot_attrs = ('source_ptr', 'target_state', 'reverse', 'cache')
 
     def __init__(
         self,
@@ -486,6 +489,7 @@ class SemijoinNode(Node):
     """
 
     name = "semijoin"
+    snapshot_attrs = ('input_state', 'filter_counts', 'cache')
 
     def __init__(
         self,
@@ -546,6 +550,7 @@ class ConcatNode(Node):
     logged as errors and resolved first-writer-wins."""
 
     name = "concat"
+    snapshot_attrs = ('owner',)
 
     def __init__(self, engine: Engine, inputs: List[Node]):
         super().__init__(engine, inputs)
@@ -583,6 +588,7 @@ class UpdateRowsNode(Node):
     (reference: update_rows_table, graph.rs)."""
 
     name = "update_rows"
+    snapshot_attrs = ('base_state', 'other_state', 'cache')
 
     def __init__(self, engine: Engine, base: Node, other: Node):
         super().__init__(engine, [base, other])
@@ -656,6 +662,7 @@ class SortNode(Node):
     operators/prev_next.rs:891, sort_table dataflow.rs:2283)."""
 
     name = "sort"
+    snapshot_attrs = ('rows', 'cache')
 
     def __init__(
         self,
@@ -717,6 +724,7 @@ class DeduplicateNode(Node):
     (reference: Graph::deduplicate, stdlib/stateful/deduplicate.py)."""
 
     name = "deduplicate"
+    snapshot_attrs = ('current', 'cache')
 
     def __init__(
         self,
@@ -771,4 +779,92 @@ class DeduplicateNode(Node):
             val, row = self.current[inst]
             out_key = ref_scalar("dedup", inst)
             self.cache.diff(inst, {out_key: row}, out)
+        self.emit(time, out)
+
+
+class GradualBroadcastNode(Node):
+    """`t._gradual_broadcast(threshold, lower, value, upper)` (reference:
+    src/engine/dataflow/operators/gradual_broadcast.rs:491).
+
+    Attaches an `apx_value` column to every input row: a deterministic
+    per-key fraction in [0,1) decides whether the row reads `upper` or
+    `lower`, with the share of `upper` rows equal to
+    (value - lower) / (upper - lower). As `value` moves, only the rows
+    whose fraction crosses the moving threshold flip — the "gradual" part
+    that avoids retracting the whole table at once (ALS-style use)."""
+
+    name = "gradual_broadcast"
+
+    snapshot_attrs = ("rows", "threshold", "cache")
+
+    def __init__(
+        self,
+        engine: Engine,
+        input_: Node,
+        threshold_node: Node,
+        lower_prog: BatchFn,
+        value_prog: BatchFn,
+        upper_prog: BatchFn,
+    ):
+        from pathway_tpu.engine.exchange import exchange_broadcast
+
+        # the threshold table is tiny and global: replicate it to every
+        # worker so each can interpolate its own rows (the reference
+        # broadcasts the arrangement the same way)
+        threshold_node = exchange_broadcast(engine, threshold_node)
+        super().__init__(engine, [input_, threshold_node])
+        self.lower_prog = lower_prog
+        self.value_prog = value_prog
+        self.upper_prog = upper_prog
+        self.rows: Dict[Pointer, tuple] = {}
+        self.threshold: tuple | None = None
+        self.cache = _DiffCache()
+
+    @staticmethod
+    def _fraction(key: Pointer) -> float:
+        # bit-mix so the fraction is independent of the shard-carrying low
+        # bits and uniform over [0, 1)
+        x = (key.value * 0x9E3779B97F4A7C15) & ((1 << 128) - 1)
+        return (x >> 75) / float(1 << 53)
+
+    def _apx(self, key: Pointer) -> Any:
+        if self.threshold is None:
+            return None
+        lower, value, upper = self.threshold
+        try:
+            span = upper - lower
+            f = (value - lower) / span if span else 1.0
+        except TypeError:
+            return ERROR
+        return upper if self._fraction(key) < f else lower
+
+    def process(self, time: int) -> None:
+        data_deltas = self.take(0)
+        thr_deltas = self.take(1)
+        if not data_deltas and not thr_deltas:
+            return
+        out: List[Delta] = []
+        if thr_deltas:
+            keys = [d[0] for d in thr_deltas if d[2] > 0]
+            rows = ([d[1] for d in thr_deltas if d[2] > 0],)
+            if keys:
+                lowers = self.lower_prog(keys, rows)
+                values = self.value_prog(keys, rows)
+                uppers = self.upper_prog(keys, rows)
+                self.threshold = (lowers[-1], values[-1], uppers[-1])
+        changed_threshold = bool(thr_deltas)
+        for key, row, diff in data_deltas:
+            if diff > 0:
+                self.rows[key] = row
+            else:
+                self.rows.pop(key, None)
+        if changed_threshold:
+            affected = set(self.rows) | set(self.cache.emitted.keys())
+        else:
+            affected = {d[0] for d in data_deltas}
+        for key in affected:
+            if key in self.rows:
+                self.cache.diff(key, {key: (self._apx(key),)}, out)
+            else:
+                self.cache.diff(key, {}, out)
         self.emit(time, out)

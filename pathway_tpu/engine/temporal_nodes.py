@@ -45,6 +45,7 @@ class BufferNode(_ClockedNode):
     """reference: postpone_core (time_column.rs:302)."""
 
     name = "buffer"
+    snapshot_attrs = ('global_now', 'held', 'released')
 
     def __init__(self, engine, input_, threshold_prog, time_prog, *, flush_on_end: bool = True):
         super().__init__(engine, input_, threshold_prog, time_prog)
@@ -104,6 +105,7 @@ class ForgetNode(_ClockedNode):
     retracts without marking (marks are a monitoring nicety)."""
 
     name = "forget"
+    snapshot_attrs = ('global_now', 'alive')
 
     def __init__(self, engine, input_, threshold_prog, time_prog, *, mark_forgetting_records: bool = False):
         super().__init__(engine, input_, threshold_prog, time_prog)
@@ -142,6 +144,7 @@ class FreezeNode(_ClockedNode):
     """reference: freeze/ignore_late (time_column.rs:627,673)."""
 
     name = "freeze"
+    snapshot_attrs = ('global_now', 'passed')
 
     def __init__(self, engine, input_, threshold_prog, time_prog):
         super().__init__(engine, input_, threshold_prog, time_prog)

@@ -122,3 +122,120 @@ def iterate(func, iteration_limit: int | None = None, **kwargs):
     from pathway_tpu.internals.iterate import iterate_impl
 
     return iterate_impl(func, iteration_limit=iteration_limit, **kwargs)
+
+
+class ExportedTable:
+    """Bridge between separate graphs (reference: export.rs:207
+    ExportedTable — frontier + data access + on-update subscription;
+    Graph::export_table graph.rs:954).
+
+    While the exporting graph runs, the handle accumulates the table's
+    state; other graphs (or threads) import it as a source. `subscribe`
+    callbacks fire per delta, enabling live cross-graph feeds."""
+
+    def __init__(self, schema, column_names):
+        import threading
+
+        self.schema = schema
+        self.column_names = list(column_names)
+        self._rows: dict = {}
+        self._subscribers: list = []
+        self._lock = threading.Lock()
+        self.closed = False
+
+    # -- producer side (called by the exporting graph's sink) ------------
+    def _apply(self, deltas) -> None:
+        with self._lock:
+            for key, values, diff in deltas:
+                if diff > 0:
+                    self._rows[key] = values
+                else:
+                    self._rows.pop(key, None)
+            subs = list(self._subscribers)
+        for cb in subs:
+            cb(deltas)
+
+    def _close(self) -> None:
+        self.closed = True
+        with self._lock:
+            subs = list(self._subscribers)
+        for cb in subs:
+            cb(None)  # end-of-stream marker
+
+    # -- consumer side ---------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._rows)
+
+    def subscribe(self, cb) -> dict:
+        """Register cb(deltas | None); returns the state snapshot current
+        at registration (no gap between snapshot and stream)."""
+        with self._lock:
+            self._subscribers.append(cb)
+            return dict(self._rows)
+
+
+def export_table(table) -> ExportedTable:
+    """Register an export sink on the current graph (reference:
+    Graph::export_table). The handle fills while the graph runs."""
+    from pathway_tpu.internals.parse_graph import G
+
+    exported = ExportedTable(table._schema, table.column_names())
+
+    def attach(ctx, nodes):
+        from pathway_tpu.engine.engine import SubscribeNode
+
+        (node,) = nodes
+
+        def on_change(key, row, time, is_addition):
+            exported._apply(
+                [(key, tuple(row[c] for c in exported.column_names),
+                  1 if is_addition else -1)]
+            )
+
+        SubscribeNode(
+            ctx.engine,
+            node,
+            on_change=on_change,
+            on_end=exported._close,
+            column_names=exported.column_names,
+        )
+
+    G.add_sink([table], attach)
+    return exported
+
+
+def import_table(exported: ExportedTable):
+    """Materialize an ExportedTable as a source in the current graph
+    (reference: Graph::import_table). If the exporting graph has finished,
+    this is a static table; if it is still live (another thread), updates
+    stream through a connector subject."""
+    from pathway_tpu.io.python import ConnectorSubject, read
+
+    class _ImportSubject(ConnectorSubject):
+        def run(self) -> None:
+            import queue as queue_mod
+
+            q: queue_mod.SimpleQueue = queue_mod.SimpleQueue()
+            snapshot = exported.subscribe(q.put)
+            names = exported.column_names
+            # rows keep their original pointers across the graph boundary
+            # (_pw_key is honored by the connector sink)
+            for key, values in snapshot.items():
+                self.next(_pw_key=key, **dict(zip(names, values)))
+            self.commit()
+            if exported.closed:
+                return
+            while True:
+                deltas = q.get()
+                if deltas is None:
+                    return
+                for key, values, diff in deltas:
+                    row = {"_pw_key": key, **dict(zip(names, values))}
+                    if diff > 0:
+                        self.next(**row)
+                    else:
+                        self._remove(row)
+                self.commit()
+
+    return read(_ImportSubject, schema=exported.schema)

@@ -38,6 +38,7 @@ def _run_coro(coro):
 
 class AsyncTransformerNode(Node):
     name = "async_transformer"
+    snapshot_attrs = ('emitted',)
 
     def __init__(
         self,

@@ -65,3 +65,6 @@ def set_license_key(key: str | None) -> None:
 
 def set_monitoring_config(*, server_endpoint: str | None = None, **kwargs) -> None:
     pathway_config.monitoring_server = server_endpoint
+    from pathway_tpu.internals import telemetry
+
+    telemetry.set_monitoring_config(server_endpoint=server_endpoint, **kwargs)

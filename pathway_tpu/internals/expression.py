@@ -232,9 +232,23 @@ class ColumnReference(ColumnExpression):
         return ()
 
     def __call__(self, *args, **kwargs):
-        raise TypeError(
-            f"column {self._name!r} is not callable; "
-            "did you mean pw.apply(fun, ...)?"
+        # method columns (row transformers' @method) hold a callable per
+        # row; `t.c(10)` applies it row-wise (reference:
+        # row_transformer.py method_call_transformer). Any other column
+        # keeps the build-time misuse error.
+        col = self._table._schema.columns().get(self._name)
+        col_dtype = dt.unoptionalize(col.dtype) if col is not None else None
+        if not isinstance(col_dtype, dt.CallableDType):
+            raise TypeError(
+                f"column {self._name!r} is not callable; "
+                "did you mean pw.apply(fun, ...)?"
+            )
+        if kwargs:
+            raise TypeError("method columns take positional arguments only")
+        from pathway_tpu.internals.api import apply_with_type
+
+        return apply_with_type(
+            lambda f, *a: f(*a), col_dtype.return_type, self, *args
         )
 
 

@@ -55,6 +55,7 @@ class IterateCoreNode(Node):
     """Holds input snapshots; recomputes the fixpoint each time."""
 
     name = "iterate"
+    snapshot_attrs = ('states',)
 
     def __init__(
         self,
@@ -135,6 +136,7 @@ def _rows_equal(a: Dict, b: Dict) -> bool:
 
 class IterateOutputNode(Node):
     name = "iterate_output"
+    snapshot_attrs = ('emitted',)
 
     def __init__(self, engine: Engine, core: IterateCoreNode, output_name: str):
         super().__init__(engine, [core])

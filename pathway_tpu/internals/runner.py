@@ -28,6 +28,8 @@ class RunContext:
         n = self._nodes.get(id(table))
         if n is None:
             n = table._build(self)
+            if getattr(n, "trace", None) is None:
+                n.trace = getattr(table, "_trace", None)
             self._nodes[id(table)] = n
             self._keepalive.append(table)
         return n
@@ -81,16 +83,24 @@ def run(
 ) -> None:
     """pw.run — execute every registered sink (reference:
     internals/run.py:11)."""
+    from pathway_tpu.internals import telemetry
+
     engine = _make_engine()
     ctx = RunContext(engine)
-    for sink in G.sinks:
-        nodes = [ctx.node(t) for t in sink.tables]
-        sink.attach(ctx, nodes)
+    with telemetry.span("graph_runner.build"):
+        for sink in G.sinks:
+            nodes = [ctx.node(t) for t in sink.tables]
+            sink.attach(ctx, nodes)
     _attach_monitoring(engine)
-    if G.sources:
-        _run_streaming(engine, ctx, persistence_config)
-    else:
-        engine.run_static()
+    with telemetry.span(
+        "graph_runner.run",
+        workers=engine.worker_count,
+        streaming=bool(G.sources),
+    ):
+        if G.sources:
+            _run_streaming(engine, ctx, persistence_config)
+        else:
+            engine.run_static()
 
 
 def run_all(**kwargs) -> None:
@@ -103,7 +113,15 @@ def _attach_monitoring(engine: Engine) -> None:
     logger = logging.getLogger("pathway_tpu")
 
     def on_error(entry):
-        logger.warning("%s (operator %s)", entry.message, entry.operator)
+        if entry.trace is not None:
+            logger.warning(
+                "%s (operator %s, created at %s)",
+                entry.message,
+                entry.operator,
+                entry.trace,
+            )
+        else:
+            logger.warning("%s (operator %s)", entry.message, entry.operator)
 
     engine.on_error = on_error
 

@@ -55,6 +55,11 @@ class Table:
         self._universe = universe
         self._build = build
         self._name = name or f"table_{next(_table_names)}"
+        # remember which user line created this operator; engine errors
+        # resurface it (reference: internals/trace.py)
+        from pathway_tpu.internals.trace import trace_user_frame
+
+        self._trace = trace_user_frame()
 
     # -- introspection ----------------------------------------------------
     @property
@@ -628,6 +633,56 @@ class Table:
             {
                 "prev": ColumnSchema(name="prev", dtype=dt.Optionalize(dt.POINTER)),
                 "next": ColumnSchema(name="next", dtype=dt.Optionalize(dt.POINTER)),
+            }
+        )
+        return Table(schema=schema, universe=self._universe, build=build)
+
+    def _gradual_broadcast(
+        self,
+        threshold_table: "Table",
+        lower_column,
+        value_column,
+        upper_column,
+    ) -> "Table":
+        """Attach `apx_value` interpolated between lower/upper per the
+        threshold's progress (reference: table.py _gradual_broadcast:637,
+        operators/gradual_broadcast.rs)."""
+        apx = self.__gradual_broadcast(
+            threshold_table, lower_column, value_column, upper_column
+        )
+        cols = {name: self[name] for name in self.column_names()}
+        cols["apx_value"] = apx.apx_value
+        return self._select_impl(cols)
+
+    def __gradual_broadcast(
+        self,
+        threshold_table: "Table",
+        lower_column,
+        value_column,
+        upper_column,
+    ) -> "Table":
+        self_ = self
+        lower_expr = smart_wrap(lower_column)
+        value_expr = smart_wrap(value_column)
+        upper_expr = smart_wrap(upper_column)
+
+        def build(ctx):
+            from pathway_tpu.engine.operators import GradualBroadcastNode
+
+            return GradualBroadcastNode(
+                ctx.engine,
+                ctx.node(self_),
+                ctx.node(threshold_table),
+                _compile_on(ctx, [threshold_table], lower_expr),
+                _compile_on(ctx, [threshold_table], value_expr),
+                _compile_on(ctx, [threshold_table], upper_expr),
+            )
+
+        schema = schema_from_columns(
+            {
+                "apx_value": ColumnSchema(
+                    name="apx_value", dtype=dt.Optionalize(dt.ANY)
+                )
             }
         )
         return Table(schema=schema, universe=self._universe, build=build)

@@ -1,0 +1,78 @@
+"""Optional OpenTelemetry traces/metrics (reference: src/engine/telemetry.rs
+OTel tracer+meter over OTLP/gRPC :45-58; python graph_runner/telemetry.py
+spans `graph_runner.run`/`graph_runner.build`).
+
+OTel is an optional dependency: without it (or without an endpoint
+configured) every call is a no-op, so the engine never grows a hard
+telemetry dependency. Configure with `pw.set_monitoring_config(
+server_endpoint=...)` or the PATHWAY_MONITORING_SERVER env var."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Optional
+
+_config: dict = {"endpoint": os.environ.get("PATHWAY_MONITORING_SERVER")}
+_tracer = None
+
+
+def set_monitoring_config(
+    *, server_endpoint: str | None = None, **kwargs
+) -> None:
+    """reference: pw.set_monitoring_config / TelemetryConfig."""
+    global _tracer
+    _config["endpoint"] = server_endpoint
+    _tracer = None  # rebuild lazily against the new endpoint
+
+
+def _get_tracer():
+    global _tracer
+    if _tracer is not None:
+        return _tracer
+    endpoint = _config.get("endpoint")
+    if not endpoint:
+        _tracer = _NoopTracer()
+        return _tracer
+    try:
+        from opentelemetry import trace as ot_trace
+        from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+            OTLPSpanExporter,
+        )
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+
+        provider = TracerProvider()
+        provider.add_span_processor(
+            BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint))
+        )
+        ot_trace.set_tracer_provider(provider)
+        _tracer = ot_trace.get_tracer("pathway_tpu")
+    except Exception:  # noqa: BLE001 — OTel not installed / endpoint down
+        _tracer = _NoopTracer()
+    return _tracer
+
+
+class _NoopSpan:
+    def set_attribute(self, *a, **k):
+        pass
+
+    def record_exception(self, *a, **k):
+        pass
+
+
+class _NoopTracer:
+    @contextlib.contextmanager
+    def start_as_current_span(self, name: str, **kwargs):
+        yield _NoopSpan()
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes: Any):
+    """`with telemetry.span("graph_runner.run", workers=4): ...`"""
+    tracer = _get_tracer()
+    with tracer.start_as_current_span(name) as s:
+        for key, value in attributes.items():
+            with contextlib.suppress(Exception):
+                s.set_attribute(key, value)
+        yield s

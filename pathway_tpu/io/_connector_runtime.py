@@ -315,6 +315,53 @@ class StreamingDriver:
         active = 0
         replayed: Dict[LiveSource, List] = {}
         my_worker = self.engine.worker_id
+
+        # operator snapshots (reference: dataflow/persist.rs): restore node
+        # state at the persisted frontier, then replay only the log tail
+        # appended after the last compaction
+        op_mgr = None
+        snap_interval = 0.0
+        restored_time = None
+        snap_ms = (
+            getattr(self.persistence_config, "snapshot_interval_ms", 0)
+            if self.persistence_config is not None
+            else 0
+        )
+        # operator snapshots are opt-in via snapshot_interval_ms > 0
+        # (reference: PersistenceMode / operator persisting); the default
+        # input-snapshot mode replays the full event log instead
+        if self.persistence_config is not None and snap_ms > 0:
+            from pathway_tpu.persistence import OperatorSnapshotManager
+
+            op_mgr = OperatorSnapshotManager(
+                self.persistence_config.backend._backend,
+                self.engine.worker_id,
+            )
+            snap_interval = snap_ms / 1000.0
+            manifest = op_mgr.load_manifest()
+            # phase 1 loads blobs without mutating; phase 2 applies only if
+            # EVERY worker can restore the same frontier — a one-sided
+            # restore would desync the lockstep clock, and a partial apply
+            # would double-count replayed events
+            states = (
+                op_mgr.load_states(self.engine, manifest)
+                if manifest is not None
+                else None
+            )
+            local_time = manifest["time"] if states is not None else -1
+            if self.engine.worker_count > 1:
+                votes = self.engine.coord.agree(local_time)
+                agreed = (
+                    votes[0]
+                    if all(v == votes[0] for v in votes) and votes[0] >= 0
+                    else -1
+                )
+            else:
+                agreed = local_time
+            if agreed >= 0:
+                op_mgr.apply_states(self.engine, states)
+                restored_time = agreed
+
         for live in sources:
             if live.node is None:
                 continue  # source never built (tree-shaken)
@@ -331,6 +378,12 @@ class StreamingDriver:
             writer = self._snapshot_writer(live)
             if writer is not None:
                 events = writer.read_events()
+                if op_mgr is not None and restored_time is None:
+                    # operator state was NOT restored (fresh run, graph
+                    # change, or diverged workers): replay the compacted
+                    # base in front of the tail so no pre-snapshot data is
+                    # lost
+                    events = op_mgr.read_base(live.name) + events
                 if events:
                     replayed[live] = events
                 state = writer.read_state()
@@ -348,19 +401,22 @@ class StreamingDriver:
             t = threading.Thread(target=runner, daemon=True, name=live.name)
             threads.append(t)
             active += 1
-        # initial time 0 processes static parts of the graph
+        # initial time 0 processes static parts of the graph (a restored
+        # run re-runs it harmlessly: restored source state marks static
+        # rows as already emitted)
         self.engine.process_time(0)
         # replay persisted input snapshots as the first batch (reference:
-        # rewind_from_disk_snapshot, connectors/mod.rs:256). Multi-worker:
-        # the replay step happens on every worker if it happens anywhere so
-        # the lockstep time sequence stays identical.
+        # rewind_from_disk_snapshot, connectors/mod.rs:256). After an
+        # operator-snapshot restore the log holds only the tail appended
+        # since the last compaction; it replays on top of restored state.
+        # Multi-worker: the replay step happens on every worker if it
+        # happens anywhere so the lockstep time sequence stays identical.
+        time = 2 if restored_time is None else restored_time + 2
         if self.engine.global_any(bool(replayed)):
             for live, events in replayed.items():
-                live.node.push(2, events)
-            self.engine.process_time(2)
-            time = 4
-        else:
-            time = 2
+                live.node.push(time, events)
+            self.engine.process_time(time)
+            time += 2
         for t in threads:
             t.start()
 
@@ -368,6 +424,11 @@ class StreamingDriver:
         states: Dict[LiveSource, Any] = {}
         counters: Dict[LiveSource, int] = {}
         last_flush = time_mod.monotonic()
+        last_snapshot = time_mod.monotonic()
+        dirty_since_snapshot = False
+        source_names = [
+            live.name for live in sources if live.node is not None
+        ]
         multiworker = self.engine.worker_count > 1
         done = False
 
@@ -377,17 +438,25 @@ class StreamingDriver:
             agree + the shared-scheduled-time loop), so agreement rounds
             align across workers; agree() itself blocks until the slowest
             worker reaches the same tick — that is the frontier protocol."""
-            nonlocal time, last_flush, done
+            nonlocal time, last_flush, last_snapshot, done
+            nonlocal dirty_since_snapshot
             has_data = any(bool(d) for d in pending.values())
             local_done = active <= 0 and not has_data
             term = self.engine.terminate_flag.is_set()
+            snap_due = op_mgr is not None and (
+                time_mod.monotonic() - last_snapshot
+            ) >= snap_interval
             if multiworker:
-                # termination rides the vote so every worker exits at the
-                # same round (a unilateral break would strand peers in
-                # agree() until the dead-peer timeout)
-                votes = self.engine.coord.agree((has_data, local_done, term))
+                # termination (and snapshot cadence) ride the vote so every
+                # worker exits/snapshots at the same round (a unilateral
+                # break would strand peers in agree() until the dead-peer
+                # timeout; a unilateral snapshot would diverge manifests)
+                votes = self.engine.coord.agree(
+                    (has_data, local_done, term, snap_due)
+                )
                 any_data = any(v[0] for v in votes)
                 done = all(v[1] for v in votes) or any(v[2] for v in votes)
+                snap_due = any(v[3] for v in votes)
             else:
                 any_data = has_data
                 done = local_done or term
@@ -402,7 +471,16 @@ class StreamingDriver:
                         live.node.push(time, deltas)
                 pending.clear()
                 self.engine.process_time(time)
+                dirty_since_snapshot = True
                 time += 2
+            if snap_due and op_mgr is not None and dirty_since_snapshot:
+                # quiescent frontier: the last time is fully processed and
+                # queues are drained — checkpoint operator state + compact
+                # logs (multi-worker: snap_due was agreed, and any_data is
+                # agreed, so every worker saves the same frontier)
+                op_mgr.save(self.engine, time - 2, source_names)
+                last_snapshot = time_mod.monotonic()
+                dirty_since_snapshot = False
             # run scheduled times that are due (global_next_time agrees, and
             # every worker sees the same nxt sequence — lockstep preserved)
             while True:

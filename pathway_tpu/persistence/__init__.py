@@ -36,6 +36,11 @@ class PersistenceBackend:
     def list_keys(self) -> List[str]:
         raise NotImplementedError
 
+    def truncate(self, key: str) -> None:
+        """Drop an append log / value (log compaction after an operator
+        snapshot bakes its events into operator state)."""
+        raise NotImplementedError
+
 
 class FilesystemBackend(PersistenceBackend):
     def __init__(self, path: str):
@@ -86,6 +91,11 @@ class FilesystemBackend(PersistenceBackend):
     def list_keys(self) -> List[str]:
         return os.listdir(self.root)
 
+    def truncate(self, key: str) -> None:
+        path = self._path(key)
+        if os.path.exists(path):
+            os.remove(path)
+
 
 class MockBackend(PersistenceBackend):
     """In-memory backend for tests (reference: backends/mock.rs)."""
@@ -111,6 +121,129 @@ class MockBackend(PersistenceBackend):
     def list_keys(self):
         return list(set(self.values) | set(self.logs))
 
+    def truncate(self, key):
+        self.values.pop(key, None)
+        self.logs.pop(key, None)
+
+
+class ObjectStoreBackend(PersistenceBackend):
+    """Persistence over any object store with put/get/delete/list
+    (reference: persistence/backends/s3.rs, azure.rs — a K/V trait over
+    immutable objects).
+
+    Objects are immutable, so `append` is emulated with numbered chunk
+    objects under `<key>/log.<n>`; `read_appended` lists and sorts them.
+    The client interface is minimal and injectable for tests:
+    put(key, bytes), get(key) -> bytes|None, delete(key), list(prefix) ->
+    [key]."""
+
+    def __init__(self, client, prefix: str = ""):
+        self.client = client
+        self.prefix = prefix.strip("/")
+        self._counters: Dict[str, int] = {}
+
+    def _full(self, key: str) -> str:
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def put_value(self, key, value):
+        self.client.put(self._full(key), value)
+
+    def get_value(self, key):
+        return self.client.get(self._full(key))
+
+    def append(self, key, value):
+        n = self._counters.get(key)
+        if n is None:
+            existing = self.client.list(self._full(key) + "/log.")
+            n = len(existing)
+        self.client.put(self._full(key) + f"/log.{n:08d}", value)
+        self._counters[key] = n + 1
+
+    def read_appended(self, key):
+        names = sorted(self.client.list(self._full(key) + "/log."))
+        out = []
+        for name in names:
+            blob = self.client.get(name)
+            if blob is not None:
+                out.append(blob)
+        return out
+
+    def list_keys(self):
+        skip = len(self.prefix) + 1 if self.prefix else 0
+        return [k[skip:] for k in self.client.list(self.prefix)]
+
+    def truncate(self, key):
+        for name in self.client.list(self._full(key) + "/log."):
+            self.client.delete(name)
+        self.client.delete(self._full(key))
+        self._counters.pop(key, None)
+
+
+class _Boto3ObjectClient:
+    """S3 client adapter (gated on boto3; injectable fake in tests)."""
+
+    def __init__(self, bucket: str, **kwargs):
+        import boto3  # type: ignore
+
+        self.bucket = bucket
+        self.client = boto3.client("s3", **kwargs)
+
+    def put(self, key, value):
+        self.client.put_object(Bucket=self.bucket, Key=key, Body=value)
+
+    def get(self, key):
+        try:
+            resp = self.client.get_object(Bucket=self.bucket, Key=key)
+        except Exception:  # noqa: BLE001 — NoSuchKey and friends
+            return None
+        return resp["Body"].read()
+
+    def delete(self, key):
+        self.client.delete_object(Bucket=self.bucket, Key=key)
+
+    def list(self, prefix):
+        out = []
+        paginator = self.client.get_paginator("list_objects_v2")
+        for page in paginator.paginate(Bucket=self.bucket, Prefix=prefix):
+            for obj in page.get("Contents", []):
+                out.append(obj["Key"])
+        return out
+
+
+class _AzureBlobClient:
+    """Azure Blob adapter (gated on azure-storage-blob)."""
+
+    def __init__(self, container: str, connection_string: str | None = None, **kwargs):
+        from azure.storage.blob import BlobServiceClient  # type: ignore
+
+        if connection_string is not None:
+            service = BlobServiceClient.from_connection_string(
+                connection_string, **kwargs
+            )
+        else:
+            service = BlobServiceClient(**kwargs)
+        self.container = service.get_container_client(container)
+
+    def put(self, key, value):
+        self.container.upload_blob(key, value, overwrite=True)
+
+    def get(self, key):
+        try:
+            return self.container.download_blob(key).readall()
+        except Exception:  # noqa: BLE001
+            return None
+
+    def delete(self, key):
+        try:
+            self.container.delete_blob(key)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def list(self, prefix):
+        return [
+            b.name for b in self.container.list_blobs(name_starts_with=prefix)
+        ]
+
 
 class Backend:
     """Factory namespace (reference: persistence/__init__.py Backend:27)."""
@@ -127,13 +260,42 @@ class Backend:
         return cls(MockBackend(events))
 
     @classmethod
-    def s3(cls, root_path: str, bucket_settings=None) -> "Backend":
-        raise NotImplementedError(
-            "S3 persistence backend requires object-store credentials; "
-            "use Backend.filesystem on a mounted bucket"
-        )
+    def s3(
+        cls,
+        root_path: str,
+        bucket_settings=None,
+        *,
+        _client=None,
+        **client_kwargs,
+    ) -> "Backend":
+        """root_path: s3://bucket/prefix (reference: backends/s3.rs).
+        Tests inject `_client`; production uses boto3 credentials from the
+        standard chain or `bucket_settings`."""
+        bucket, _, prefix = root_path.removeprefix("s3://").partition("/")
+        if _client is None:
+            if isinstance(bucket_settings, dict):
+                client_kwargs.update(bucket_settings)
+            _client = _Boto3ObjectClient(bucket, **client_kwargs)
+        return cls(ObjectStoreBackend(_client, prefix))
 
-    azure = s3
+    @classmethod
+    def azure(
+        cls,
+        root_path: str,
+        *,
+        account=None,
+        password=None,
+        connection_string=None,
+        _client=None,
+        **client_kwargs,
+    ) -> "Backend":
+        """root_path: az://container/prefix (reference: backends/azure.rs)."""
+        container, _, prefix = root_path.removeprefix("az://").partition("/")
+        if _client is None:
+            _client = _AzureBlobClient(
+                container, connection_string=connection_string, **client_kwargs
+            )
+        return cls(ObjectStoreBackend(_client, prefix))
 
 
 class Config:
@@ -157,6 +319,138 @@ class Config:
     @classmethod
     def simple_config(cls, backend: Backend, **kwargs) -> "Config":
         return cls(backend, **kwargs)
+
+
+class OperatorSnapshotManager:
+    """Checkpoint operator state keyed by frontier + compact input logs
+    (reference: src/persistence/operator_snapshot.rs:231 snapshot
+    writer/merger, tracker.rs:51 frontier commit, dataflow/persist.rs).
+
+    At a quiescent frontier (all node queues drained after `process_time`),
+    every stateful node's `snapshot_state()` is pickled under an
+    epoch-versioned key `opsnap/<worker>/<epoch>/<node-idx>`; the manifest
+    is written LAST and names the epoch, so a crash mid-save leaves the old
+    manifest pointing at the old epoch's intact blobs (commit-last
+    atomicity, like the reference's snapshot writer). Before the event logs
+    are truncated, their deltas merge into a *consolidated base log* per
+    source — so even if a later restart cannot restore operator state (the
+    graph changed, a blob is missing), full replay of base + tail loses
+    nothing. Restore is two-phase: `load_states` reads and unpickles
+    without mutating (multi-worker agreement can veto), `apply_states`
+    commits. If any node's state fails to pickle, the whole snapshot
+    aborts and the logs are kept."""
+
+    def __init__(self, backend: PersistenceBackend, worker_id: int = 0):
+        self.backend = backend
+        self.worker_id = worker_id
+        self.manifest_key = f"opsnap/{worker_id}/manifest"
+
+    def _events_key(self, name: str) -> str:
+        return f"snapshot/{name}/events"
+
+    def _base_key(self, name: str) -> str:
+        return f"snapshot/{name}/base"
+
+    def save(self, engine, time: int, source_names: List[str]) -> bool:
+        states: List[Tuple[int, bytes]] = []
+        try:
+            for idx, node in enumerate(engine.nodes):
+                state = node.snapshot_state()
+                if state is not None:
+                    states.append((idx, pickle.dumps(state)))
+        except Exception:  # noqa: BLE001 — unpicklable operator state
+            return False
+        # compaction step 1: fold the event-log tail into the consolidated
+        # base (bounded by live rows, not history) BEFORE truncation — the
+        # full-replay fallback stays complete no matter what happens later
+        from pathway_tpu.engine.stream import consolidate
+
+        for name in source_names:
+            tail: List = []
+            for chunk in self.backend.read_appended(self._events_key(name)):
+                try:
+                    tail.extend(pickle.loads(chunk))
+                except Exception:  # noqa: BLE001 — torn crash-point chunk
+                    break
+            if not tail:
+                continue
+            base_blob = self.backend.get_value(self._base_key(name))
+            base: List = []
+            if base_blob is not None:
+                try:
+                    base = pickle.loads(base_blob)
+                except Exception:  # noqa: BLE001
+                    base = []
+            merged = consolidate(base + tail)
+            self.backend.put_value(self._base_key(name), pickle.dumps(merged))
+            self.backend.truncate(self._events_key(name))
+
+        prev = self.load_manifest()
+        epoch = time
+        for idx, blob in states:
+            self.backend.put_value(
+                f"opsnap/{self.worker_id}/{epoch}/{idx}", blob
+            )
+        # commit point: the manifest flips to the new epoch atomically
+        self.backend.put_value(
+            self.manifest_key,
+            pickle.dumps(
+                {
+                    "time": time,
+                    "epoch": epoch,
+                    "node_count": len(engine.nodes),
+                    "state_nodes": [idx for idx, _ in states],
+                }
+            ),
+        )
+        if prev is not None and prev.get("epoch") not in (None, epoch):
+            for idx in prev.get("state_nodes", []):
+                self.backend.truncate(
+                    f"opsnap/{self.worker_id}/{prev['epoch']}/{idx}"
+                )
+        return True
+
+    def load_manifest(self) -> dict | None:
+        blob = self.backend.get_value(self.manifest_key)
+        if blob is None:
+            return None
+        try:
+            return pickle.loads(blob)
+        except Exception:  # noqa: BLE001
+            return None
+
+    def load_states(self, engine, manifest: dict) -> Dict[int, dict] | None:
+        """Phase 1: read + unpickle every state blob WITHOUT touching the
+        engine. None = unusable (graph changed / blob missing / corrupt)."""
+        if manifest.get("node_count") != len(engine.nodes):
+            return None
+        epoch = manifest.get("epoch", manifest.get("time"))
+        states: Dict[int, dict] = {}
+        for idx in manifest.get("state_nodes", []):
+            blob = self.backend.get_value(
+                f"opsnap/{self.worker_id}/{epoch}/{idx}"
+            )
+            if blob is None:
+                return None
+            try:
+                states[idx] = pickle.loads(blob)
+            except Exception:  # noqa: BLE001
+                return None
+        return states
+
+    def apply_states(self, engine, states: Dict[int, dict]) -> None:
+        """Phase 2: commit (after any multi-worker agreement)."""
+        for idx, state in states.items():
+            engine.nodes[idx].restore_state(state)
+
+    def read_base(self, name: str) -> List:
+        blob = self.backend.get_value(self._base_key(name))
+        if blob is None:
+            return []
+        try:
+            return pickle.loads(blob)
+        except Exception:  # noqa: BLE001
+            return []
 
 
 class InputSnapshotWriter:

@@ -24,6 +24,7 @@ class Direction(enum.Enum):
 
 class AsofJoinNode(Node):
     name = "asof_join"
+    snapshot_attrs = ('left_index', 'right_index', 'cache')
 
     def __init__(
         self,

@@ -21,6 +21,7 @@ class AsofNowJoinNode(Node):
     external_index.rs batch-by-time)."""
 
     name = "asof_now_join"
+    snapshot_attrs = ('right_index',)
 
     def __init__(
         self,

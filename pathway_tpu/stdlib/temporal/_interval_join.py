@@ -39,6 +39,7 @@ class IntervalJoinNode(Node):
     """Bucketed interval join with optional outer sides."""
 
     name = "interval_join"
+    snapshot_attrs = ('left_index', 'right_index', 'cache')
 
     def __init__(
         self,

@@ -345,6 +345,7 @@ class SessionAssignNode(Node):
     stdlib/temporal/_window.py:65-140)."""
 
     name = "session_assign"
+    snapshot_attrs = ('rows', 'cache')
 
     def __init__(self, engine, input_, time_prog, inst_prog, predicate, max_gap):
         super().__init__(engine, [input_])
@@ -457,6 +458,7 @@ class IntervalsOverNode(Node):
     """Membership rows for each at-point's interval neighborhood."""
 
     name = "intervals_over"
+    snapshot_attrs = ('data_rows', 'at_points', 'cache')
 
     def __init__(
         self,

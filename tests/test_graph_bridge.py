@@ -1,0 +1,129 @@
+"""gradual_broadcast + export/import between graphs (reference:
+src/engine/dataflow/operators/gradual_broadcast.rs:491, export.rs:207;
+behavioral spec: python/pathway/tests/test_gradual_broadcast.py)."""
+
+import threading
+
+import pathway_tpu as pw
+from pathway_tpu.internals.api import export_table, import_table
+from pathway_tpu.internals.runner import run_tables
+
+
+def _vals(table, col=-1):
+    (cap,) = run_tables(table)
+    return [r[col] for r in cap.state.rows.values()]
+
+
+def _thr(lower, value, upper):
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(lower=float, value=float, upper=float),
+        [(lower, value, upper)],
+    )
+
+
+def _tab(n):
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(val=int), [(10 * i,) for i in range(n)]
+    )
+
+
+def test_gradual_broadcast_bounds():
+    # value == lower: every row reads lower; value == upper: every row upper
+    tab = _tab(50)
+    thr = _thr(20.5, 20.5, 30.5)
+    assert set(_vals(tab._gradual_broadcast(thr, thr.lower, thr.value, thr.upper))) == {20.5}
+    pw.G.clear()
+    tab = _tab(50)
+    thr = _thr(20.5, 30.5, 30.5)
+    assert set(_vals(tab._gradual_broadcast(thr, thr.lower, thr.value, thr.upper))) == {30.5}
+
+
+def test_gradual_broadcast_proportional_and_monotone():
+    tab = _tab(400)
+    thr = _thr(0.0, 0.3, 1.0)
+    low = _vals(tab._gradual_broadcast(thr, thr.lower, thr.value, thr.upper))
+    frac30 = sum(1 for v in low if v == 1.0) / len(low)
+    assert 0.2 < frac30 < 0.4, frac30
+
+    # raising value only flips rows lower -> upper (same hash fractions)
+    pw.G.clear()
+    tab = _tab(400)
+    thr = _thr(0.0, 0.7, 1.0)
+    high = _vals(tab._gradual_broadcast(thr, thr.lower, thr.value, thr.upper))
+    frac70 = sum(1 for v in high if v == 1.0) / len(high)
+    assert 0.6 < frac70 < 0.8, frac70
+    assert frac70 > frac30
+
+
+def test_gradual_broadcast_threshold_stream_updates():
+    """Streaming threshold: apx_value tracks the latest threshold row and
+    the update emits retractions only for flipped rows."""
+    tab = _tab(100)
+    thr = pw.debug.table_from_markdown(
+        """
+        lower | value | upper | __time__ | __diff__
+        0.0   | 0.0   | 1.0   | 2        | 1
+        0.0   | 0.0   | 1.0   | 4        | -1
+        0.0   | 1.0   | 1.0   | 4        | 1
+        """
+    )
+    ext = tab._gradual_broadcast(thr, thr.lower, thr.value, thr.upper)
+    (cap,) = run_tables(ext, record_stream=True)
+    final = [r[-1] for r in cap.state.rows.values()]
+    assert set(final) == {1.0}
+    # every row was emitted with 0.0 first, then flipped
+    flips = [d for _t, d in cap.stream if _t >= 4]
+    assert len(flips) == 200  # 100 retractions + 100 inserts
+
+
+def test_export_import_after_close():
+    t = pw.debug.table_from_markdown(
+        """
+        w
+        a
+        a
+        b
+        """
+    )
+    counts = t.groupby(pw.this.w).reduce(w=pw.this.w, c=pw.reducers.count())
+    ex = export_table(counts)
+    pw.run()
+    assert ex.closed
+    assert sorted(ex.snapshot().values()) == [("a", 2), ("b", 1)]
+
+    pw.G.clear()
+    t2 = import_table(ex)
+    doubled = t2.select(w=pw.this.w, c2=pw.this.c * 2)
+    seen = {}
+    pw.io.subscribe(
+        doubled,
+        on_change=lambda key, row, time, is_addition: seen.__setitem__(
+            row["w"], row["c2"]
+        ),
+    )
+    pw.run()
+    assert seen == {"a": 4, "b": 2}
+
+
+def test_export_import_preserves_keys():
+    t = pw.debug.table_from_markdown(
+        """
+        v
+        7
+        """
+    )
+    ex = export_table(t)
+    pw.run()
+    (orig_key,) = ex.snapshot().keys()
+
+    pw.G.clear()
+    t2 = import_table(ex)
+    got = {}
+    pw.io.subscribe(
+        t2,
+        on_change=lambda key, row, time, is_addition: got.__setitem__(
+            key, row["v"]
+        ),
+    )
+    pw.run()
+    assert got == {orig_key: 7}

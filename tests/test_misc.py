@@ -236,3 +236,34 @@ def test_fuzzy_match():
     # apple<->Apple and banana<->Banana pairs found with positive weight
     assert len(rows) >= 2
     assert all(r[2] > 0 for r in rows)
+
+
+def test_error_cites_user_frame():
+    """A failing UDF's error log entry names both the UDF body line and the
+    user line that created the operator (reference: internals/trace.py,
+    graph_runner/__init__.py:221-232)."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.engine import Engine
+    from pathway_tpu.internals.runner import run_tables
+
+    def explode(x):
+        return x // 0  # deliberate: cited in the error message
+
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+    bad = t.select(r=pw.apply_with_type(explode, int, pw.this.a))
+    eng = Engine()
+    run_tables(bad, engine=eng)
+    (entry,) = eng.error_log
+    # the UDF body frame
+    assert "explode" in entry.message
+    assert "x // 0" in entry.message
+    # the operator-creation frame
+    assert entry.trace is not None
+    assert entry.trace.file.endswith("test_misc.py")
+    assert "bad = t.select" in entry.trace.line_text
+    assert entry.operator == "rowwise"
