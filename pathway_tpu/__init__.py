@@ -85,6 +85,7 @@ from pathway_tpu.engine.value import (
     Json,
     Pointer,
     PyObjectWrapper,
+    ref_scalar,
     wrap_py_object,
 )
 
@@ -108,7 +109,9 @@ from pathway_tpu.internals import udfs  # noqa: E402
 from pathway_tpu.internals.udfs import UDF, udf  # noqa: E402
 from pathway_tpu.stdlib import indexing, ml, ordered, stateful, statistical  # noqa: E402
 from pathway_tpu.stdlib import temporal  # noqa: E402
-from pathway_tpu.stdlib import utils as _stdlib_utils  # noqa: E402
+from pathway_tpu.stdlib import utils  # noqa: E402
+from pathway_tpu.stdlib import viz  # noqa: E402
+from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer  # noqa: E402
 from pathway_tpu.stdlib.temporal import (  # noqa: E402
     intervals_over,
     session,
@@ -119,6 +122,11 @@ from pathway_tpu.stdlib.temporal import (  # noqa: E402
 # graft frequently-used stdlib entry points onto the pw namespace, as the
 # reference does (reference: python/pathway/__init__.py:155-176)
 windowby = temporal.windowby
+
+# Table.diff (reference grafts it the same way: pathway/__init__.py:207)
+from pathway_tpu.stdlib import ordered as _ordered  # noqa: E402
+
+Table.diff = _ordered.diff
 
 
 def __getattr__(name):
@@ -142,6 +150,10 @@ def __getattr__(name):
         from pathway_tpu.stdlib import graphs as g
 
         return g
+    if name in ("enable_interactive_mode", "LiveTable"):
+        from pathway_tpu.internals import interactive
+
+        return getattr(interactive, name)
     if name == "MonitoringLevel":
         from pathway_tpu.internals.monitoring import MonitoringLevel as m
 

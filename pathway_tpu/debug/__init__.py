@@ -100,20 +100,27 @@ def table_from_markdown(
         dtypes = out_schema.dtypes()
 
     events = []
+    # without explicit ids, a `-1` line must cancel the key of an earlier
+    # identical `+1` line (the connector sinks match retractions the same
+    # way, _connector_runtime.push_row)
+    keys_by_values: Dict[tuple, list] = {}
     for i, r in enumerate(rows):
+        values = tuple(
+            dt.coerce_value(r.get(c), dtypes.get(c, dt.ANY)) for c in out_schema.keys()
+        )
+        time = int(r.get(_SPECIAL_TIME, 0) or 0)
+        diff = int(r.get(_SPECIAL_DIFF, 1) or 1)
         if "id" in r:
             key = ref_scalar(r["id"])
         elif id_from:
             key = ref_scalar(*(r[c] for c in id_from))
         elif schema is not None and schema.primary_key_columns():
             key = ref_scalar(*(r[c] for c in schema.primary_key_columns()))
+        elif diff < 0 and keys_by_values.get(values):
+            key = keys_by_values[values].pop()
         else:
             key = ref_scalar(i)
-        values = tuple(
-            dt.coerce_value(r.get(c), dtypes.get(c, dt.ANY)) for c in out_schema.keys()
-        )
-        time = int(r.get(_SPECIAL_TIME, 0) or 0)
-        diff = int(r.get(_SPECIAL_DIFF, 1) or 1)
+            keys_by_values.setdefault(values, []).append(key)
         events.append((time, (key, values, diff)))
 
     return table_from_events(out_schema, events)

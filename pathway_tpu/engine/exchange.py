@@ -417,12 +417,24 @@ def exchange_by_value(engine, node, value_fn):
 
 
 def exchange_to_worker(engine, node, worker: int = 0):
-    """Gather the whole stream onto one worker (sinks, global operators)."""
+    """Gather the whole stream onto one worker (sinks, global operators).
+    Memoized per (node, worker): several consumers of the same gathered
+    stream (e.g. a transformer's output tables) share one exchange node."""
+    if engine.coord.worker_count == 1:
+        return node
+    memo = getattr(engine, "_gather_memo", None)
+    if memo is None:
+        memo = engine._gather_memo = {}
+    key = (id(node), worker)
+    if key in memo:
+        return memo[key]
 
     def route(keys, rows):
         return [worker] * len(keys)
 
-    return _exchange(engine, node, route)
+    out = _exchange(engine, node, route)
+    memo[key] = out
+    return out
 
 
 def coordinator_from_config() -> Coordinator:

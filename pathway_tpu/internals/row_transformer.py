@@ -177,6 +177,16 @@ class _BoundMethod:
     def __repr__(self):
         return f"<method {self._arg_name}.{self._attr_name} of {self._ptr!r}>"
 
+    # method values live inside emitted rows, so they must pickle for
+    # operator snapshots / cross-worker exchange; the node binding is
+    # process-local and re-attached by RowTransformerNode._after_restore
+    def __getstate__(self):
+        return (self._arg_name, self._ptr, self._attr_name)
+
+    def __setstate__(self, state):
+        self._node = None
+        self._arg_name, self._ptr, self._attr_name = state
+
 
 class RowReference:
     """`self` inside attribute computations; also what
@@ -341,7 +351,13 @@ class _Evaluator:
 class RowTransformerNode(Node):
     """One output table of a transformer. Holds every argument table's
     state; recomputes affected outputs per batch with a shared memo
-    (reference executes this as complex_columns Computers)."""
+    (reference executes this as complex_columns Computers).
+
+    Multi-output transformers build one node per output ClassArg, each
+    with its own state copy — a deliberate trade (transformers with >1
+    output table are rare; sharing mutable state across sibling nodes
+    would complicate snapshot/restore ordering). The gather exchanges in
+    front are shared via exchange_to_worker's memo."""
 
     name = "row_transformer"
 
@@ -378,6 +394,14 @@ class RowTransformerNode(Node):
     def fresh_evaluator(self) -> _Evaluator:
         """Evaluator over current state (out-of-batch _BoundMethod calls)."""
         return _Evaluator(self.class_args, self.states, self.column_names)
+
+    def _after_restore(self) -> None:
+        # re-bind unpickled method values to this node
+        for rows in self.cache.emitted.values():
+            for row in rows.values():
+                for v in row:
+                    if isinstance(v, _BoundMethod) and v._node is None:
+                        v._node = self
 
     def _forget_deps(self, root: Pointer) -> None:
         for dep in self.deps.pop(root, ()):

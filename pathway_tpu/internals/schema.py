@@ -339,7 +339,17 @@ def schema_from_pandas(
         elif kind == "m":
             dtype = dt.DURATION
         elif kind == "O":
-            non_null = [v for v in series if v is not None and v == v]
+            # the NaN check (v == v) is only valid for scalars; ndarray
+            # cells (e.g. embedding columns) are never NaN-markers
+            def _not_nan(v):
+                if v is None:
+                    return False
+                try:
+                    return bool(v == v)
+                except (ValueError, TypeError):
+                    return True
+
+            non_null = [v for v in series if _not_nan(v)]
             py_types = {type(v) for v in non_null}
             if py_types == {str}:
                 dtype = dt.STR

@@ -35,19 +35,25 @@ def _get_tracer():
         _tracer = _NoopTracer()
         return _tracer
     try:
-        from opentelemetry import trace as ot_trace
         from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
             OTLPSpanExporter,
         )
         from opentelemetry.sdk.trace import TracerProvider
         from opentelemetry.sdk.trace.export import BatchSpanProcessor
 
+        # module-owned provider: re-configuring swaps it cleanly (OTel's
+        # global set_tracer_provider ignores every call after the first,
+        # which would make endpoint changes silent no-ops)
+        old = _config.pop("_provider", None)
+        if old is not None:
+            with contextlib.suppress(Exception):
+                old.shutdown()
         provider = TracerProvider()
         provider.add_span_processor(
             BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint))
         )
-        ot_trace.set_tracer_provider(provider)
-        _tracer = ot_trace.get_tracer("pathway_tpu")
+        _config["_provider"] = provider
+        _tracer = provider.get_tracer("pathway_tpu")
     except Exception:  # noqa: BLE001 — OTel not installed / endpoint down
         _tracer = _NoopTracer()
     return _tracer

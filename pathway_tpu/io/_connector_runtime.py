@@ -305,7 +305,9 @@ class StreamingDriver:
         writer = self._writers.get(live)
         if writer is None:
             writer = InputSnapshotWriter(
-                self.persistence_config.backend._backend, live.name
+                self.persistence_config.backend._backend,
+                live.name,
+                self.engine.worker_id,
             )
             self._writers[live] = writer
         return writer
@@ -377,13 +379,23 @@ class StreamingDriver:
             subject._bind(sink)
             writer = self._snapshot_writer(live)
             if writer is not None:
-                events = writer.read_events()
-                if op_mgr is not None and restored_time is None:
-                    # operator state was NOT restored (fresh run, graph
-                    # change, or diverged workers): replay the compacted
-                    # base in front of the tail so no pre-snapshot data is
-                    # lost
-                    events = op_mgr.read_base(live.name) + events
+                if restored_time is not None:
+                    # operator state restored: replay only the segments
+                    # appended after the manifest's folded frontier
+                    folded = (manifest or {}).get("folded_through", {})
+                    events = writer.read_events(
+                        after_segment=folded.get(live.name, -1)
+                    )
+                elif op_mgr is not None:
+                    # restore refused (fresh run, graph change, diverged
+                    # workers): consolidated base + every later segment is
+                    # the complete history
+                    base, base_seg = op_mgr.read_base(live.name)
+                    events = base + writer.read_events(
+                        after_segment=base_seg
+                    )
+                else:
+                    events = writer.read_events()
                 if events:
                     replayed[live] = events
                 state = writer.read_state()
@@ -426,9 +438,11 @@ class StreamingDriver:
         last_flush = time_mod.monotonic()
         last_snapshot = time_mod.monotonic()
         dirty_since_snapshot = False
-        source_names = [
-            live.name for live in sources if live.node is not None
-        ]
+        snapshot_writers = {
+            live.name: self._snapshot_writer(live)
+            for live in sources
+            if live.node is not None and self._snapshot_writer(live) is not None
+        }
         multiworker = self.engine.worker_count > 1
         done = False
 
@@ -478,7 +492,7 @@ class StreamingDriver:
                 # queues are drained — checkpoint operator state + compact
                 # logs (multi-worker: snap_due was agreed, and any_data is
                 # agreed, so every worker saves the same frontier)
-                op_mgr.save(self.engine, time - 2, source_names)
+                op_mgr.save(self.engine, time - 2, snapshot_writers)
                 last_snapshot = time_mod.monotonic()
                 dirty_since_snapshot = False
             # run scheduled times that are due (global_next_time agrees, and

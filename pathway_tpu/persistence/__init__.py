@@ -345,53 +345,79 @@ class OperatorSnapshotManager:
         self.worker_id = worker_id
         self.manifest_key = f"opsnap/{worker_id}/manifest"
 
-    def _events_key(self, name: str) -> str:
-        return f"snapshot/{name}/events"
+    def _base_key(self, name: str, epoch: int) -> str:
+        return f"snapshot/{self.worker_id}/{name}/base.{epoch:016d}"
 
-    def _base_key(self, name: str) -> str:
-        return f"snapshot/{name}/base"
+    def _list_base_epochs(self, name: str) -> List[int]:
+        marker = f"snapshot/{self.worker_id}/{name}/base.".replace("/", "__")
+        out = []
+        for key in self.backend.list_keys():
+            flat = key.replace("/", "__")
+            if flat.startswith(marker):
+                try:
+                    out.append(int(flat[len(marker):][:16]))
+                except ValueError:
+                    continue
+        return sorted(set(out))
 
-    def save(self, engine, time: int, source_names: List[str]) -> bool:
+    def save(
+        self, engine, time: int, writers: Dict[str, "InputSnapshotWriter"]
+    ) -> bool:
+        """Crash-safe ordering: (1) seal log segments, (2) stage the new
+        consolidated bases and state blobs under epoch-versioned keys,
+        (3) write the manifest — the single commit point, (4) clean up old
+        segments/bases/blobs. A crash before (3) leaves the previous
+        manifest + its intact epoch; a crash after (3) only leaves garbage
+        that the next save deletes. Replay never double-applies because the
+        manifest records `folded_through` per source and the restore path
+        replays only later segments."""
+        import logging
+
         states: List[Tuple[int, bytes]] = []
-        try:
-            for idx, node in enumerate(engine.nodes):
-                state = node.snapshot_state()
-                if state is not None:
-                    states.append((idx, pickle.dumps(state)))
-        except Exception:  # noqa: BLE001 — unpicklable operator state
-            return False
-        # compaction step 1: fold the event-log tail into the consolidated
-        # base (bounded by live rows, not history) BEFORE truncation — the
-        # full-replay fallback stays complete no matter what happens later
+        for idx, node in enumerate(engine.nodes):
+            state = node.snapshot_state()
+            if state is None:
+                continue
+            try:
+                states.append((idx, pickle.dumps(state)))
+            except Exception as exc:  # noqa: BLE001 — unpicklable state
+                logging.getLogger("pathway_tpu").warning(
+                    "operator snapshot disabled: node %d (%s) state does "
+                    "not pickle: %s",
+                    idx,
+                    node.name,
+                    exc,
+                )
+                return False
+
         from pathway_tpu.engine.stream import consolidate
 
-        for name in source_names:
-            tail: List = []
-            for chunk in self.backend.read_appended(self._events_key(name)):
-                try:
-                    tail.extend(pickle.loads(chunk))
-                except Exception:  # noqa: BLE001 — torn crash-point chunk
-                    break
-            if not tail:
-                continue
-            base_blob = self.backend.get_value(self._base_key(name))
-            base: List = []
-            if base_blob is not None:
-                try:
-                    base = pickle.loads(base_blob)
-                except Exception:  # noqa: BLE001
-                    base = []
-            merged = consolidate(base + tail)
-            self.backend.put_value(self._base_key(name), pickle.dumps(merged))
-            self.backend.truncate(self._events_key(name))
-
-        prev = self.load_manifest()
         epoch = time
+        folded_through: Dict[str, int] = {}
+        for name, writer in writers.items():
+            sealed = writer.start_new_segment()
+            folded_through[name] = sealed
+            prev_deltas, prev_seg = self.read_base(name)
+            # fold sealed segments the previous base has not folded yet
+            tail = [
+                d
+                for seg in writer.list_segments()
+                if prev_seg < seg <= sealed
+                for d in writer.read_segment(seg)
+            ]
+            merged = consolidate(prev_deltas + tail)
+            self.backend.put_value(
+                self._base_key(name, epoch),
+                pickle.dumps(
+                    {"folded_through": sealed, "deltas": merged}
+                ),
+            )
         for idx, blob in states:
             self.backend.put_value(
                 f"opsnap/{self.worker_id}/{epoch}/{idx}", blob
             )
-        # commit point: the manifest flips to the new epoch atomically
+        prev = self.load_manifest()
+        # commit point
         self.backend.put_value(
             self.manifest_key,
             pickle.dumps(
@@ -400,9 +426,16 @@ class OperatorSnapshotManager:
                     "epoch": epoch,
                     "node_count": len(engine.nodes),
                     "state_nodes": [idx for idx, _ in states],
+                    "folded_through": folded_through,
                 }
             ),
         )
+        # cleanup: sealed segments are folded; older epochs superseded
+        for name, writer in writers.items():
+            writer.drop_segments_through(folded_through[name])
+            for e in self._list_base_epochs(name):
+                if e != epoch:
+                    self.backend.truncate(self._base_key(name, e))
         if prev is not None and prev.get("epoch") not in (None, epoch):
             for idx in prev.get("state_nodes", []):
                 self.backend.truncate(
@@ -443,38 +476,88 @@ class OperatorSnapshotManager:
         for idx, state in states.items():
             engine.nodes[idx].restore_state(state)
 
-    def read_base(self, name: str) -> List:
-        blob = self.backend.get_value(self._base_key(name))
-        if blob is None:
-            return []
-        try:
-            return pickle.loads(blob)
-        except Exception:  # noqa: BLE001
-            return []
+    def read_base(self, name: str) -> Tuple[List, int]:
+        """Latest readable consolidated base: (deltas, folded_through).
+        (-1 = nothing folded; replay every segment.)"""
+        for epoch in reversed(self._list_base_epochs(name)):
+            blob = self.backend.get_value(self._base_key(name, epoch))
+            if blob is None:
+                continue
+            try:
+                data = pickle.loads(blob)
+                return data["deltas"], data["folded_through"]
+            except Exception:  # noqa: BLE001
+                continue
+        return [], -1
 
 
 class InputSnapshotWriter:
-    """Append parsed events per source (reference: input_snapshot.rs:286)."""
+    """Segmented event log per source per worker (reference:
+    input_snapshot.rs:286 chunked event logs).
 
-    def __init__(self, backend: PersistenceBackend, source_name: str):
+    Events append to `snapshot/<worker>/<name>/events.<segment>`; a
+    snapshot rolls the writer onto a fresh segment so compaction folds only
+    sealed segments (no read/truncate race with ongoing appends), and the
+    worker scoping makes each log single-writer — the contract
+    `PersistenceBackend.append` requires."""
+
+    def __init__(
+        self, backend: PersistenceBackend, source_name: str, worker_id: int = 0
+    ):
         self.backend = backend
-        self.key = f"snapshot/{source_name}/events"
-        self.state_key = f"snapshot/{source_name}/state"
+        self.prefix = f"snapshot/{worker_id}/{source_name}"
+        self.state_key = f"{self.prefix}/state"
+        segs = self.list_segments()
+        self.active_segment = segs[-1] if segs else 0
+
+    def _segment_key(self, seg: int) -> str:
+        return f"{self.prefix}/events.{seg:08d}"
+
+    def list_segments(self) -> List[int]:
+        out = []
+        marker = self.prefix.replace("/", "__") + "__events."
+        for key in self.backend.list_keys():
+            if marker in key.replace("/", "__"):
+                try:
+                    out.append(int(key.rsplit(".", 1)[1][:8]))
+                except ValueError:
+                    continue
+        return sorted(set(out))
+
+    def start_new_segment(self) -> int:
+        """Seal the active segment; returns the sealed segment number."""
+        sealed = self.active_segment
+        self.active_segment = sealed + 1
+        return sealed
 
     def write_batch(self, deltas, subject_state=None) -> None:
         if deltas:
-            self.backend.append(self.key, pickle.dumps(deltas))
+            self.backend.append(
+                self._segment_key(self.active_segment), pickle.dumps(deltas)
+            )
         if subject_state is not None:
             self.backend.put_value(self.state_key, pickle.dumps(subject_state))
 
-    def read_events(self):
+    def read_segment(self, seg: int) -> List:
         out = []
-        for chunk in self.backend.read_appended(self.key):
+        for chunk in self.backend.read_appended(self._segment_key(seg)):
             try:
                 out.extend(pickle.loads(chunk))
             except Exception:  # noqa: BLE001 — torn chunk at crash point
                 break
         return out
+
+    def read_events(self, after_segment: int = -1) -> List:
+        out: List = []
+        for seg in self.list_segments():
+            if seg > after_segment:
+                out.extend(self.read_segment(seg))
+        return out
+
+    def drop_segments_through(self, seg: int) -> None:
+        for s in self.list_segments():
+            if s <= seg:
+                self.backend.truncate(self._segment_key(s))
 
     def read_state(self):
         blob = self.backend.get_value(self.state_key)

@@ -2,8 +2,26 @@
 bellman_ford/impl.py, pagerank/impl.py, louvain_communities/impl.py).
 All are fixed-point computations over edge tables via pw.iterate."""
 
-from pathway_tpu.stdlib.graphs.common import Edge, Vertex, Graph
+from pathway_tpu.stdlib.graphs.common import (
+    Clustering,
+    Edge,
+    Graph,
+    Vertex,
+    Weight,
+    WeightedGraph,
+)
 from pathway_tpu.stdlib.graphs.pagerank import pagerank
 from pathway_tpu.stdlib.graphs.bellman_ford import bellman_ford
+from pathway_tpu.stdlib.graphs.louvain import _louvain_level, louvain_communities
 
-__all__ = ["Edge", "Vertex", "Graph", "pagerank", "bellman_ford"]
+__all__ = [
+    "Clustering",
+    "Edge",
+    "Graph",
+    "Vertex",
+    "Weight",
+    "WeightedGraph",
+    "pagerank",
+    "bellman_ford",
+    "louvain_communities",
+]

@@ -1,6 +1,23 @@
-"""pw.ml (reference: python/pathway/stdlib/ml/). Populated progressively:
-index (legacy KNNIndex), classifiers, smart_table_ops."""
+"""pw.ml (reference: python/pathway/stdlib/ml/)."""
 
-from pathway_tpu.stdlib.ml import classifiers, index, smart_table_ops
+from pathway_tpu.stdlib.ml import (
+    classifiers,
+    datasets,
+    hmm,
+    index,
+    smart_table_ops,
+    utils,
+)
+from pathway_tpu.stdlib.ml.hmm import create_hmm_reducer
+from pathway_tpu.stdlib.ml.utils import classifier_accuracy
 
-__all__ = ["classifiers", "index", "smart_table_ops"]
+__all__ = [
+    "classifiers",
+    "classifier_accuracy",
+    "create_hmm_reducer",
+    "datasets",
+    "hmm",
+    "index",
+    "smart_table_ops",
+    "utils",
+]

@@ -12,12 +12,18 @@ def diff(table, timestamp, *values, instance=None):
     stdlib/ordered/diff.py — built on sort's prev pointers)."""
     mapping = {thisclass.this: table}
     ts = desugar(timestamp, mapping)
+    from pathway_tpu.internals.api import require, unwrap
+
     sorted_t = table.sort(key=ts, instance=instance)
     prev_rows = table.ix(sorted_t.prev, optional=True)
     cols = {}
     for v in values:
         ref = desugar(v, mapping)
-        cols[f"diff_{ref.name}"] = ref - prev_rows[ref.name]
+        # first row (prev is None) gets None, not an Error (reference:
+        # ordered/diff.py wraps the subtraction in pw.require on prev)
+        cols[f"diff_{ref.name}"] = require(
+            ref - unwrap(prev_rows[ref.name]), sorted_t.prev
+        )
     return table.select(**cols)
 
 

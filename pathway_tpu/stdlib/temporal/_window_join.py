@@ -30,12 +30,29 @@ def _with_windows(table: Table, time_expr, window: Window, prefix: str) -> Table
 
 
 class WindowJoinResult:
-    def __init__(self, left_flat: Table, right_flat: Table, join_result: JoinResult):
+    def __init__(
+        self,
+        left_flat: Table,
+        right_flat: Table,
+        join_result: JoinResult,
+        left_orig: Table,
+        right_orig: Table,
+    ):
         self._jr = join_result
         self._left_flat = left_flat
         self._right_flat = right_flat
+        self._left_orig = left_orig
+        self._right_orig = right_orig
 
     def select(self, *args, **kwargs) -> Table:
+        # user expressions reference the ORIGINAL tables (reference API);
+        # remap them onto the window-flattened copies the join runs over
+        remap = lambda e: _remap_sides(  # noqa: E731
+            e, self._left_orig, self._right_orig,
+            self._left_flat, self._right_flat,
+        )
+        args = tuple(remap(a) for a in args)
+        kwargs = {k: remap(v) for k, v in kwargs.items()}
         return self._jr.select(*args, **kwargs)
 
 
@@ -57,7 +74,7 @@ def window_join(
     for cond in on:
         conds.append(_remap_sides(cond, self, other, left_flat, right_flat))
     jr = JoinResult(left_flat, right_flat, tuple(conds), mode=how)
-    return WindowJoinResult(left_flat, right_flat, jr)
+    return WindowJoinResult(left_flat, right_flat, jr, self, other)
 
 
 def _remap_sides(cond, left, right, left_flat, right_flat):

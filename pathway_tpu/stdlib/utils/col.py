@@ -38,11 +38,61 @@ def flatten_column(column, origin_id: str | None = None):
 
 
 def multiapply_all_rows(*cols, fun, result_col_names):
-    raise NotImplementedError("multiapply_all_rows: use batched UDFs instead")
+    """Apply `fun` over the FULL columns at once; fun receives one list per
+    input column and returns one aligned list per result column (reference:
+    utils/col.py multiapply_all_rows — whole-column semantics, e.g.
+    normalization against global statistics)."""
+    from pathway_tpu.engine.value import Pointer, ref_scalar
+    from pathway_tpu.internals import api as pw_api
+    from pathway_tpu.internals.expression import collect_tables
+    from pathway_tpu.internals.reducers import reducers
+
+    tables = set()
+    for c in cols:
+        tables |= collect_tables(c, set())
+    if len(tables) != 1:
+        raise ValueError("multiapply_all_rows expects columns of one table")
+    (table,) = tables
+
+    packed = table.select(
+        _pw_row=pw_api.make_tuple(thisclass.this.id, *cols)
+    ).groupby().reduce(rows=reducers.tuple(thisclass.this._pw_row))
+
+    n_out = len(result_col_names)
+
+    def run(rows) -> tuple:
+        rows = list(rows or ())
+        keys = [r[0] for r in rows]
+        columns = [[r[i + 1] for r in rows] for i in range(len(cols))]
+        results = fun(*columns)
+        if n_out == 1 and not isinstance(results, tuple):
+            results = (results,)
+        return tuple(
+            (k, *(col[i] for col in results)) for i, k in enumerate(keys)
+        )
+
+    flat = packed.select(
+        pairs=pw_api.apply_with_type(run, tuple, thisclass.this.rows)
+    ).flatten(thisclass.this.pairs)
+    keyed = flat.with_id(
+        pw_api.apply_with_type(
+            lambda p: p, Pointer, thisclass.this.pairs.get(0)
+        )
+    )
+    return keyed.select(
+        **{
+            name: thisclass.this.pairs.get(i + 1)
+            for i, name in enumerate(result_col_names)
+        }
+    )
 
 
 def apply_all_rows(*cols, fun, result_col_name):
-    raise NotImplementedError("apply_all_rows: use batched UDFs instead")
+    """Single-result variant of multiapply_all_rows (reference:
+    utils/col.py apply_all_rows)."""
+    return multiapply_all_rows(
+        *cols, fun=fun, result_col_names=[result_col_name]
+    )
 
 
 def groupby_reduce_majority(column, majority_col_name: str = "majority"):

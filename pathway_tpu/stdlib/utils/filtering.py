@@ -1,0 +1,27 @@
+"""Row filters (reference: python/pathway/stdlib/utils/filtering.py)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals import thisclass
+from pathway_tpu.internals.reducers import reducers
+from pathway_tpu.internals.table import Table
+
+
+def argmax_rows(table: Table, *on, what) -> Table:
+    """Keep, per group of `on`, the row maximizing `what` (reference:
+    filtering.py argmax_rows:8)."""
+    filter_t = (
+        table.groupby(*on)
+        .reduce(argmax_id=reducers.argmax(what))
+        .with_id(thisclass.this.argmax_id)
+    )
+    return table.restrict(filter_t)
+
+
+def argmin_rows(table: Table, *on, what) -> Table:
+    filter_t = (
+        table.groupby(*on)
+        .reduce(argmin_id=reducers.argmin(what))
+        .with_id(thisclass.this.argmin_id)
+    )
+    return table.restrict(filter_t)

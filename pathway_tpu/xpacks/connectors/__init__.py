@@ -1,0 +1,5 @@
+"""pw.xpacks.connectors (reference: python/pathway/xpacks/connectors/)."""
+
+from pathway_tpu.xpacks.connectors import sharepoint
+
+__all__ = ["sharepoint"]

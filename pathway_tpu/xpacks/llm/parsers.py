@@ -7,6 +7,7 @@ reference."""
 
 from __future__ import annotations
 
+import inspect
 from typing import Any, List, Tuple
 
 from pathway_tpu.internals.udfs import UDF
@@ -108,7 +109,10 @@ class DoclingParser(UDF):
 
 class ImageParser(UDF):
     """reference: parsers.py ImageParser:676 — vision-LLM description of
-    images; requires an LLM with vision support."""
+    images. The image decodes via PIL (dimensions/format land in the chunk
+    metadata); the text is the configured LLM's description of the
+    base64-encoded image, so any chat wrapper with vision support (or a
+    test fake) plugs in."""
 
     def __init__(self, llm=None, prompt: str | None = None, **kwargs):
         super().__init__(return_type=list, deterministic=False)
@@ -116,10 +120,56 @@ class ImageParser(UDF):
         self.prompt = prompt or "Describe this image."
 
         def parse(contents: bytes) -> list:
-            raise NotImplementedError(
-                "ImageParser requires a vision LLM configured for this "
-                "deployment"
-            )
+            import base64
+            import io
+
+            meta: dict = {}
+            mime = "image/png"
+            try:
+                from PIL import Image
+
+                with Image.open(io.BytesIO(contents)) as img:
+                    meta = {
+                        "width": img.width,
+                        "height": img.height,
+                        "format": img.format,
+                    }
+                    if img.format:
+                        mime = f"image/{img.format.lower()}"
+            except Exception:  # noqa: BLE001 — undecodable: still try llm
+                pass
+            if self.llm is None:
+                raise ValueError(
+                    "ImageParser needs llm= (a vision-capable chat wrapper)"
+                )
+            b64 = base64.b64encode(contents).decode()
+            messages = [
+                {
+                    "role": "user",
+                    "content": [
+                        {"type": "text", "text": self.prompt},
+                        {
+                            "type": "image_url",
+                            "image_url": {
+                                "url": f"data:{mime};base64,{b64}"
+                            },
+                        },
+                    ],
+                }
+            ]
+            text = self.llm.func(messages)
+            if inspect.isawaitable(text):
+                import asyncio
+                import concurrent.futures
+
+                try:
+                    asyncio.get_running_loop()
+                except RuntimeError:
+                    text = asyncio.run(text)
+                else:
+                    with concurrent.futures.ThreadPoolExecutor(1) as pool:
+                        text = pool.submit(asyncio.run, text).result()
+            return [(text, meta)]
 
         self.func = parse
 

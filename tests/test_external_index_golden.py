@@ -1,0 +1,262 @@
+"""External-index golden behavior specs (modeled on the reference's
+python/pathway/tests/external_index/test_{brute_force_knn,usearch_knn,
+tantivy}.py): as-of-now vs tracking query semantics, metadata filters,
+per-query k, index updates and deletions."""
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import run_tables
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    BruteForceKnnFactory,
+)
+
+
+def _vec_docs(rows):
+    """rows: [(name, vector)] with vectors as tuples."""
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str, x=float, y=float),
+        [(n, float(v[0]), float(v[1])) for n, v in rows],
+    )
+    return t.select(
+        name=pw.this.name,
+        vec=pw.apply_with_type(
+            lambda a, b: np.array([a, b], dtype=np.float32),
+            np.ndarray,
+            pw.this.x,
+            pw.this.y,
+        ),
+    )
+
+
+def _stream_vec_docs(markdown):
+    t = pw.debug.table_from_markdown(markdown)
+    return t.select(
+        name=pw.this.name,
+        vec=pw.apply_with_type(
+            lambda a, b: np.array([a, b], dtype=np.float32),
+            np.ndarray,
+            pw.this.x,
+            pw.this.y,
+        ),
+    )
+
+
+def test_asof_now_results_do_not_update():
+    """as-of-now: a query answered at time T keeps its answer even when a
+    closer document arrives later (reference external_index.rs contract)."""
+    docs = _stream_vec_docs(
+        """
+        name | x | y | __time__
+        far  | 0 | 1 | 2
+        near | 1 | 0 | 4
+        """
+    )
+    queries = pw.debug.table_from_markdown(
+        """
+        qx | qy | __time__
+        1  | 0  | 2
+        """
+    ).select(
+        qv=pw.apply_with_type(
+            lambda a, b: np.array([a, b], dtype=np.float32),
+            np.ndarray,
+            pw.this.qx,
+            pw.this.qy,
+        )
+    )
+    index = DataIndex(docs, BruteForceKnn(docs.vec, dimensions=2))
+    res = index.query_as_of_now(queries.qv, number_of_matches=1).select(
+        m=pw.this.name
+    )
+    (cap,) = run_tables(res, record_stream=True)
+    ((m,),) = cap.state.rows.values()
+    assert m == ("far",)  # answered at t=2; `near` must not retro-update
+    assert len(cap.stream) == 1
+
+
+def test_tracking_query_updates_with_index():
+    """query(): results track later index changes with retractions."""
+    docs = _stream_vec_docs(
+        """
+        name | x | y | __time__
+        far  | 0 | 1 | 2
+        near | 1 | 0 | 4
+        """
+    )
+    queries = pw.debug.table_from_markdown(
+        """
+        qx | qy | __time__
+        1  | 0  | 2
+        """
+    ).select(
+        qv=pw.apply_with_type(
+            lambda a, b: np.array([a, b], dtype=np.float32),
+            np.ndarray,
+            pw.this.qx,
+            pw.this.qy,
+        )
+    )
+    index = DataIndex(docs, BruteForceKnn(docs.vec, dimensions=2))
+    res = index.query(queries.qv, number_of_matches=1).select(m=pw.this.name)
+    (cap,) = run_tables(res, record_stream=True)
+    ((m,),) = cap.state.rows.values()
+    assert m == ("near",)
+    # the t=2 answer (far) was retracted at t=4
+    retractions = [d for _t, d in cap.stream if d[2] < 0]
+    assert any(d[1][0] == ("far",) for d in retractions)
+
+
+def test_deletion_updates_tracking_results():
+    docs = _stream_vec_docs(
+        """
+        name | x | y | __time__ | __diff__
+        a    | 1 | 0 | 2        | 1
+        b    | 0 | 1 | 2        | 1
+        a    | 1 | 0 | 4        | -1
+        """
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qx=float, qy=float), [(1.0, 0.0)]
+    ).select(
+        qv=pw.apply_with_type(
+            lambda a, b: np.array([a, b], dtype=np.float32),
+            np.ndarray,
+            pw.this.qx,
+            pw.this.qy,
+        )
+    )
+    index = DataIndex(docs, BruteForceKnn(docs.vec, dimensions=2))
+    res = index.query(queries.qv, number_of_matches=1).select(m=pw.this.name)
+    (cap,) = run_tables(res)
+    ((m,),) = cap.state.rows.values()
+    assert m == ("b",)  # best remaining after deletion of `a`
+
+
+def test_metadata_filter_jmespath_subset():
+    docs = _vec_docs([("a", (1, 0)), ("b", (0.9, 0.1)), ("c", (0, 1))])
+    docs = docs.select(
+        name=pw.this.name,
+        vec=pw.this.vec,
+        meta=pw.apply_with_type(
+            lambda n: pw.Json({"path": f"/docs/{n}.txt", "owner": n}),
+            pw.Json,
+            pw.this.name,
+        ),
+    )
+    index = DataIndex(
+        docs,
+        BruteForceKnn(docs.vec, metadata_column=docs.meta, dimensions=2),
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qx=float, qy=float, filt=str),
+        [(1.0, 0.0, "owner == 'b'")],
+    ).select(
+        qv=pw.apply_with_type(
+            lambda a, b: np.array([a, b], dtype=np.float32),
+            np.ndarray,
+            pw.this.qx,
+            pw.this.qy,
+        ),
+        filt=pw.this.filt,
+    )
+    res = index.query_as_of_now(
+        queries.qv, number_of_matches=2, metadata_filter=queries.filt
+    ).select(m=pw.this.name)
+    (cap,) = run_tables(res)
+    ((m,),) = cap.state.rows.values()
+    assert m == ("b",)  # `a` scores higher but fails the filter
+
+
+def test_per_query_k():
+    docs = _vec_docs([("a", (1, 0)), ("b", (0.9, 0.1)), ("c", (0, 1))])
+    index = DataIndex(docs, BruteForceKnn(docs.vec, dimensions=2))
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qx=float, qy=float, k=int),
+        [(1.0, 0.0, 1), (1.0, 0.0, 3)],
+    ).select(
+        qv=pw.apply_with_type(
+            lambda a, b: np.array([a, b], dtype=np.float32),
+            np.ndarray,
+            pw.this.qx,
+            pw.this.qy,
+        ),
+        k=pw.this.k,
+    )
+    res = index.query_as_of_now(
+        queries.qv, number_of_matches=queries.k
+    ).select(m=pw.this.name)
+    (cap,) = run_tables(res)
+    lens = sorted(len(r[0]) for r in cap.state.rows.values())
+    assert lens == [1, 3]
+
+
+def test_bm25_scoring_order():
+    from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25Factory
+
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(text=str),
+        [
+            ("the quick brown fox",),
+            ("the lazy dog sleeps",),
+            ("quick quick quick fox fox",),
+        ],
+    )
+    factory = TantivyBM25Factory()
+    index = factory.build_index(docs.text, docs)
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(q=str), [("quick fox",)]
+    )
+    res = index.query_as_of_now(queries.q, number_of_matches=2).select(
+        m=pw.this.text, s=pw.this._pw_index_reply_score
+    )
+    (cap,) = run_tables(res)
+    ((texts, scores),) = cap.state.rows.values()
+    # term-frequency-heavy doc ranks first; scores strictly decreasing
+    assert texts[0] == "quick quick quick fox fox"
+    assert scores[0] > scores[1] > 0
+
+
+def test_hybrid_rrf_fuses_both_indexes():
+    from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25Factory
+    from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndexFactory
+
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(text=str),
+        [("alpha beta",), ("gamma delta",), ("epsilon zeta",)],
+    )
+
+    class CharEmbedder(pw.UDF):
+        def __init__(self):
+            super().__init__(return_type=np.ndarray, deterministic=True)
+
+            def embed(text: str) -> np.ndarray:
+                v = np.zeros(26, dtype=np.float32)
+                for ch in text:
+                    if ch.isalpha():
+                        v[ord(ch) - ord("a")] += 1
+                return v
+
+            self.func = embed
+
+        def get_embedding_dimension(self):
+            return 26
+
+    hybrid = HybridIndexFactory(
+        [
+            TantivyBM25Factory(),
+            BruteForceKnnFactory(dimensions=26, embedder=CharEmbedder()),
+        ]
+    )
+    index = hybrid.build_index(docs.text, docs)
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(q=str), [("alpha beta",)]
+    )
+    res = index.query_as_of_now(queries.q, number_of_matches=1).select(
+        m=pw.this.text
+    )
+    (cap,) = run_tables(res)
+    ((m,),) = cap.state.rows.values()
+    assert m == ("alpha beta",)

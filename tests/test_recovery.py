@@ -123,11 +123,16 @@ def test_kill_restart_resumes_from_snapshot(tmp_path):
     os.kill(proc.pid, signal.SIGKILL)
     proc.wait()
 
-    # compaction happened: the event log for the source was truncated when
-    # the snapshot was taken (file absent or holding only a short tail)
-    events_log = os.path.join(pstore, "snapshot__src__events")
-    if os.path.exists(events_log):
-        assert os.path.getsize(events_log) < 4096
+    # compaction happened: sealed event-log segments were folded into the
+    # base and dropped — only a short unsealed tail may remain
+    seg_files = [
+        f for f in os.listdir(pstore) if "__src__events." in f
+    ]
+    tail_bytes = sum(
+        os.path.getsize(os.path.join(pstore, f)) for f in seg_files
+    )
+    assert tail_bytes < 4096, (seg_files, tail_bytes)
+    assert any("__src__base." in f for f in os.listdir(pstore))
 
     # phase 2: restart, feed the rest + stop marker
     for i in range(4, 8):
@@ -241,35 +246,80 @@ def test_compaction_base_preserves_history_when_restore_refused(tmp_path):
     from pathway_tpu.engine.engine import Engine
     from pathway_tpu.engine.value import ref_scalar
 
+    from pathway_tpu.persistence import InputSnapshotWriter
+
     backend = FilesystemBackend(str(tmp_path))
     mgr = OperatorSnapshotManager(backend, worker_id=0)
+    writer = InputSnapshotWriter(backend, "src", worker_id=0)
 
-    # simulate two appended event batches, then a snapshot (compaction)
+    # two appended event batches, then a snapshot (compaction)
     k1, k2 = ref_scalar("a"), ref_scalar("b")
-    backend.append(
-        "snapshot/src/events", pickle.dumps([(k1, ("a",), 1)])
-    )
-    backend.append(
-        "snapshot/src/events", pickle.dumps([(k2, ("b",), 1), (k1, ("a",), -1)])
-    )
+    writer.write_batch([(k1, ("a",), 1)])
+    writer.write_batch([(k2, ("b",), 1), (k1, ("a",), -1)])
     engine = Engine()  # no nodes: empty operator state
-    assert mgr.save(engine, time=10, source_names=["src"])
-    # events log truncated, base holds the consolidated survivors
-    assert backend.read_appended("snapshot/src/events") == []
-    base = mgr.read_base("src")
-    assert base == [(k2, ("b",), 1)]
-
-    # tail appended after the snapshot
-    backend.append(
-        "snapshot/src/events", pickle.dumps([(k1, ("a2",), 1)])
-    )
-    # a changed graph refuses the manifest; base + tail = full history
+    assert mgr.save(engine, time=10, writers={"src": writer})
+    # sealed segments dropped; base holds the consolidated survivors
     manifest = mgr.load_manifest()
+    folded = manifest["folded_through"]["src"]
+    assert writer.read_events(after_segment=folded) == []
+    base, base_seg = mgr.read_base("src")
+    assert base == [(k2, ("b",), 1)]
+    assert base_seg == folded
+
+    # tail appended after the snapshot (new active segment)
+    writer.write_batch([(k1, ("a2",), 1)])
+    # a changed graph refuses the manifest; base + tail = full history
     engine2 = Engine()
     engine2.nodes = [object()]  # node_count mismatch
     assert mgr.load_states(engine2, manifest) is None
-    tail = []
-    for chunk in backend.read_appended("snapshot/src/events"):
-        tail.extend(pickle.loads(chunk))
-    replay = mgr.read_base("src") + tail
+    base, base_seg = mgr.read_base("src")
+    replay = base + writer.read_events(after_segment=base_seg)
     assert replay == [(k2, ("b",), 1), (k1, ("a2",), 1)]
+
+    # a second snapshot folds only the NEW segment into the base (no
+    # double-fold of already-compacted history)
+    assert mgr.save(engine, time=20, writers={"src": writer})
+    base2, _ = mgr.read_base("src")
+    assert sorted(base2, key=repr) == sorted(
+        [(k2, ("b",), 1), (k1, ("a2",), 1)], key=repr
+    )
+
+
+def test_operator_snapshot_with_method_columns(tmp_path):
+    """Transformer method columns (_BoundMethod values) pickle structurally
+    so operator snapshots stay enabled (regression: silent save() failure
+    disabled snapshots + compaction for any @method transformer)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.runner import run_tables
+    from pathway_tpu.persistence import MockBackend, OperatorSnapshotManager
+
+    @pw.transformer
+    class M:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.output_attribute
+            def b(self) -> int:
+                return self.a * 2
+
+            @pw.method
+            def f(self, k) -> int:
+                return self.b + k
+
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+    mt = M(t).table
+    res = mt.select(r=mt.f(5))
+    (cap,) = run_tables(res)
+    engine = cap.engine
+    mgr = OperatorSnapshotManager(MockBackend(), 0)
+    assert mgr.save(engine, 10, {})
+    manifest = mgr.load_manifest()
+    states = mgr.load_states(engine, manifest)
+    assert states is not None
+    mgr.apply_states(engine, states)
+    assert list(cap.state.rows.values()) == [(7,)]

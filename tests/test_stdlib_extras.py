@@ -1,0 +1,290 @@
+"""Long-tail stdlib components: louvain, hmm, datasets, pandas_transformer,
+argmax_rows, apply_all_rows, viz, interactive mode, approximate indexes
+(reference: stdlib/graphs/louvain_communities, ml/hmm.py, ml/datasets,
+utils/{pandas_transformer,filtering,col}.py, stdlib/viz,
+internals/interactive.py, usearch/LSH integrations)."""
+
+import math
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import run_tables
+
+
+def _rows(table):
+    (cap,) = run_tables(table)
+    return list(cap.state.rows.values())
+
+
+def test_louvain_two_cliques():
+    from pathway_tpu.stdlib.graphs import WeightedGraph, louvain_communities
+
+    edges = []
+    for base in (0, 10):
+        for i in range(4):
+            for j in range(i + 1, 4):
+                edges.append((f"v{base + i}", f"v{base + j}", 1.0))
+    edges.append(("v0", "v10", 0.5))  # weak bridge
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(un=str, vn=str, weight=float), edges
+    )
+    e = t.select(
+        u=pw.this.pointer_from(pw.this.un),
+        v=pw.this.pointer_from(pw.this.vn),
+        weight=pw.this.weight,
+    )
+    g = WeightedGraph.from_vertices_and_weighted_edges(None, e)
+    out = louvain_communities(g)
+    labels = [r[0] for r in _rows(out)]
+    from collections import Counter
+
+    sizes = sorted(Counter(repr(c) for c in labels).values())
+    assert sizes == [4, 4]
+
+
+def test_hmm_reducer_decodes_viterbi_path():
+    nx = pytest.importorskip("networkx")
+    from pathway_tpu.stdlib.ml import create_hmm_reducer
+
+    def emission(state):
+        tbl = {
+            "HUNGRY": {"GRUMPY": 0.9, "HAPPY": 0.1},
+            "FULL": {"GRUMPY": 0.2, "HAPPY": 0.8},
+        }
+        return lambda obs: math.log(tbl[state][obs])
+
+    g = nx.DiGraph()
+    g.add_node("HUNGRY", idx=0, calc_emission_log_ppb=emission("HUNGRY"))
+    g.add_node("FULL", idx=1, calc_emission_log_ppb=emission("FULL"))
+    for a in ("HUNGRY", "FULL"):
+        for b in ("HUNGRY", "FULL"):
+            g.add_edge(a, b, log_transition_ppb=math.log(0.7 if a == b else 0.3))
+    g.graph["start_nodes"] = ["HUNGRY", "FULL"]
+
+    obs = pw.debug.table_from_markdown(
+        """
+        observation | __time__
+        HAPPY       | 2
+        HAPPY       | 4
+        GRUMPY      | 6
+        GRUMPY      | 8
+        """
+    )
+    decoded = obs.groupby().reduce(
+        path=create_hmm_reducer(g)(pw.this.observation)
+    )
+    ((path,),) = _rows(decoded)
+    assert path == ("FULL", "FULL", "HUNGRY", "HUNGRY")
+
+
+def test_datasets_digits_sample():
+    from pathway_tpu.stdlib.ml.datasets import load_digits_sample
+
+    Xtr, ytr, Xte, yte = load_digits_sample(sample_size=70)
+    assert len(_rows(ytr)) == 60
+    assert len(_rows(yte)) == 10
+    assert all(isinstance(r[0], np.ndarray) for r in _rows(Xtr))
+
+
+def test_classifier_accuracy():
+    from pathway_tpu.stdlib.ml import classifier_accuracy
+
+    pred = (
+        pw.debug.table_from_markdown(
+            """
+            name | predicted_label
+            a    | x
+            b    | y
+            c    | x
+            """
+        )
+        .with_id_from(pw.this.name)
+        .select(predicted_label=pw.this.predicted_label)
+    )
+    exact = (
+        pw.debug.table_from_markdown(
+            """
+            name | label
+            a    | x
+            b    | x
+            c    | x
+            """
+        )
+        .with_id_from(pw.this.name)
+        .select(label=pw.this.label)
+    )
+    acc = {bool(r[1]): r[0] for r in _rows(classifier_accuracy(pred, exact))}
+    assert acc == {True: 2, False: 1}
+
+
+def test_pandas_transformer():
+    import pandas as pd
+
+    t = pw.debug.table_from_markdown(
+        """
+        foo | bar
+        10  | 100
+        20  | 200
+        """
+    )
+
+    class Output(pw.Schema):
+        sum: int
+
+    @pw.pandas_transformer(output_schema=Output)
+    def sum_cols(df: pd.DataFrame) -> pd.DataFrame:
+        return pd.DataFrame(df.sum(axis=1))
+
+    assert sorted(r[0] for r in _rows(sum_cols(t))) == [110, 220]
+
+
+def test_argmax_rows_and_apply_all_rows():
+    from pathway_tpu.stdlib.utils import argmax_rows
+    from pathway_tpu.stdlib.utils.col import apply_all_rows
+
+    t = pw.debug.table_from_markdown(
+        """
+        g | v
+        a | 1
+        a | 5
+        b | 2
+        """
+    )
+    best = argmax_rows(t, t.g, what=t.v)
+    assert sorted((r[0], r[1]) for r in _rows(best)) == [("a", 5), ("b", 2)]
+
+    pw.G.clear()
+    t2 = pw.debug.table_from_markdown(
+        """
+        v
+        2
+        4
+        """
+    )
+    normed = apply_all_rows(
+        t2.v, fun=lambda vs: [x / max(vs) for x in vs], result_col_name="n"
+    )
+    assert sorted(r[0] for r in _rows(normed)) == [0.5, 1.0]
+
+
+def test_viz_show_and_plot_headless():
+    t = pw.debug.table_from_markdown(
+        """
+        x | y
+        1 | 10
+        2 | 20
+        """
+    )
+    viz = t.show(include_id=False)
+    handle = t.plot(lambda src: None)
+    pw.run()
+    assert "x | y" in str(viz)
+    assert sorted(handle.source.data["y"]) == [10, 20]
+    fig = handle.to_matplotlib("x", "y")
+    assert fig is not None
+
+
+def test_interactive_live_table():
+    pw.enable_interactive_mode()
+    t = pw.debug.table_from_markdown(
+        """
+        v | __time__
+        1 | 2
+        2 | 4
+        """
+    )
+    lt = t.live()
+    deadline = time.time() + 30
+    while not lt.finished and time.time() < deadline:
+        time.sleep(0.02)
+    assert not lt.failed
+    assert sorted(v[0] for v in lt.snapshot().values()) == [1, 2]
+    assert "v" in str(lt)
+
+
+def _clustered(n_clusters=30, per=100, d=32, seed=3):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((n_clusters, d)).astype(np.float32) * 5
+    return np.concatenate(
+        [c + 0.3 * rng.standard_normal((per, d)).astype(np.float32) for c in centers]
+    )
+
+
+def test_lsh_and_ivf_recall_and_sublinearity():
+    from pathway_tpu.stdlib.indexing.approximate import (
+        IvfIndex,
+        LshIndex,
+        _scores,
+    )
+
+    data = _clustered()
+    for name, idx in [
+        ("lsh-cos", LshIndex(32, metric="cos", n_or=24, n_and=8)),
+        ("lsh-l2", LshIndex(32, metric="l2sq", n_or=24, n_and=6, bucket_length=8.0)),
+        ("ivf", IvfIndex(32, metric="cos", n_probes=6, retrain_every=512)),
+    ]:
+        for i, v in enumerate(data):
+            idx.add(i, v)
+        qs = data[:100]
+        exact = np.argsort(-_scores(idx.metric, data, qs), axis=1)[:, :10]
+        res = idx.search_many(qs, 10)
+        recall = np.mean(
+            [len({k for k, _ in r} & set(exact[i])) / 10 for i, r in enumerate(res)]
+        )
+        cand = np.mean([len(idx._candidates(q)) for q in qs[:20]])
+        assert recall > 0.8, (name, recall)
+        # the candidate set must be sub-linear — that is the whole point
+        assert cand < len(data) * 0.6, (name, cand)
+
+    idx = LshIndex(8, metric="cos")
+    idx.add("a", np.ones(8))
+    idx.add("b", -np.ones(8))
+    idx.remove("a")
+    # a's bucket is empty now; b still findable near its own vector
+    assert idx.search_many(np.ones((1, 8)), 2)[0] == []
+    assert [k for k, _ in idx.search_many(-np.ones((1, 8)), 2)[0]] == ["b"]
+
+
+def test_lsh_knn_through_data_index():
+    """LshKnn honors its LSH parameters (no longer a brute-force alias)."""
+    from pathway_tpu.stdlib.indexing.data_index import DataIndex
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+        LshKnn,
+        USearchKnn,
+        USearchMetricKind,
+        _ApproxIndexImpl,
+    )
+
+    rng = np.random.default_rng(5)
+    vecs = [rng.standard_normal(16).astype(np.float32) for _ in range(40)]
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str), [(f"d{i}",) for i in range(40)]
+    )
+    docs = docs.select(
+        name=pw.this.name,
+        vec=pw.apply_with_type(
+            lambda n: vecs[int(n[1:])], np.ndarray, pw.this.name
+        ),
+    )
+    inner = LshKnn(
+        docs.vec, dimensions=16, distance_type="cosine", n_or=16, n_and=6
+    )
+    impl = inner._make_impl()
+    assert isinstance(impl, _ApproxIndexImpl)
+    index = DataIndex(docs, inner)
+    q = pw.debug.table_from_rows(
+        pw.schema_from_types(qv=np.ndarray), [(vecs[7],)]
+    )
+    res = index.query_as_of_now(q.qv, number_of_matches=1).select(
+        m=pw.this.name
+    )
+    ((m,),) = [(r[-1][0],) for r in _rows(res)]
+    assert m == "d7"
+
+    usearch_impl = USearchKnn(
+        docs.vec, dimensions=16, metric=USearchMetricKind.COS
+    )._make_impl()
+    assert isinstance(usearch_impl, _ApproxIndexImpl)

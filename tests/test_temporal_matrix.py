@@ -1,0 +1,307 @@
+"""Temporal operator matrix: windows (tumbling/sliding/session/
+intervals_over), interval joins, asof joins, asof-now joins, window joins —
+static and update-stream assertions (modeled on the reference's
+tests/temporal/ split into deterministic batch tests + *_stream.py
+variants)."""
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import run_tables
+from pathway_tpu.stdlib import temporal
+
+
+def _rows(table):
+    (cap,) = run_tables(table)
+    return sorted(cap.state.rows.values())
+
+
+def _stream(table):
+    (cap,) = run_tables(table, record_stream=True)
+    return cap.stream, sorted(cap.state.rows.values())
+
+
+def test_tumbling_window():
+    t = pw.debug.table_from_markdown(
+        """
+        t  | v
+        1  | 1
+        4  | 2
+        11 | 5
+        19 | 7
+        """
+    )
+    res = temporal.windowby(
+        t, t.t, window=temporal.tumbling(duration=10)
+    ).reduce(
+        start=pw.this._pw_window_start, total=pw.reducers.sum(pw.this.v)
+    )
+    assert _rows(res) == [(0, 3), (10, 12)]
+
+
+def test_sliding_window_multi_assignment():
+    t = pw.debug.table_from_markdown(
+        """
+        t | v
+        5 | 1
+        """
+    )
+    res = temporal.windowby(
+        t, t.t, window=temporal.sliding(hop=2, duration=6)
+    ).reduce(
+        start=pw.this._pw_window_start, c=pw.reducers.count()
+    )
+    # t=5 falls into windows starting at 0, 2, 4
+    assert _rows(res) == [(0, 1), (2, 1), (4, 1)]
+
+
+def test_session_window_merges_chains():
+    t = pw.debug.table_from_markdown(
+        """
+        t  | v
+        1  | 1
+        2  | 2
+        3  | 3
+        10 | 9
+        """
+    )
+    res = temporal.windowby(
+        t, t.t, window=temporal.session(max_gap=2)
+    ).reduce(
+        total=pw.reducers.sum(pw.this.v), c=pw.reducers.count()
+    )
+    assert _rows(res) == [(6, 3), (9, 1)]
+
+
+def test_intervals_over():
+    t = pw.debug.table_from_markdown(
+        """
+        t | v
+        1 | 10
+        3 | 20
+        5 | 30
+        9 | 90
+        """
+    )
+    res = temporal.windowby(
+        t,
+        t.t,
+        window=temporal.intervals_over(
+            at=pw.debug.table_from_markdown(
+                """
+                at
+                3
+                """
+            ).at,
+            lower_bound=-2,
+            upper_bound=2,
+        ),
+    ).reduce(
+        vals=pw.reducers.sorted_tuple(pw.this.v)
+    )
+    assert _rows(res) == [((10, 20, 30),)]
+
+
+def test_interval_join_inner_and_left():
+    left = pw.debug.table_from_markdown(
+        """
+        lt | lv
+        0  | a
+        10 | b
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        rt | rv
+        1  | x
+        12 | y
+        30 | z
+        """
+    )
+    j = temporal.interval_join(
+        left, right, left.lt, right.rt, temporal.interval(-2, 2)
+    ).select(lv=left.lv, rv=right.rv)
+    assert _rows(j) == [("a", "x"), ("b", "y")]
+
+    pw.G.clear()
+    left = pw.debug.table_from_markdown(
+        """
+        lt | lv
+        0  | a
+        100 | c
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        rt | rv
+        1  | x
+        """
+    )
+    jl = temporal.interval_join_left(
+        left, right, left.lt, right.rt, temporal.interval(-2, 2)
+    ).select(lv=left.lv, rv=right.rv)
+    assert _rows(jl) == [("a", "x"), ("c", None)]
+
+
+def test_interval_join_with_on_condition():
+    left = pw.debug.table_from_markdown(
+        """
+        lt | k | lv
+        0  | g | a
+        0  | h | b
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        rt | k | rv
+        1  | g | x
+        """
+    )
+    j = temporal.interval_join(
+        left, right, left.lt, right.rt, temporal.interval(-2, 2),
+        left.k == right.k,
+    ).select(lv=left.lv, rv=right.rv)
+    assert _rows(j) == [("a", "x")]
+
+
+def test_asof_join_directions():
+    left = pw.debug.table_from_markdown(
+        """
+        lt | lv
+        5  | a
+        15 | b
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        rt | rv
+        3  | x
+        10 | y
+        20 | z
+        """
+    )
+    jb = temporal.asof_join(
+        left, right, left.lt, right.rt,
+        how=pw.JoinMode.LEFT,
+        direction=temporal.Direction.BACKWARD,
+    ).select(lv=left.lv, rv=right.rv)
+    assert _rows(jb) == [("a", "x"), ("b", "y")]
+
+    pw.G.clear()
+    left = pw.debug.table_from_markdown(
+        """
+        lt | lv
+        5  | a
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        rt | rv
+        3  | x
+        10 | y
+        """
+    )
+    jf = temporal.asof_join(
+        left, right, left.lt, right.rt,
+        how=pw.JoinMode.LEFT,
+        direction=temporal.Direction.FORWARD,
+    ).select(lv=left.lv, rv=right.rv)
+    assert _rows(jf) == [("a", "y")]
+
+
+def test_asof_now_join_is_frozen_at_query_time():
+    queries = pw.debug.table_from_markdown(
+        """
+        qv | __time__
+        q1 | 4
+        """
+    )
+    data = pw.debug.table_from_markdown(
+        """
+        dv | __time__
+        d1 | 2
+        d2 | 6
+        """
+    )
+    j = temporal.asof_now_join(queries, data).select(
+        qv=queries.qv, dv=data.dv
+    )
+    stream, final = _stream(j)
+    # the query at t=4 saw only d1; d2 at t=6 must not retro-update
+    assert [d[1] for _t, d in stream] == [("q1", "d1")]
+
+
+def test_window_join():
+    left = pw.debug.table_from_markdown(
+        """
+        lt | lv
+        1  | a
+        11 | b
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        rt | rv
+        2  | x
+        15 | y
+        25 | z
+        """
+    )
+    j = temporal.window_join(
+        left, right, left.lt, right.rt, temporal.tumbling(duration=10)
+    ).select(lv=left.lv, rv=right.rv)
+    assert _rows(j) == [("a", "x"), ("b", "y")]
+
+
+def test_sliding_window_update_stream():
+    """A late row extends an existing window: old aggregate retracted."""
+    t = pw.debug.table_from_markdown(
+        """
+        t | v | __time__
+        1 | 1 | 2
+        3 | 2 | 4
+        """
+    )
+    res = temporal.windowby(
+        t, t.t, window=temporal.tumbling(duration=10)
+    ).reduce(
+        start=pw.this._pw_window_start, total=pw.reducers.sum(pw.this.v)
+    )
+    stream, final = _stream(res)
+    assert final == [(0, 3)]
+    flat = [(time, d[1], d[2]) for time, d in stream]
+    assert (2, (0, 1), 1) in flat
+    assert (4, (0, 1), -1) in flat
+    assert (4, (0, 3), 1) in flat
+
+
+def test_inactivity_detection_flags_stale_stream():
+    import datetime
+
+    stale = datetime.datetime.now(datetime.timezone.utc) - datetime.timedelta(
+        hours=2
+    )
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(ts=pw.DateTimeUtc), [(stale,)]
+    )
+    inactive, resumed = temporal.inactivity_detection(
+        t.ts,
+        allowed_inactivity_period=datetime.timedelta(minutes=5),
+        refresh_rate=datetime.timedelta(milliseconds=50),
+    )
+    # utc_now is a streaming source: drive with pw.run and stop at the
+    # first alert
+    alerts = []
+    engines = []
+
+    def grab_engine(ctx, nodes):
+        engines.append(ctx.engine)
+
+    pw.G.add_sink([inactive], grab_engine)
+    pw.io.subscribe(
+        inactive,
+        on_change=lambda key, row, time, is_addition: (
+            alerts.append(row["inactive_since"]),
+            engines[0].terminate_flag.set(),
+        ),
+    )
+    pw.run()
+    assert alerts and alerts[0] == stale  # inactive since the last event
