@@ -60,6 +60,17 @@ def table_from_markdown(
 
     Special columns: `id` fixes row keys; `__time__`/`__diff__` make the
     table a stream of timed insertions/retractions.
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... owner | pet
+    ... Alice | dog
+    ... Bob   | cat
+    ... ''')
+    >>> pw.debug.compute_and_print(t, include_id=False)
+    owner | pet
+    Alice | dog
+    Bob   | cat
     """
     lines = [ln.strip() for ln in table_def.strip().splitlines()]
     lines = [ln for ln in lines if ln and set(ln) - set("|- ")]
@@ -257,7 +268,23 @@ def compute_and_print(
     terminate_on_error: bool = True,
 ) -> None:
     """Run the graph and print the table (reference:
-    debug/__init__.py:222)."""
+    debug/__init__.py:222).
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... g | v
+    ... a | 1
+    ... a | 2
+    ... b | 3
+    ... ''')
+    >>> res = t.groupby(pw.this.g).reduce(
+    ...     g=pw.this.g, total=pw.reducers.sum(pw.this.v)
+    ... )
+    >>> pw.debug.compute_and_print(res, include_id=False)
+    g | total
+    b | 3
+    a | 3
+    """
     (capture,) = run_tables(table)
     names = table.column_names()
     items = sorted(capture.state.rows.items(), key=lambda kv: kv[0])

@@ -648,6 +648,21 @@ class FlattenNode(Node):
                 except TypeError:
                     self.log_error(f"flatten: not a sequence: {seq!r}")
                     continue
+            if len(elements) == 1:
+                # singleton fast path: the parent key is already unique and
+                # stable, so reuse it instead of hashing a derived one (the
+                # Utf8Parser/NullSplitter ingest pipeline flattens twice
+                # per document — this halves its key-derivation cost)
+                out.append(
+                    (
+                        key,
+                        values[: self.flat_idx]
+                        + (elements[0],)
+                        + values[self.flat_idx + 1 :],
+                        diff,
+                    )
+                )
+                continue
             for i, elem in enumerate(elements):
                 new_key = ref_scalar(key, i)
                 new_row = (

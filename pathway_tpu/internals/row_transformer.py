@@ -160,6 +160,15 @@ class _BoundMethod:
         self._attr_name = attr_name
 
     def __call__(self, *args):
+        if self._node is None:
+            # unpickled away from the owning transformer node (another
+            # worker's shard, or an inspected snapshot): there is no state
+            # to evaluate against
+            raise RuntimeError(
+                f"transformer method {self._arg_name}.{self._attr_name} "
+                "can only be called on the worker hosting its transformer "
+                "node (method columns do not evaluate across workers)"
+            )
         return self._node.fresh_evaluator().compute(
             self._arg_name, self._ptr, self._attr_name, args
         )
@@ -178,8 +187,10 @@ class _BoundMethod:
         return f"<method {self._arg_name}.{self._attr_name} of {self._ptr!r}>"
 
     # method values live inside emitted rows, so they must pickle for
-    # operator snapshots / cross-worker exchange; the node binding is
-    # process-local and re-attached by RowTransformerNode._after_restore
+    # operator snapshots (and survive crossing an exchange without
+    # breaking the pipeline); the node binding is process-local and only
+    # RowTransformerNode._after_restore re-attaches it — calling an
+    # unbound method elsewhere raises, it does not silently misbehave
     def __getstate__(self):
         return (self._arg_name, self._ptr, self._attr_name)
 

@@ -164,14 +164,23 @@ class DeviceKnnIndex:
         *,
         metric: str = "cos",
         reserved_space: int = 512,
+        mesh=None,
     ):
         import jax.numpy as jnp
 
         self.d = dimensions
         self.metric = metric
-        self.capacity = _next_bucket(max(reserved_space, 8))
+        # mesh: shard the index rows over the mesh's first axis; searches
+        # run per-shard top-k + ICI all-gather merge (sharded_knn_search)
+        # instead of the reference's full-copy-per-worker replication
+        self.mesh = mesh
+        min_cap = 8
+        if mesh is not None:
+            min_cap = max(min_cap, 2 * mesh.shape[mesh.axis_names[0]])
+        self.capacity = _next_bucket(max(reserved_space, min_cap))
         self._buffer = jnp.zeros((self.capacity, self.d), dtype=jnp.float32)
         self._valid_dev = jnp.zeros((self.capacity,), dtype=bool)
+        self._shard_buffers()
         self._slot_of_key: dict = {}
         self._key_of_slot: dict = {}
         self._free: list[int] = list(range(self.capacity - 1, -1, -1))
@@ -180,6 +189,21 @@ class DeviceKnnIndex:
 
     def __len__(self) -> int:
         return len(self._slot_of_key)
+
+    def _shard_buffers(self) -> None:
+        if self.mesh is None:
+            return
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        axis = self.mesh.axis_names[0]
+        self._buffer = jax.device_put(
+            self._buffer, NamedSharding(self.mesh, P(axis, None))
+        )
+        self._valid_dev = jax.device_put(
+            self._valid_dev, NamedSharding(self.mesh, P(axis))
+        )
 
     def _normalize(self, vectors):
         """cos rows are normalized ONCE at insert time so searches never
@@ -256,6 +280,7 @@ class DeviceKnnIndex:
         )
         self._free.extend(range(new_capacity - 1, self.capacity - 1, -1))
         self.capacity = new_capacity
+        self._shard_buffers()
 
     def _flush(self) -> None:
         if not self._dirty:
@@ -312,8 +337,24 @@ class DeviceKnnIndex:
         k_eff = min(k, self.capacity)
         padded = np.zeros((q_pad, self.d), dtype=np.float32)
         padded[:q] = queries
-        fn = _compiled_search(k_eff, self.metric)
-        top_scores, top_idx = fn(self._buffer, self._valid_dev, padded)
+        if self.mesh is not None:
+            if self.metric == "cos":
+                # rows are insert-normalized; normalize queries host-side so
+                # the sharded kernel can use the plain inner product
+                padded = padded / (
+                    np.linalg.norm(padded, axis=1, keepdims=True) + 1e-30
+                )
+            top_scores, top_idx = sharded_knn_search(
+                self.mesh,
+                self._buffer,
+                self._valid_dev,
+                padded,
+                k_eff,
+                metric="ip" if self.metric == "cos" else self.metric,
+            )
+        else:
+            fn = _compiled_search(k_eff, self.metric)
+            top_scores, top_idx = fn(self._buffer, self._valid_dev, padded)
         top_scores = np.asarray(top_scores)[:q]
         top_idx = np.asarray(top_idx)[:q]
         return top_scores, top_idx, self._key_of_slot
@@ -407,25 +448,35 @@ def sharded_knn_search(mesh, index, valid, queries, k: int, metric: str = "cos")
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.8
+        _rep_kwargs = {"check_vma": False}
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
+        _rep_kwargs = {"check_rep": False}
 
     axis = mesh.axis_names[0]
     n_dev = mesh.shape[axis]
+    shard_size = index.shape[0] // n_dev
+    # the per-shard pass only needs min(k, shard_size) candidates; the
+    # merged pool of n_dev of those always holds >= min(k, capacity), so
+    # the caller gets the full k it asked for (never clamped per shard)
+    local_k = min(k, shard_size)
+    k = min(k, index.shape[0])
 
     def local_search(index_shard, valid_shard, queries_rep):
         scores = _similarity(index_shard, valid_shard, queries_rep, metric)
-        local_scores, local_idx = jax.lax.top_k(scores, k)
+        local_scores, local_idx = jax.lax.top_k(scores, local_k)
         # globalize slot ids, then gather candidates from every shard
         shard_id = jax.lax.axis_index(axis)
-        shard_size = index_shard.shape[0]
         global_idx = local_idx + shard_id * shard_size
-        all_scores = jax.lax.all_gather(local_scores, axis)  # [n_dev, Q, k]
+        all_scores = jax.lax.all_gather(local_scores, axis)  # [n_dev, Q, lk]
         all_idx = jax.lax.all_gather(global_idx, axis)
         all_scores = jnp.transpose(all_scores, (1, 0, 2)).reshape(
-            queries_rep.shape[0], n_dev * k
+            queries_rep.shape[0], n_dev * local_k
         )
         all_idx = jnp.transpose(all_idx, (1, 0, 2)).reshape(
-            queries_rep.shape[0], n_dev * k
+            queries_rep.shape[0], n_dev * local_k
         )
         merged_scores, merged_pos = jax.lax.top_k(all_scores, k)
         merged_idx = jnp.take_along_axis(all_idx, merged_pos, axis=1)
@@ -436,6 +487,6 @@ def sharded_knn_search(mesh, index, valid, queries, k: int, metric: str = "cos")
         mesh=mesh,
         in_specs=(P(axis, None), P(axis), P(None, None)),
         out_specs=(P(None, None), P(None, None)),
-        check_rep=False,
+        **_rep_kwargs,
     )
     return jax.jit(fn)(index, valid, queries)

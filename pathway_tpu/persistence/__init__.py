@@ -507,8 +507,19 @@ class InputSnapshotWriter:
         self.backend = backend
         self.prefix = f"snapshot/{worker_id}/{source_name}"
         self.state_key = f"{self.prefix}/state"
+        self.segptr_key = f"{self.prefix}/segptr"
         segs = self.list_segments()
-        self.active_segment = segs[-1] if segs else 0
+        # the segment pointer survives compaction deleting every segment
+        # file: without it a restart would reuse a sealed segment number
+        # and the replay cursor (folded_through) would skip its events
+        ptr = 0
+        blob = self.backend.get_value(self.segptr_key)
+        if blob is not None:
+            try:
+                ptr = int(blob.decode())
+            except ValueError:
+                ptr = 0
+        self.active_segment = max(segs[-1] if segs else 0, ptr)
 
     def _segment_key(self, seg: int) -> str:
         return f"{self.prefix}/events.{seg:08d}"
@@ -528,6 +539,9 @@ class InputSnapshotWriter:
         """Seal the active segment; returns the sealed segment number."""
         sealed = self.active_segment
         self.active_segment = sealed + 1
+        self.backend.put_value(
+            self.segptr_key, str(self.active_segment).encode()
+        )
         return sealed
 
     def write_batch(self, deltas, subject_state=None) -> None:

@@ -247,7 +247,26 @@ def windowby(
     shard=None,
 ) -> WindowedTable:
     """Assign windows and group (reference: stdlib/temporal/_window.py
-    windowby:590)."""
+    windowby:590).
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... t  | v
+    ... 1  | 10
+    ... 7  | 20
+    ... 13 | 5
+    ... ''')
+    >>> win = pw.temporal.windowby(
+    ...     t, t.t, window=pw.temporal.tumbling(duration=10)
+    ... ).reduce(
+    ...     start=pw.this._pw_window_start,
+    ...     total=pw.reducers.sum(pw.this.v),
+    ... )
+    >>> pw.debug.compute_and_print(win, include_id=False)
+    start | total
+    10    | 5
+    0     | 30
+    """
     if instance is None and shard is not None:
         instance = shard
     mapping = {thisclass.this: table}

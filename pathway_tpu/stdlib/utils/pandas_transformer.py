@@ -37,6 +37,21 @@ def pandas_transformer(
         def wrapper(*tables: Table) -> Table:
             import pandas as pd
 
+            universe_arg: int | None = None
+            if output_universe is not None:
+                if isinstance(output_universe, int):
+                    universe_arg = output_universe
+                else:
+                    raise NotImplementedError(
+                        "output_universe by argument NAME is not supported; "
+                        "pass the positional index of the input table"
+                    )
+                if not 0 <= universe_arg < len(tables):
+                    raise ValueError(
+                        f"output_universe={universe_arg} out of range for "
+                        f"{len(tables)} input tables"
+                    )
+
             column_names = [t.column_names() for t in tables]
 
             packed_inputs = [
@@ -63,6 +78,17 @@ def pandas_transformer(
                         )
                     )
                 result = func(*frames)
+                if universe_arg is not None:
+                    # promised universe: every output row must keep a key
+                    # of the chosen input table (reference: the output
+                    # index IS the output universe)
+                    allowed = {r[0] for r in per_input[universe_arg]}
+                    stray = [i for i in result.index if i not in allowed]
+                    if stray:
+                        raise ValueError(
+                            "pandas_transformer: output index not in the "
+                            f"universe of input {universe_arg}: {stray[:3]}"
+                        )
                 out = []
                 for idx, row in zip(result.index, result.itertuples(index=False)):
                     out.append((idx, *tuple(row)[: len(out_names)]))

@@ -94,6 +94,18 @@ def show(table, *, include_id: bool = True, short_pointers: bool = True,
 
 
 def _repr_mimebundle_(self, include, exclude):
-    """Notebook hook grafted onto Table (reference: table_viz.py:20)."""
-    viz = show(self)
-    return {"text/plain": str(viz)}
+    """Notebook hook grafted onto Table (reference: table_viz.py:20).
+
+    Rendering a table must not mutate the graph (a bare `t` in a notebook
+    cell would otherwise register one subscriber sink per display), so the
+    repr shows the schema; `t.show()` / interactive mode give live data."""
+    cols = ", ".join(
+        f"{name}: {col.dtype!r}"
+        for name, col in self._schema.columns().items()
+    )
+    return {
+        "text/plain": (
+            f"<pw.Table {self._name}({cols})> — call .show() or "
+            "enable_interactive_mode() + .live() for data"
+        )
+    }

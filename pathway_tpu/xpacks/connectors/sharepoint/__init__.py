@@ -155,14 +155,19 @@ class _SharePointSubject(ConnectorSubjectBase):
             time_mod.sleep(self.refresh_interval)
 
     def _persisted_state(self):
-        return {"seen_mtimes": {p: m for p, (m, _r) in self._seen.items()}}
+        # the full rows persist (payload included): retracting a modified/
+        # deleted file after a restart needs the OLD row's values, exactly
+        # why the reference caches source objects for recovery
+        # (src/persistence/cached_object_storage.rs)
+        return {"seen": dict(self._seen)}
 
     def _restore_persisted_state(self, state) -> None:
-        # rows are not replayable from the cursor alone; modified-time map
-        # prevents re-downloading unchanged files after resume
-        if state and "seen_mtimes" in state:
-            for p, m in state["seen_mtimes"].items():
-                self._seen.setdefault(p, (m, {}))
+        if not state:
+            return
+        if "seen" in state:
+            self._seen.update(state["seen"])
+        elif "seen_mtimes" in state:  # legacy cursor: force re-download
+            pass
 
 
 def read(

@@ -17,14 +17,21 @@ class Utf8Parser(UDF):
     """reference: parsers.py Utf8Parser:48."""
 
     def __init__(self):
-        super().__init__(return_type=list, deterministic=True)
+        # batched: one Python call per engine batch, not per document —
+        # this parser sits on the bulk-ingest hot path (SURVEY §3.4)
+        super().__init__(
+            return_type=list, deterministic=True, max_batch_size=65536
+        )
 
-        def parse(contents: bytes) -> list:
-            if isinstance(contents, str):
-                text = contents
-            else:
-                text = contents.decode("utf-8", errors="replace")
-            return [(text, {})]
+        def parse(contents_batch: list) -> list:
+            out = []
+            for contents in contents_batch:
+                if isinstance(contents, str):
+                    text = contents
+                else:
+                    text = contents.decode("utf-8", errors="replace")
+                out.append([(text, {})])
+            return out
 
         self.func = parse
 

@@ -36,10 +36,13 @@ class NullSplitter(BaseSplitter):
     """reference: splitters.py NullSplitter:161."""
 
     def __init__(self):
-        super().__init__()
+        super().__init__(max_batch_size=65536)
 
-        def split(text: str, metadata) -> list:
-            return [(text, _meta(metadata))]
+        def split(texts: list, metadatas: list) -> list:
+            return [
+                [(text, _meta(metadata))]
+                for text, metadata in zip(texts, metadatas)
+            ]
 
         self.func = split
 

@@ -1,0 +1,33 @@
+"""Doctests on public entry points (the reference runs doctests in CI:
+.github/workflows/package_test.yml `--doctest-modules --pyargs pathway`;
+conftest python/pathway/conftest.py). Collected explicitly so import-heavy
+modules stay out of doctest discovery."""
+
+import doctest
+
+import pathway_tpu  # noqa: F401 — ensures grafts applied before examples
+
+
+MODULES = [
+    "pathway_tpu.debug",
+    "pathway_tpu.stdlib.temporal._window",
+]
+
+
+def test_doctests():
+    import importlib
+
+    import pathway_tpu as pw
+
+    total = 0
+    for name in MODULES:
+        mod = importlib.import_module(name)
+        pw.G.clear()
+        results = doctest.testmod(
+            mod,
+            verbose=False,
+            optionflags=doctest.NORMALIZE_WHITESPACE,
+        )
+        assert results.failed == 0, f"doctest failures in {name}"
+        total += results.attempted
+    assert total >= 3  # the examples actually ran

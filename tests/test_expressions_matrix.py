@@ -1,0 +1,203 @@
+"""Expression surface: datetime namespace, Json accessors, pointer
+expressions, tuple ops, unary/binary operator coverage (modeled on
+reference tests/expressions/)."""
+
+import datetime
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import run_tables
+
+
+def _rows(table):
+    (cap,) = run_tables(table)
+    return sorted(cap.state.rows.values())
+
+
+def _one(table):
+    (cap,) = run_tables(table)
+    (row,) = cap.state.rows.values()
+    return row
+
+
+def test_dt_accessors_and_strftime():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(ts=pw.DateTimeNaive),
+        [(datetime.datetime(2026, 7, 30, 12, 34, 56),)],
+    )
+    res = t.select(
+        y=t.ts.dt.year(),
+        mo=t.ts.dt.month(),
+        d=t.ts.dt.day(),
+        h=t.ts.dt.hour(),
+        s=t.ts.dt.strftime("%Y-%m-%d"),
+    )
+    assert _one(res) == (2026, 7, 30, 12, "2026-07-30")
+
+
+def test_dt_strptime_roundtrip_and_floor():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [("2026-07-30 12:34:56",)]
+    )
+    parsed = t.select(ts=t.s.dt.strptime("%Y-%m-%d %H:%M:%S"))
+    res = parsed.select(
+        floored=parsed.ts.dt.floor(datetime.timedelta(hours=1)),
+    )
+    assert _one(res) == (datetime.datetime(2026, 7, 30, 12, 0, 0),)
+
+
+def test_duration_arithmetic():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=pw.DateTimeNaive, b=pw.DateTimeNaive),
+        [
+            (
+                datetime.datetime(2026, 1, 2),
+                datetime.datetime(2026, 1, 1),
+            )
+        ],
+    )
+    res = t.select(
+        d=t.a - t.b,
+        later=t.a + datetime.timedelta(days=1),
+    )
+    assert _one(res) == (
+        datetime.timedelta(days=1),
+        datetime.datetime(2026, 1, 3),
+    )
+
+
+def test_json_get_accessors():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(j=pw.Json),
+        [(pw.Json({"a": {"b": [10, 20]}, "name": "x"}),)],
+    )
+    res = t.select(
+        b1=t.j.get("a").get("b").get(1),
+        name=t.j.get("name"),
+        missing=t.j.get("nope"),
+    )
+    b1, name, missing = _one(res)
+    assert (
+        b1 == 20 or (isinstance(b1, pw.Json) and b1.value == 20)
+    )
+    assert name == "x" or (isinstance(name, pw.Json) and name.value == "x")
+    assert missing is None or (
+        isinstance(missing, pw.Json) and missing.value is None
+    )
+
+
+def test_pointer_from_and_instance_colocation():
+    t = pw.debug.table_from_markdown(
+        """
+        name
+        a
+        b
+        """
+    )
+    res = t.select(p=t.pointer_from(t.name))
+    rows = _rows(res)
+    assert len({r[0] for r in rows}) == 2
+    assert all(isinstance(r[0], pw.Pointer) for r in rows)
+
+    # instance= pins the shard bits (reference: Key::with_shard_of)
+    pw.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+        name | grp
+        a    | g1
+        b    | g1
+        """
+    )
+    res = t.select(p=t.pointer_from(t.name, instance=t.grp))
+    rows = _rows(res)
+    shards = {r[0].shard for r in rows}
+    assert len(shards) == 1  # same instance -> same shard
+
+
+def test_make_tuple_and_get():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    res = t.select(
+        tup=pw.make_tuple(t.a, t.b, t.a + t.b),
+    )
+    res2 = res.select(
+        x=res.tup.get(2),
+        oob=res.tup.get(9),
+        dflt=res.tup.get(9, default=-1),
+    )
+    assert _one(res2) == (3, None, -1)
+
+
+def test_unary_and_bitwise_ops():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        6 | 3
+        """
+    )
+    res = t.select(
+        neg=-t.a,
+        inv=~(t.a > t.b),
+        andv=(t.a > 0) & (t.b > 0),
+        orv=(t.a < 0) | (t.b > 0),
+        xor=(t.a > 0) ^ (t.b > 0),
+        fdiv=t.a // 4,
+        mod=t.a % 4,
+        pow_=t.b**2,
+    )
+    assert _one(res) == (-6, False, True, True, False, 1, 2, 9)
+
+
+def test_string_methods_extended():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [("  Alpha,Beta  ",)]
+    )
+    res = t.select(
+        stripped=t.s.str.strip(),
+        up=t.s.str.upper(),
+        has=t.s.str.find("Beta"),
+        rep=t.s.str.replace("Beta", "Gamma"),
+        starts=t.s.str.strip().str.startswith("Alpha"),
+    )
+    stripped, up, has, rep, starts = _one(res)
+    assert stripped == "Alpha,Beta"
+    assert up == "  ALPHA,BETA  "
+    assert has >= 0
+    assert "Gamma" in rep
+    assert starts is True
+
+
+def test_parse_int_float_and_to_string():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [("42",)]
+    )
+    res = t.select(
+        i=t.s.str.parse_int(),
+        f=t.s.str.parse_float(),
+        back=pw.cast(int, t.s).to_string(),
+    )
+    assert _one(res) == (42, 42.0, "42")
+
+
+def test_matmul_operator_on_arrays():
+    import numpy as np
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str), [("r",)]
+    )
+    t = t.select(
+        a=pw.apply_with_type(
+            lambda _n: np.array([[1.0, 2.0], [3.0, 4.0]]), np.ndarray, t.name
+        ),
+        b=pw.apply_with_type(
+            lambda _n: np.array([1.0, 1.0]), np.ndarray, t.name
+        ),
+    )
+    res = t.select(m=t.a @ t.b)
+    ((m,),) = [r for r in _rows(res)]
+    assert list(m) == [3.0, 7.0]

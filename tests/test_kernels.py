@@ -98,3 +98,40 @@ def test_knn_topk_matches_dense(metric):
             np.sort(s[row]), np.sort(dense[row, ref_i[row]]), rtol=1e-4
         )
     assert not np.isin(i, np.arange(50, 60)).any()
+
+
+def test_device_knn_mesh_sharded_search_matches_dense():
+    """DeviceKnnIndex with a mesh shards the buffer over the first axis and
+    searches via per-shard top-k + all-gather merge (ops/knn.py
+    sharded_knn_search); results must equal the dense single-device path."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    devices = np.array(jax.devices()[:8])
+    mesh = Mesh(devices, ("knn",))
+    rng = np.random.default_rng(7)
+    data = rng.standard_normal((200, 16)).astype(np.float32)
+
+    dense = DeviceKnnIndex(16, metric="cos", reserved_space=256)
+    sharded = DeviceKnnIndex(16, metric="cos", reserved_space=256, mesh=mesh)
+    for i, v in enumerate(data):
+        dense.add(i, v)
+        sharded.add(i, v)
+
+    queries = data[:5] + 0.01 * rng.standard_normal((5, 16)).astype(np.float32)
+    rows_dense = dense.search_keys(queries, 4)
+    rows_sharded = sharded.search_keys(queries, 4)
+    for rd, rs in zip(rows_dense, rows_sharded):
+        assert [k for k, _ in rd] == [k for k, _ in rs]
+        np.testing.assert_allclose(
+            [s for _, s in rd], [s for _, s in rs], rtol=1e-4, atol=1e-5
+        )
+
+    # removals propagate through the sharded path too
+    top_key = rows_sharded[0][0][0]
+    sharded.remove(top_key)
+    rows_after = sharded.search_keys(queries[:1], 4)
+    assert top_key not in [k for k, _ in rows_after[0]]

@@ -213,7 +213,8 @@ def test_recursive_splitter():
 
 def test_null_splitter():
     s = NullSplitter()
-    assert s.func("hello", Json({})) == [("hello", {})]
+    # batched contract: one call per engine batch (lists in, lists out)
+    assert s.func(["hello"], [Json({})]) == [[("hello", {})]]
 
 
 def test_sentence_transformer_embedder_shape():

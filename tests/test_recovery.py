@@ -323,3 +323,41 @@ def test_operator_snapshot_with_method_columns(tmp_path):
     assert states is not None
     mgr.apply_states(engine, states)
     assert list(cap.state.rows.values()) == [(7,)]
+
+
+def test_segment_pointer_survives_full_compaction(tmp_path):
+    """After compaction deletes every segment file, a restarted writer must
+    NOT reuse a sealed segment number (regression: replay cursor skipped
+    the reused segment and the next save deleted its events)."""
+    from pathway_tpu.engine.engine import Engine
+    from pathway_tpu.engine.value import ref_scalar
+    from pathway_tpu.persistence import (
+        FilesystemBackend,
+        InputSnapshotWriter,
+        OperatorSnapshotManager,
+    )
+
+    backend = FilesystemBackend(str(tmp_path))
+    mgr = OperatorSnapshotManager(backend, worker_id=0)
+    writer = InputSnapshotWriter(backend, "src", worker_id=0)
+    k1 = ref_scalar("a")
+    writer.write_batch([(k1, ("a",), 1)])
+    engine = Engine()
+    assert mgr.save(engine, time=10, writers={"src": writer})
+    sealed = mgr.load_manifest()["folded_through"]["src"]
+    assert writer.list_segments() == []  # all folded + deleted
+
+    # restart: new writer must start past the sealed segment
+    writer2 = InputSnapshotWriter(backend, "src", worker_id=0)
+    assert writer2.active_segment > sealed
+    k2 = ref_scalar("b")
+    writer2.write_batch([(k2, ("b",), 1)])
+    # the restore path replays segments after folded_through — the new
+    # event must be visible there
+    assert writer2.read_events(after_segment=sealed) == [(k2, ("b",), 1)]
+    # and the next save folds it into the base instead of deleting it
+    assert mgr.save(engine, time=20, writers={"src": writer2})
+    base, _ = mgr.read_base("src")
+    assert sorted(base, key=repr) == sorted(
+        [(k1, ("a",), 1), (k2, ("b",), 1)], key=repr
+    )

@@ -288,3 +288,61 @@ def test_lsh_knn_through_data_index():
         docs.vec, dimensions=16, metric=USearchMetricKind.COS
     )._make_impl()
     assert isinstance(usearch_impl, _ApproxIndexImpl)
+
+
+def test_pandas_transformer_output_universe_contract():
+    import pandas as pd
+
+    t = pw.debug.table_from_markdown(
+        """
+        foo
+        1
+        2
+        """
+    )
+
+    class Out(pw.Schema):
+        doubled: int
+
+    @pw.pandas_transformer(output_schema=Out, output_universe=0)
+    def keep_keys(df: pd.DataFrame) -> pd.DataFrame:
+        return pd.DataFrame({"doubled": df["foo"] * 2}, index=df.index)
+
+    out = keep_keys(t)
+    (cap_in,) = run_tables(t)
+    pw.G.clear()
+    t2 = pw.debug.table_from_markdown(
+        """
+        foo
+        1
+        2
+        """
+    )
+
+    @pw.pandas_transformer(output_schema=Out, output_universe=0)
+    def keep_keys2(df: pd.DataFrame) -> pd.DataFrame:
+        return pd.DataFrame({"doubled": df["foo"] * 2}, index=df.index)
+
+    out2 = keep_keys2(t2)
+    (cap_t, cap_out) = run_tables(t2, out2)
+    # output rows keep the INPUT's keys (same universe)
+    assert set(cap_out.state.rows.keys()) == set(cap_t.state.rows.keys())
+
+    # a function inventing foreign indexes is rejected under the contract
+    pw.G.clear()
+    t3 = pw.debug.table_from_markdown(
+        """
+        foo
+        1
+        """
+    )
+
+    @pw.pandas_transformer(output_schema=Out, output_universe=0)
+    def breaks_universe(df: pd.DataFrame) -> pd.DataFrame:
+        return pd.DataFrame({"doubled": [1]}, index=[999])
+
+    from pathway_tpu.engine.engine import Engine
+
+    eng = Engine()
+    run_tables(breaks_universe(t3), engine=eng)
+    assert eng.error_log  # surfaced as a UDF error, not silent rekeying

@@ -1,0 +1,180 @@
+"""UDF system depth: sync batching, async capacity/timeout/retry, caching
+strategies, fully-async executor, deterministic flags (modeled on the
+reference's python/pathway/tests/test_udf.py + test_udf_caches)."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import run_tables
+from pathway_tpu.internals.udfs import (
+    ExponentialBackoffRetryStrategy,
+    InMemoryCache,
+    async_executor,
+    fully_async_executor,
+)
+
+
+def _rows(table):
+    (cap,) = run_tables(table)
+    return sorted(cap.state.rows.values())
+
+
+def _t123():
+    return pw.debug.table_from_markdown(
+        """
+        v
+        1
+        2
+        3
+        """
+    )
+
+
+def test_sync_udf_batches_by_max_batch_size():
+    batch_sizes = []
+
+    @pw.udf(max_batch_size=2)
+    def doubled(vs: list) -> list:
+        batch_sizes.append(len(vs))
+        return [v * 2 for v in vs]
+
+    res = _t123().select(d=doubled(pw.this.v))
+    assert _rows(res) == [(2,), (4,), (6,)]
+    assert max(batch_sizes) <= 2 and sum(batch_sizes) == 3
+
+
+def test_async_udf_capacity_limits_concurrency():
+    active = [0]
+    peak = [0]
+    lock = threading.Lock()
+
+    @pw.udf(executor=async_executor(capacity=2))
+    async def slow(v: int) -> int:
+        with lock:
+            active[0] += 1
+            peak[0] = max(peak[0], active[0])
+        await asyncio.sleep(0.05)
+        with lock:
+            active[0] -= 1
+        return v * 10
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(i,) for i in range(6)]
+    )
+    res = t.select(r=slow(pw.this.v))
+    assert [r[0] for r in _rows(res)] == [0, 10, 20, 30, 40, 50]
+    assert peak[0] <= 2
+
+
+def test_async_udf_timeout_yields_error():
+    from pathway_tpu.engine.engine import Engine
+
+    @pw.udf(executor=async_executor(timeout=0.05))
+    async def too_slow(v: int) -> int:
+        await asyncio.sleep(1.0)
+        return v
+
+    t = pw.debug.table_from_rows(pw.schema_from_types(v=int), [(1,)])
+    res = t.select(r=too_slow(pw.this.v))
+    eng = Engine()
+    (cap,) = run_tables(res, engine=eng)
+    ((r,),) = cap.state.rows.values()
+    assert r is pw.Error
+    assert eng.error_log
+
+
+def test_retry_strategy_retries_until_success():
+    attempts = [0]
+
+    @pw.udf(
+        executor=async_executor(
+            retry_strategy=ExponentialBackoffRetryStrategy(
+                max_retries=5, initial_delay=1, backoff_factor=1
+            )
+        )
+    )
+    async def flaky(v: int) -> int:
+        attempts[0] += 1
+        if attempts[0] < 3:
+            raise RuntimeError("transient")
+        return v * 2
+
+    t = pw.debug.table_from_rows(pw.schema_from_types(v=int), [(21,)])
+    res = t.select(r=flaky(pw.this.v))
+    assert _rows(res) == [(42,)]
+    assert attempts[0] == 3
+
+
+def test_in_memory_cache_deduplicates_calls():
+    calls = [0]
+
+    @pw.udf(cache_strategy=InMemoryCache())
+    def expensive(v: int) -> int:
+        calls[0] += 1
+        return v + 100
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(1,), (1,), (2,), (1,)]
+    )
+    res = t.select(r=expensive(pw.this.v))
+    assert [r[0] for r in _rows(res)] == [101, 101, 101, 102]
+    assert calls[0] == 2  # one evaluation per distinct argument
+
+
+def test_fully_async_udf_streams_results():
+    """Fully-async UDFs return Pending first, then upsert the result
+    (reference: async_transformer.rs design; executors.py:226)."""
+
+    @pw.udf(executor=fully_async_executor())
+    async def enrich(v: int) -> int:
+        await asyncio.sleep(0.01)
+        return v * 3
+
+    t = pw.debug.table_from_markdown(
+        """
+        v | __time__
+        5 | 2
+        """
+    )
+    res = t.select(r=enrich(pw.this.v))
+    got = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, is_addition: got.append(
+            (row["r"], is_addition)
+        ),
+    )
+    pw.run()
+    assert (15, True) in got
+    final = [v for v, add in got if add][-1]
+    assert final == 15
+
+
+def test_udf_deterministic_false_keeps_results_stable_on_update():
+    """Non-deterministic UDFs must not re-execute for unchanged rows when
+    an unrelated row updates (the engine caches their outputs)."""
+    calls = [0]
+
+    @pw.udf(deterministic=False)
+    def tag(v: int) -> int:
+        calls[0] += 1
+        return v
+
+    t = pw.debug.table_from_markdown(
+        """
+        name | v | __time__ | __diff__
+        a    | 1 | 2        | 1
+        b    | 2 | 2        | 1
+        b    | 2 | 4        | -1
+        b    | 5 | 4        | 1
+        """
+    ).with_id_from(pw.this.name)
+    t = t.select(v=pw.this.v)
+    res = t.select(r=tag(pw.this.v))
+    (cap,) = run_tables(res)
+    assert sorted(r[0] for r in cap.state.rows.values()) == [1, 5]
+    assert calls[0] == 3  # a, b, updated b — NOT a second evaluation of a
