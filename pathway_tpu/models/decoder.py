@@ -265,9 +265,10 @@ def decoder_forward(params, config: DecoderConfig, ids, mask, *,
         x = x + (swish * up) @ layer["down"].astype(compute_dtype)
 
     x = _rms_norm(x, params["ln_f"], config.norm_eps)
-    logits = jnp.einsum(
-        "blh,vh->blv", x.astype(jnp.float32), params["embed"]
-    )
+    # HF Llama/Mistral checkpoints ship an untied lm_head; fall back to
+    # weight tying (our from-scratch init) when absent
+    head = params.get("lm_head", params["embed"])
+    logits = jnp.einsum("blh,vh->blv", x.astype(jnp.float32), head)
     return logits, new_cache
 
 

@@ -39,15 +39,40 @@ class ChatModel:
         seed: int = 2,
         max_len: int = 128,
     ):
+        import os
+
         import jax
 
+        params = None
+        tokenizer = None
+        from pathway_tpu.models import hf_loader
+
+        if hf_loader.is_decoder_checkpoint(model):
+            if config is not None:
+                raise ValueError(
+                    "pass either a checkpoint directory (its config.json "
+                    "defines the architecture) or an explicit config=, "
+                    "not both"
+                )
+            # real weights: a local Llama/Mistral-family checkpoint dir
+            # (reference: llms.py HFPipelineChat:456 loads HF weights)
+            config, params = hf_loader.load_hf_decoder(model)
+            tok_json = os.path.join(model, "tokenizer.json")
+            if os.path.exists(tok_json):
+                from pathway_tpu.models.tokenizer import FastTokenizer
+
+                tokenizer = FastTokenizer(tok_json)
         if config is None:
             config = MISTRAL_7B_DECODER if "mistral" in model.lower() else TINY
         self.name = model
         self.config = config
         self.max_len = min(max_len, config.max_len)
-        self.tokenizer = HashTokenizer(vocab_size=config.vocab_size)
-        self.params = init_decoder_params(jax.random.PRNGKey(seed), config)
+        self.tokenizer = tokenizer or HashTokenizer(
+            vocab_size=config.vocab_size
+        )
+        if params is None:
+            params = init_decoder_params(jax.random.PRNGKey(seed), config)
+        self.params = params
 
     @classmethod
     def cached(cls, model: str = "tiny-decoder", **kw) -> "ChatModel":

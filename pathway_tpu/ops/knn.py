@@ -440,11 +440,11 @@ class FusedEmbedSearch:
         return _format_rows(scores, idx, self.index._key_of_slot)
 
 
-def sharded_knn_search(mesh, index, valid, queries, k: int, metric: str = "cos"):
-    """Mesh-sharded search: index rows sharded over the mesh's first axis,
-    per-shard top-k, then a global merge (the all-gather of [Q, k] per shard
-    rides ICI; reference instead broadcast-replicates the whole index,
-    external_index.rs:70)."""
+@functools.lru_cache(maxsize=None)
+def _compiled_sharded_search(mesh, n_rows: int, k: int, metric: str):
+    """Compile-once per (mesh, capacity, k, metric): the serving hot path
+    calls this per query batch and must hit jit's trace cache, exactly
+    like the dense `_compiled_search`."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -457,12 +457,12 @@ def sharded_knn_search(mesh, index, valid, queries, k: int, metric: str = "cos")
 
     axis = mesh.axis_names[0]
     n_dev = mesh.shape[axis]
-    shard_size = index.shape[0] // n_dev
+    shard_size = n_rows // n_dev
     # the per-shard pass only needs min(k, shard_size) candidates; the
     # merged pool of n_dev of those always holds >= min(k, capacity), so
     # the caller gets the full k it asked for (never clamped per shard)
     local_k = min(k, shard_size)
-    k = min(k, index.shape[0])
+    k = min(k, n_rows)
 
     def local_search(index_shard, valid_shard, queries_rep):
         scores = _similarity(index_shard, valid_shard, queries_rep, metric)
@@ -489,4 +489,14 @@ def sharded_knn_search(mesh, index, valid, queries, k: int, metric: str = "cos")
         out_specs=(P(None, None), P(None, None)),
         **_rep_kwargs,
     )
-    return jax.jit(fn)(index, valid, queries)
+    return jax.jit(fn)
+
+
+def sharded_knn_search(mesh, index, valid, queries, k: int, metric: str = "cos"):
+    """Mesh-sharded search: index rows sharded over the mesh's first axis,
+    per-shard top-k, then a global merge (the all-gather of [Q, k] per shard
+    rides ICI; reference instead broadcast-replicates the whole index,
+    external_index.rs:70)."""
+    return _compiled_sharded_search(mesh, index.shape[0], k, metric)(
+        index, valid, queries
+    )

@@ -99,13 +99,10 @@ def _repr_mimebundle_(self, include, exclude):
     Rendering a table must not mutate the graph (a bare `t` in a notebook
     cell would otherwise register one subscriber sink per display), so the
     repr shows the schema; `t.show()` / interactive mode give live data."""
-    cols = ", ".join(
-        f"{name}: {col.dtype!r}"
-        for name, col in self._schema.columns().items()
-    )
     return {
         "text/plain": (
-            f"<pw.Table {self._name}({cols})> — call .show() or "
-            "enable_interactive_mode() + .live() for data"
+            repr(self)
+            + " — call .show() or enable_interactive_mode() + .live() "
+            "for data"
         )
     }

@@ -166,8 +166,13 @@ class _SharePointSubject(ConnectorSubjectBase):
             return
         if "seen" in state:
             self._seen.update(state["seen"])
-        elif "seen_mtimes" in state:  # legacy cursor: force re-download
-            pass
+        elif "seen_mtimes" in state:
+            # legacy cursor (mtimes only): keep it so unchanged files are
+            # not re-downloaded/re-emitted on top of the snapshot replay;
+            # the known limitation is that a file modified later cannot
+            # retract its pre-upgrade row (no cached payload)
+            for p, m in state["seen_mtimes"].items():
+                self._seen.setdefault(p, (m, {}))
 
 
 def read(
