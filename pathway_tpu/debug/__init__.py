@@ -382,6 +382,50 @@ def assert_table_equality_wo_index(actual, expected, **kwargs) -> None:
         assert a_multi == e_multi, _diff_message(a_multi, e_multi)
 
 
+def assert_stream_equality(actual, expected_stream) -> None:
+    """Determinism check on the UPDATE STREAM, not just final state
+    (reference: tests/utils.py assert_key_entries_in_stream_consistent /
+    DiffEntry — batch-boundary consistency is the differential-dataflow
+    guarantee the engine must keep).
+
+    `expected_stream` entries: (time, values_tuple, diff), or
+    (time, key, values_tuple, diff) to also pin row keys (key determinism).
+    Comparison is per-time multisets so within-batch ordering stays free."""
+    from collections import defaultdict
+
+    from pathway_tpu.internals.runner import run_tables
+
+    expected_stream = list(expected_stream)
+    with_keys = any(len(e) == 4 for e in expected_stream)
+    (cap,) = run_tables(actual, record_stream=True)
+    got: dict = defaultdict(Counter)
+    for time, (key, values, diff) in cap.stream:
+        entry = (key, _norm_row(values), diff) if with_keys else (
+            _norm_row(values), diff
+        )
+        got[time][entry] += 1
+    want: dict = defaultdict(Counter)
+    for e in expected_stream:
+        if with_keys:
+            time, key, values, diff = e
+            want[time][(key, _norm_row(tuple(values)), diff)] += 1
+        else:
+            time, values, diff = e
+            want[time][(_norm_row(tuple(values)), diff)] += 1
+    assert dict(got) == dict(want), _diff_message(dict(got), dict(want))
+
+
+def assert_stream_equality_wo_index(actual, expected_stream) -> None:
+    """Values-only variant (keys ignored even if provided)."""
+    assert_stream_equality(
+        actual,
+        [
+            (e[0], e[-2], e[-1])
+            for e in expected_stream
+        ],
+    )
+
+
 def assert_table_equality_wo_types(actual, expected, **kwargs) -> None:
     assert_table_equality(actual, expected)
 

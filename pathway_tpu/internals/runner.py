@@ -92,15 +92,50 @@ def run(
             nodes = [ctx.node(t) for t in sink.tables]
             sink.attach(ctx, nodes)
     _attach_monitoring(engine)
-    with telemetry.span(
-        "graph_runner.run",
-        workers=engine.worker_count,
-        streaming=bool(G.sources),
-    ):
-        if G.sources:
-            _run_streaming(engine, ctx, persistence_config)
-        else:
-            engine.run_static()
+    monitor = _maybe_start_dashboard(engine, monitoring_level)
+    http_server = None
+    if with_http_server:
+        from pathway_tpu.internals.monitoring import PrometheusServer
+
+        http_server = PrometheusServer(engine, process_id=engine.worker_id)
+        http_server.start()
+    try:
+        with telemetry.span(
+            "graph_runner.run",
+            workers=engine.worker_count,
+            streaming=bool(G.sources),
+        ):
+            if G.sources:
+                _run_streaming(engine, ctx, persistence_config)
+            else:
+                engine.run_static()
+    finally:
+        if monitor is not None:
+            monitor.stop()
+        if http_server is not None:
+            http_server.stop()
+
+
+def _maybe_start_dashboard(engine: Engine, monitoring_level):
+    """Rich live console dashboard (reference: internals/monitoring.py
+    StatsMonitor:186). AUTO shows it only on a tty; NONE never."""
+    from pathway_tpu.internals.monitoring import MonitoringLevel, StatsMonitor
+
+    if isinstance(monitoring_level, str):
+        monitoring_level = MonitoringLevel(monitoring_level.lower())
+    if monitoring_level is None or monitoring_level == MonitoringLevel.NONE:
+        return None
+    if monitoring_level == MonitoringLevel.AUTO:
+        import sys
+
+        if not sys.stderr.isatty():
+            return None
+    try:
+        monitor = StatsMonitor(engine)
+        monitor.start_live()
+        return monitor
+    except Exception:  # noqa: BLE001 — rich absent / no console
+        return None
 
 
 def run_all(**kwargs) -> None:

@@ -10,6 +10,8 @@ connector image via docker; tests inject a runner emitting protocol lines.
 from __future__ import annotations
 
 import json
+import os
+import re
 import subprocess
 import tempfile
 import time as time_mod
@@ -26,10 +28,49 @@ from pathway_tpu.io._connector_runtime import (
 
 
 class AirbyteSourceRunner:
-    """Produces Airbyte protocol messages (dicts) for one sync run."""
+    """Produces Airbyte protocol messages (dicts) for one sync run.
+
+    Shared machinery for every execution backend: configured-catalog
+    construction, tolerant protocol parsing, and an injectable command
+    executor (tests pass `_execute`)."""
+
+    _execute = None  # injectable: fn(args) -> stdout text
 
     def sync(self, state: Any) -> Iterable[dict]:
         raise NotImplementedError
+
+    def cleanup(self) -> None:
+        """Release backend resources (venv dir, cloud job)."""
+
+    def _configured_catalog(self, state) -> dict:
+        return {
+            "streams": [
+                {
+                    "stream": {"name": s, "json_schema": {}},
+                    "sync_mode": "incremental" if state else "full_refresh",
+                    "destination_sync_mode": "append",
+                }
+                for s in self.streams
+            ]
+        }
+
+    def _exec(self, args: List[str]) -> str:
+        if self._execute is not None:
+            return self._execute(args)
+        return subprocess.run(
+            args, check=True, capture_output=True, text=True
+        ).stdout
+
+    @staticmethod
+    def _parse_protocol(lines: Iterable[str]) -> Iterable[dict]:
+        for line in lines:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue
 
 
 class DockerAirbyteSource(AirbyteSourceRunner):
@@ -46,19 +87,9 @@ class DockerAirbyteSource(AirbyteSourceRunner):
             cfg = f"{tmp}/config.json"
             with open(cfg, "w") as fh:
                 json.dump(self.config, fh)
-            catalog = {
-                "streams": [
-                    {
-                        "stream": {"name": s, "json_schema": {}},
-                        "sync_mode": "incremental" if state else "full_refresh",
-                        "destination_sync_mode": "append",
-                    }
-                    for s in self.streams
-                ]
-            }
             cat = f"{tmp}/catalog.json"
             with open(cat, "w") as fh:
-                json.dump(catalog, fh)
+                json.dump(self._configured_catalog(state), fh)
             cmd = [
                 "docker", "run", "--rm", "-v", f"{tmp}:/cfg",
                 self.image, "read", "--config", "/cfg/config.json",
@@ -70,15 +101,181 @@ class DockerAirbyteSource(AirbyteSourceRunner):
                     json.dump(state, fh)
                 cmd += ["--state", "/cfg/state.json"]
             proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
-            for line in proc.stdout:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    yield json.loads(line)
-                except json.JSONDecodeError:
-                    continue
+            yield from self._parse_protocol(proc.stdout)
             proc.wait()
+
+
+class VenvAirbyteSource(AirbyteSourceRunner):
+    """Runs a pip-installable Airbyte connector inside a private venv
+    (reference: third_party/airbyte_serverless venv execution)."""
+
+    def __init__(self, package: str, config: dict, streams: List[str], *, _execute=None):
+        self.package = package
+        self.config = config
+        self.streams = streams
+        self._execute = _execute
+        self._venv = None
+
+    def _entrypoint(self) -> str:
+        """Console-script path: Airbyte convention names the script after
+        the connector (`airbyte-source-faker` installs `source-faker`)."""
+        base = re.split(r"[=<>!\[ ]", self.package)[0]
+        candidates = [base]
+        if base.startswith("airbyte-"):
+            candidates.insert(0, base[len("airbyte-"):])
+        for cand in candidates:
+            path = os.path.join(self._venv, "bin", cand)
+            if self._execute is not None or os.path.exists(path):
+                return path
+        bin_dir = os.path.join(self._venv, "bin")
+        if os.path.isdir(bin_dir):
+            for f in sorted(os.listdir(bin_dir)):
+                if f.startswith(("source-", "destination-")):
+                    return os.path.join(bin_dir, f)
+        raise FileNotFoundError(
+            f"no connector entrypoint found in {bin_dir} for {self.package}"
+        )
+
+    def sync(self, state):
+        import sys
+
+        if self._venv is None:
+            self._venv = tempfile.mkdtemp(prefix="pw_airbyte_venv_")
+            self._exec([sys.executable, "-m", "venv", self._venv])
+            self._exec([f"{self._venv}/bin/pip", "install", self.package])
+        with tempfile.TemporaryDirectory() as tmp:
+            cfg = f"{tmp}/config.json"
+            with open(cfg, "w") as fh:
+                json.dump(self.config, fh)
+            cat = f"{tmp}/catalog.json"
+            with open(cat, "w") as fh:
+                json.dump(self._configured_catalog(state), fh)
+            args = [
+                self._entrypoint(), "read",
+                "--config", cfg, "--catalog", cat,
+            ]
+            if state is not None:
+                st = f"{tmp}/state.json"
+                with open(st, "w") as fh:
+                    json.dump(state, fh)
+                args += ["--state", st]
+            out = self._exec(args)
+        yield from self._parse_protocol(out.splitlines())
+
+    def cleanup(self) -> None:
+        if self._venv is not None:
+            import shutil
+
+            shutil.rmtree(self._venv, ignore_errors=True)
+            self._venv = None
+
+
+# bootstrap script run inside the Cloud Run job: Airbyte images export
+# AIRBYTE_ENTRYPOINT; config/catalog/state arrive base64-encoded in env
+# vars set per execution (the same scheme the reference's
+# airbyte_serverless remote runner uses)
+_CLOUD_RUN_WRAPPER = (
+    'echo $AIRBYTE_CONFIG_B64 | base64 -d > /tmp/config.json; '
+    'echo $AIRBYTE_CATALOG_B64 | base64 -d > /tmp/catalog.json; '
+    'if [ -n "$AIRBYTE_STATE_B64" ]; then '
+    'echo $AIRBYTE_STATE_B64 | base64 -d > /tmp/state.json; '
+    'STATE_ARGS="--state /tmp/state.json"; fi; '
+    '$AIRBYTE_ENTRYPOINT read --config /tmp/config.json '
+    '--catalog /tmp/catalog.json $STATE_ARGS'
+)
+
+
+class CloudRunAirbyteSource(AirbyteSourceRunner):
+    """Executes the connector as a Google Cloud Run job (reference:
+    io/airbyte read(execution_type="remote") over the airbyte_serverless
+    remote runner). The job wraps the image entrypoint in a shell that
+    decodes config/catalog/state from env vars; protocol output is read
+    back from Cloud Logging for the specific execution. Shells out to
+    `gcloud` (ambient credentials); tests inject `_execute`."""
+
+    def __init__(
+        self,
+        image: str,
+        config: dict,
+        streams: List[str],
+        *,
+        region: str = "europe-west1",
+        job_name: str | None = None,
+        env_vars: dict | None = None,
+        _execute=None,
+    ):
+        import uuid
+
+        self.image = image
+        self.config = config
+        self.streams = streams
+        self.region = region
+        self._auto_named = job_name is None
+        self.job_name = job_name or f"pw-airbyte-{uuid.uuid4().hex[:12]}"
+        self.env_vars = env_vars or {}
+        self._execute = _execute
+        self._created = False
+
+    def sync(self, state):
+        import base64
+
+        if not self._created:
+            env_flags = []
+            for k, v in self.env_vars.items():
+                env_flags += ["--set-env-vars", f"{k}={v}"]
+            self._exec(
+                [
+                    "gcloud", "run", "jobs", "create", self.job_name,
+                    "--image", self.image, "--region", self.region,
+                    "--max-retries", "0",
+                    "--command", "/bin/sh",
+                    "--args", "-c," + _CLOUD_RUN_WRAPPER,
+                ]
+                + env_flags
+            )
+            self._created = True
+
+        def b64(obj) -> str:
+            return base64.b64encode(json.dumps(obj).encode()).decode()
+
+        env = (
+            f"AIRBYTE_CONFIG_B64={b64(self.config)},"
+            f"AIRBYTE_CATALOG_B64={b64(self._configured_catalog(state))}"
+        )
+        if state is not None:
+            env += f",AIRBYTE_STATE_B64={b64(state)}"
+        execution = self._exec(
+            [
+                "gcloud", "run", "jobs", "execute", self.job_name,
+                "--region", self.region, "--wait",
+                "--update-env-vars", env,
+                "--format", "value(metadata.name)",
+            ]
+        ).strip()
+        logs = self._exec(
+            [
+                "gcloud", "logging", "read",
+                'resource.type="cloud_run_job" AND '
+                f'labels."run.googleapis.com/execution_name"="{execution}"',
+                "--format", "value(textPayload)",
+                "--order", "asc",
+            ]
+        )
+        yield from self._parse_protocol(logs.splitlines())
+
+    def cleanup(self) -> None:
+        if self._created and self._auto_named:
+            # auto-named jobs would otherwise accumulate in the project
+            try:
+                self._exec(
+                    [
+                        "gcloud", "run", "jobs", "delete", self.job_name,
+                        "--region", self.region, "--quiet",
+                    ]
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            self._created = False
 
 
 class _AirbyteSubject(ConnectorSubjectBase):
@@ -111,6 +308,9 @@ class _AirbyteSubject(ConnectorSubjectBase):
                 return  # full-refresh source: one sync per run
             time_mod.sleep(self.refresh_interval)
 
+    def on_stop(self) -> None:
+        self.runner.cleanup()
+
     def _persisted_state(self):
         return {"state": self._state}
 
@@ -125,6 +325,9 @@ def read(
     *,
     mode: str = "streaming",
     refresh_interval_ms: int = 60_000,
+    execution_type: str = "local",
+    gcp_region: str = "europe-west1",
+    gcp_job_name: str | None = None,
     name: str | None = None,
     _runner: AirbyteSourceRunner | None = None,
     **kwargs,
@@ -138,7 +341,16 @@ def read(
         source = config.get("source", config)
         image = source.get("docker_image") or source.get("image")
         conf = source.get("config", {})
-        _runner = DockerAirbyteSource(image, conf, streams or [])
+        if execution_type == "remote":
+            _runner = CloudRunAirbyteSource(
+                image,
+                conf,
+                streams or [],
+                region=gcp_region,
+                job_name=gcp_job_name,
+            )
+        else:
+            _runner = DockerAirbyteSource(image, conf, streams or [])
     schema = schema_from_columns(
         {"data": ColumnSchema(name="data", dtype=dt.JSON)}, name="AirbyteSchema"
     )

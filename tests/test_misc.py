@@ -267,3 +267,43 @@ def test_error_cites_user_frame():
     assert entry.trace.file.endswith("test_misc.py")
     assert "bad = t.select" in entry.trace.line_text
     assert entry.operator == "rowwise"
+
+
+def test_sql_union_all_and_aliases():
+    t1 = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 10
+        """
+    )
+    t2 = pw.debug.table_from_markdown(
+        """
+        a | b
+        2 | 20
+        """
+    )
+    res = pw.sql("SELECT a, b FROM t1 UNION ALL SELECT a, b FROM t2", t1=t1, t2=t2)
+    from pathway_tpu.internals.runner import run_tables
+
+    (cap,) = run_tables(res)
+    assert sorted(cap.state.rows.values()) == [(1, 10), (2, 20)]
+
+
+def test_sql_aggregates_and_having():
+    t = pw.debug.table_from_markdown(
+        """
+        g | v
+        a | 1
+        a | 3
+        b | 10
+        """
+    )
+    res = pw.sql(
+        "SELECT g, SUM(v) AS s, COUNT(*) AS c, AVG(v) AS m FROM t "
+        "GROUP BY g HAVING SUM(v) > 3",
+        t=t,
+    )
+    from pathway_tpu.internals.runner import run_tables
+
+    (cap,) = run_tables(res)
+    assert sorted(cap.state.rows.values()) == [("a", 4, 2, 2.0), ("b", 10, 1, 10.0)]
