@@ -1,0 +1,92 @@
+"""REST ingress depth: GET method coercion, OpenAPI description, CORS
+headers, rejection of bad payloads (reference: io/http/_server.py
+PathwayWebserver:482, rest_connector:696, EndpointDocumentation:127)."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pathway_tpu as pw
+from pathway_tpu.io.http._server import PathwayWebserver, rest_connector
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_http(port, path="/_schema", timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ) as resp:
+                return json.loads(resp.read())
+        except Exception:
+            time.sleep(0.1)
+    raise TimeoutError("webserver did not come up")
+
+
+def test_rest_connector_get_and_openapi_and_cors():
+    port = _free_port()
+    webserver = PathwayWebserver("127.0.0.1", port, with_cors=True)
+
+    class QuerySchema(pw.Schema):
+        value: int
+
+    queries, writer = rest_connector(
+        webserver=webserver,
+        route="/double",
+        schema=QuerySchema,
+        methods=("GET", "POST"),
+        delete_completed_queries=False,
+    )
+    result = queries.select(result=pw.this.value * 2)
+    writer(result)
+
+    runner = threading.Thread(target=pw.run, daemon=True)
+    runner.start()
+
+    # OpenAPI description is served and names the route
+    desc = _wait_http(port)
+    assert "/double" in json.dumps(desc)
+
+    # GET with query-string params coerces types per the schema
+    deadline = time.time() + 30
+    body = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/double?value=21", timeout=10
+            ) as resp:
+                body = json.loads(resp.read())
+                cors = resp.headers.get("Access-Control-Allow-Origin")
+                break
+        except (urllib.error.URLError, TimeoutError):
+            time.sleep(0.2)
+    assert body is not None and (body == 42 or body.get("result") == 42), body
+    assert cors == "*"
+
+    # unknown route -> 404 json error
+    try:
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/nope", timeout=10)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+    # invalid json on POST -> 400
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/double",
+        data=b"{not-json",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
