@@ -445,6 +445,7 @@ class StreamingDriver:
         }
         multiworker = self.engine.worker_count > 1
         done = False
+        carry: List = []  # events deferred across drain rounds
 
         def flush():
             """One coordinated flush tick. Multi-worker: every worker makes
@@ -514,25 +515,59 @@ class StreamingDriver:
                 # that idle peers are blocked on)
                 flush()
                 continue
-            try:
-                kind, live, payload, counter = self.queue.get(timeout=timeout)
-            except queue_mod.Empty:
+            if carry:
+                # deferred tail from the previous round (data that followed
+                # a commit) processes first, without waiting for new input
+                events = carry
+                carry = []
+            else:
+                try:
+                    events = [self.queue.get(timeout=timeout)]
+                except queue_mod.Empty:
+                    flush()
+                    continue
+            # drain whatever already queued up: events that arrived while
+            # the engine was busy coalesce into ONE batch — server-side
+            # micro-batching that amortizes the per-dispatch device round
+            # trip across concurrent requests (reference: commit ticks
+            # group per-duration; here load itself sets the batch size).
+            # Bounded so a hot source cannot starve the autocommit
+            # deadline / multi-worker barrier.
+            while len(events) < 4096:
+                try:
+                    events.append(self.queue.get_nowait())
+                except queue_mod.Empty:
+                    break
+            needs_flush = False
+            committed_this_round: set = set()
+            for idx, (kind, live, payload, counter) in enumerate(events):
+                if (
+                    self.persistence_config is not None
+                    and kind == "data"
+                    and live in committed_this_round
+                ):
+                    # exactly-once: a persisted batch must not contain
+                    # deltas from AFTER its subject-state commit — hold the
+                    # tail for the next round instead of logging it under a
+                    # stale cursor
+                    carry = events[idx:]
+                    break
+                counters[live] = max(counters.get(live, 0), counter)
+                if kind == "data":
+                    pending.setdefault(live, []).append(payload)
+                elif kind == "commit":
+                    if payload is not None:
+                        states[live] = payload
+                    committed_this_round.add(live)
+                    # multi-worker: commits buffer until the timer tick so
+                    # every worker performs the same number of
+                    # coordination rounds
+                    needs_flush = True
+                elif kind == "close":
+                    active -= 1
+                    needs_flush = True
+            if needs_flush and not multiworker:
                 flush()
-                continue
-            counters[live] = max(counters.get(live, 0), counter)
-            if kind == "data":
-                pending.setdefault(live, []).append(payload)
-            elif kind == "commit":
-                if payload is not None:
-                    states[live] = payload
-                # multi-worker: commits buffer until the timer tick so every
-                # worker performs the same number of coordination rounds
-                if not multiworker:
-                    flush()
-            elif kind == "close":
-                active -= 1
-                if not multiworker:
-                    flush()
             if not multiworker and self.engine.terminate_flag.is_set():
                 break
         self.engine.finish()
