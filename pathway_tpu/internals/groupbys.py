@@ -92,7 +92,7 @@ class GroupedTable:
 
         def build(ctx):
             from pathway_tpu.engine.operators import ReduceNode
-            from pathway_tpu.engine.value import ERROR, Pointer, ref_scalar
+            from pathway_tpu.engine.value import ERROR, Error, Pointer, ref_scalar
 
             node = ctx.node(source)
             group_progs = [_compile_on(ctx, [source], g) for g in grouping]
@@ -115,6 +115,12 @@ class GroupedTable:
                 out = []
                 for i in range(len(keys)):
                     gvals = tuple(c[i] for c in gcols)
+                    if any(isinstance(v, Error) for v in gvals):
+                        # an Error grouping value must exclude the row (and
+                        # log), not silently form its own Error-group
+                        # (reference: group_by error handling, reduce.rs)
+                        out.append((ERROR, gvals))
+                        continue
                     if ids is not None:
                         gkey = ids[i]
                     else:

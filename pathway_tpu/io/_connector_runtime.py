@@ -445,7 +445,10 @@ class StreamingDriver:
         }
         multiworker = self.engine.worker_count > 1
         done = False
-        carry: List = []  # events deferred across drain rounds
+        # per-live commit bookkeeping: how much of `pending` the subject
+        # has committed (flushable), and whether it ever commits at all
+        committed_upto: Dict[LiveSource, int] = {}
+        ever_committed: set = set()
 
         def flush():
             """One coordinated flush tick. Multi-worker: every worker makes
@@ -455,7 +458,14 @@ class StreamingDriver:
             worker reaches the same tick — that is the frontier protocol."""
             nonlocal time, last_flush, last_snapshot, done
             nonlocal dirty_since_snapshot
-            has_data = any(bool(d) for d in pending.values())
+            has_data = any(
+                bool(
+                    d[: committed_upto.get(live, 0)]
+                    if live in ever_committed
+                    else d
+                )
+                for live, d in pending.items()
+            )
             local_done = active <= 0 and not has_data
             term = self.engine.terminate_flag.is_set()
             snap_due = op_mgr is not None and (
@@ -476,15 +486,31 @@ class StreamingDriver:
                 any_data = has_data
                 done = local_done or term
             if any_data:
-                for live, deltas in pending.items():
-                    if deltas:
-                        writer = self._snapshot_writer(live)
-                        if writer is not None:
-                            state = states.pop(live, None) or {}
-                            state["counter"] = counters.get(live, 0)
-                            writer.write_batch(deltas, state)
-                        live.node.push(time, deltas)
-                pending.clear()
+                for live in list(pending.keys()):
+                    deltas = pending[live]
+                    if not deltas:
+                        continue
+                    # exactly-once under persistence: only the prefix up to
+                    # the subject's last commit flushes with the committed
+                    # cursor state; the uncommitted tail waits for its own
+                    # commit. Sources that never commit (autocommit-only)
+                    # flush everything with the counter cursor, as before.
+                    if live in ever_committed:
+                        cut = committed_upto.get(live, 0)
+                        batch, tail = deltas[:cut], deltas[cut:]
+                        pending[live] = tail
+                        committed_upto[live] = 0
+                    else:
+                        batch, tail = deltas, []
+                        pending[live] = []
+                    if not batch:
+                        continue
+                    writer = self._snapshot_writer(live)
+                    if writer is not None:
+                        state = states.pop(live, None) or {}
+                        state["counter"] = counters.get(live, 0)
+                        writer.write_batch(batch, state)
+                    live.node.push(time, batch)
                 self.engine.process_time(time)
                 dirty_since_snapshot = True
                 time += 2
@@ -515,17 +541,11 @@ class StreamingDriver:
                 # that idle peers are blocked on)
                 flush()
                 continue
-            if carry:
-                # deferred tail from the previous round (data that followed
-                # a commit) processes first, without waiting for new input
-                events = carry
-                carry = []
-            else:
-                try:
-                    events = [self.queue.get(timeout=timeout)]
-                except queue_mod.Empty:
-                    flush()
-                    continue
+            try:
+                events = [self.queue.get(timeout=timeout)]
+            except queue_mod.Empty:
+                flush()
+                continue
             # drain whatever already queued up: events that arrived while
             # the engine was busy coalesce into ONE batch — server-side
             # micro-batching that amortizes the per-dispatch device round
@@ -539,32 +559,24 @@ class StreamingDriver:
                 except queue_mod.Empty:
                     break
             needs_flush = False
-            committed_this_round: set = set()
-            for idx, (kind, live, payload, counter) in enumerate(events):
-                if (
-                    self.persistence_config is not None
-                    and kind == "data"
-                    and live in committed_this_round
-                ):
-                    # exactly-once: a persisted batch must not contain
-                    # deltas from AFTER its subject-state commit — hold the
-                    # tail for the next round instead of logging it under a
-                    # stale cursor
-                    carry = events[idx:]
-                    break
+            for kind, live, payload, counter in events:
                 counters[live] = max(counters.get(live, 0), counter)
                 if kind == "data":
                     pending.setdefault(live, []).append(payload)
                 elif kind == "commit":
                     if payload is not None:
                         states[live] = payload
-                    committed_this_round.add(live)
+                    committed_upto[live] = len(pending.get(live, []))
+                    ever_committed.add(live)
                     # multi-worker: commits buffer until the timer tick so
                     # every worker performs the same number of
                     # coordination rounds
                     needs_flush = True
                 elif kind == "close":
                     active -= 1
+                    # close is an implicit final commit: the source is gone,
+                    # its uncommitted tail is final data
+                    committed_upto[live] = len(pending.get(live, []))
                     needs_flush = True
             if needs_flush and not multiworker:
                 flush()

@@ -464,3 +464,77 @@ def test_mcp_server_tool_roundtrip():
             break
         time_mod.sleep(0.1)
     assert "apple" in text, text
+
+
+def test_rerank_topk_filter_and_llm_reranker():
+    from pathway_tpu.xpacks.llm.rerankers import (
+        LLMReranker,
+        rerank_topk_filter,
+    )
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(docs=tuple, scores=tuple),
+        [((("d1", "d2", "d3")), (0.1, 0.9, 0.5))],
+    )
+    top = rerank_topk_filter(t.docs, t.scores, k=2)
+    res = t.select(kept=top)
+    (cap,) = run_tables(res)
+    ((kept,),) = cap.state.rows.values()
+    kept_docs = kept[0] if isinstance(kept, tuple) and len(kept) == 2 else kept
+    assert "d2" in str(kept_docs) and "d3" in str(kept_docs)
+    assert "d1" not in str(kept_docs)
+
+    # LLMReranker parses the model's 1-5 score
+    class ScoreChat(UDF):
+        def __init__(self):
+            super().__init__(return_type=str, deterministic=True)
+
+            async def chat(messages, **kw) -> str:
+                return "4"
+
+            self.func = chat
+
+    pw.G.clear()
+    reranker = LLMReranker(llm=ScoreChat())
+    pairs = pw.debug.table_from_rows(
+        pw.schema_from_types(doc=str, q=str), [("some doc", "some query")]
+    )
+    scored = pairs.select(s=reranker(pw.this.doc, pw.this.q))
+    (cap,) = run_tables(scored)
+    ((s,),) = cap.state.rows.values()
+    assert float(s) == 4.0
+
+
+def test_encoder_reranker_scores_by_dot():
+    from pathway_tpu.xpacks.llm.rerankers import EncoderReranker
+
+    reranker = EncoderReranker()
+    pairs = pw.debug.table_from_rows(
+        pw.schema_from_types(doc=str, q=str),
+        [
+            ("identical text", "identical text"),  # cos ~ 1.0
+            ("alpha bravo charlie", "zulu yankee xray"),
+        ],
+    )
+    scored = pairs.select(s=reranker(pw.this.doc, pw.this.q))
+    (cap,) = run_tables(scored)
+    scores = sorted(r[0] for r in cap.state.rows.values())
+    assert abs(scores[-1] - 1.0) < 1e-3  # self-pair is a perfect match
+    assert scores[0] < scores[-1]
+
+
+def test_prompt_library_shapes():
+    from pathway_tpu.xpacks.llm import prompts
+
+    p = prompts.prompt_qa("what is x?", ("doc a", "doc b"))
+    # prompt builders return column expressions over literals; evaluate
+    t = pw.debug.table_from_rows(pw.schema_from_types(marker=int), [(1,)])
+    res = t.select(p=p)
+    (cap,) = run_tables(res)
+    ((text,),) = cap.state.rows.values()
+    assert "what is x?" in text and "doc a" in text
+
+    tpl = prompts.RAGPromptTemplate(
+        template="Q: {query} C: {context}"
+    )
+    assert tpl.format(query="q1", context="c1") == "Q: q1 C: c1"

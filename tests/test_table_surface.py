@@ -48,7 +48,7 @@ def test_copy_preserves_rows_and_keys():
 def test_slice_and_column_namespace():
     t = _t()
     sl = t.slice[["a"]]
-    assert list(sl) if not hasattr(sl, "select") else True
+    assert [c.name if hasattr(c, "name") else c for c in sl] == ["a"]
     assert _rows(t.select(via_c=t.C.a)) == [(1,), (2,)]
 
 
