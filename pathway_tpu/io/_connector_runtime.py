@@ -446,7 +446,10 @@ class StreamingDriver:
         multiworker = self.engine.worker_count > 1
         done = False
         # per-live commit bookkeeping: how much of `pending` the subject
-        # has committed (flushable), and whether it ever commits at all
+        # has committed (flushable), and whether it ever commits at all.
+        # The committed-prefix gating only matters when a persisted cursor
+        # must stay consistent with the logged batch.
+        gate_commits = self.persistence_config is not None
         committed_upto: Dict[LiveSource, int] = {}
         ever_committed: set = set()
 
@@ -459,11 +462,9 @@ class StreamingDriver:
             nonlocal time, last_flush, last_snapshot, done
             nonlocal dirty_since_snapshot
             has_data = any(
-                bool(
-                    d[: committed_upto.get(live, 0)]
-                    if live in ever_committed
-                    else d
-                )
+                (committed_upto.get(live, 0) > 0 or not gate_commits
+                 or live not in ever_committed)
+                and bool(d)
                 for live, d in pending.items()
             )
             local_done = active <= 0 and not has_data
@@ -495,7 +496,9 @@ class StreamingDriver:
                     # cursor state; the uncommitted tail waits for its own
                     # commit. Sources that never commit (autocommit-only)
                     # flush everything with the counter cursor, as before.
-                    if live in ever_committed:
+                    # Without persistence there is no cursor to keep
+                    # consistent, so nothing is ever withheld.
+                    if gate_commits and live in ever_committed:
                         cut = committed_upto.get(live, 0)
                         batch, tail = deltas[:cut], deltas[cut:]
                         pending[live] = tail

@@ -238,3 +238,49 @@ def test_chat_model_from_llama_checkpoint_dir(tmp_path):
 
     out = chat.generate(["the quick brown"], max_new_tokens=4)
     assert len(out) == 1 and isinstance(out[0], str)
+
+
+def test_greedy_generation_matches_torch_llama(tmp_path):
+    """Greedy decode with the KV-cached scan must produce the same token
+    ids as transformers' generate() on the same checkpoint."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from pathway_tpu.models.decoder import generate_tokens
+    from pathway_tpu.models.hf_loader import load_hf_decoder
+
+    cfg = LlamaConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(7)
+    model = LlamaForCausalLM(cfg).eval()
+    path = str(tmp_path / "llama_gen_ckpt")
+    model.save_pretrained(path)
+    config, params = load_hf_decoder(path, dtype="float32")
+
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(1, 128, size=(1, 6)).astype(np.int32)
+    mask = np.ones_like(prompt)
+
+    ours = np.asarray(
+        generate_tokens(
+            params, config, prompt, mask, max_new_tokens=6, temperature=0.0
+        )
+    )[0]
+
+    with torch.no_grad():
+        golden = model.generate(
+            input_ids=torch.tensor(prompt.astype(np.int64)),
+            attention_mask=torch.tensor(mask.astype(np.int64)),
+            max_new_tokens=6,
+            do_sample=False,
+            pad_token_id=0,
+        )[0, 6:].numpy()
+
+    np.testing.assert_array_equal(ours[: len(golden)], golden)

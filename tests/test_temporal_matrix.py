@@ -305,3 +305,74 @@ def test_inactivity_detection_flags_stale_stream():
     )
     pw.run()
     assert alerts and alerts[0] == stale  # inactive since the last event
+
+
+def test_behavior_matrix_on_sliding_windows():
+    """common_behavior (delay/cutoff/keep_results) across sliding windows —
+    the reference tests behaviors per window type (tests/temporal)."""
+    t = pw.debug.table_from_markdown(
+        """
+        t  | v | __time__
+        1  | 1 | 2
+        3  | 2 | 4
+        1  | 7 | 20
+        """
+    )
+    # cutoff 5: by the time the late row (t=1 at engine time 20) arrives,
+    # the stream clock (max t seen = 3) has NOT passed 1+5, so it applies
+    res = temporal.windowby(
+        t,
+        t.t,
+        window=temporal.sliding(hop=2, duration=4),
+        behavior=temporal.common_behavior(cutoff=5),
+    ).reduce(
+        start=pw.this._pw_window_start, s=pw.reducers.sum(pw.this.v)
+    )
+    stream, final = _stream(res)
+    totals = {start: s for start, s in final}
+    assert totals[0] == 10  # 1 + 2 + late 7
+
+
+def test_exactly_once_behavior_on_session_windows():
+    t = pw.debug.table_from_markdown(
+        """
+        t  | v | __time__
+        1  | 1 | 2
+        2  | 2 | 2
+        50 | 9 | 4
+        """
+    )
+    res = temporal.windowby(
+        t,
+        t.t,
+        window=temporal.session(max_gap=3),
+        behavior=temporal.exactly_once_behavior(),
+    ).reduce(total=pw.reducers.sum(pw.this.v))
+    stream, final = _stream(res)
+    # first session emitted once when the clock passed its close
+    session1_events = [d for _t, d in stream if d[1][0] == 3]
+    assert len(session1_events) == 1 and session1_events[0][2] == 1
+
+
+def test_interval_join_temporal_behavior_cleanup():
+    """interval joins keep bounded state; verify correctness of results
+    over a long stream (the buffers must not change outcomes)."""
+    left = pw.debug.table_from_markdown(
+        """
+        lt | lv | __time__
+        0  | a  | 2
+        50 | b  | 4
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        rt | rv | __time__
+        1  | x  | 2
+        51 | y  | 6
+        """
+    )
+    j = temporal.interval_join(
+        left, right, left.lt, right.rt, temporal.interval(-2, 2)
+    ).select(lv=pw.left.lv, rv=pw.right.rv)
+    stream, final = _stream(j)
+    assert final == [("a", "x"), ("b", "y")]
