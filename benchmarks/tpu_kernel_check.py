@@ -32,7 +32,14 @@ def check_flash() -> dict:
         ref = np.asarray(
             _reference_attention(q, k, v, mask, 1.0 / np.sqrt(D), causal)
         )
-        err = float(np.max(np.abs(out[:, :, :400] - ref[:, :, :400])))
+        # batch 0 is fully valid: compare ALL query rows (late-block
+        # lowering bugs must not hide); batch 1 compares its valid prefix
+        err = float(
+            max(
+                np.max(np.abs(out[0] - ref[0])),
+                np.max(np.abs(out[1, :, :400] - ref[1, :, :400])),
+            )
+        )
         assert err < 2e-2, err
         errs[f"causal={causal}"] = round(err, 6)
     return {"kernel": "flash_attention", "ok": True, "max_err": errs}

@@ -197,6 +197,11 @@ class ConnectorSubjectBase:
         self._sink.push_row(row, diff=-1)
 
     def commit(self) -> None:
+        """Mark a consistent point in the stream. With persistence, a
+        commit seals the batch + cursor that recovery replays. Without
+        persistence it is a flush hint only: under load the driver may
+        coalesce rows from after a commit into the same engine minibatch
+        (server-side micro-batching)."""
         self._sink.commit()
 
     def close(self) -> None:

@@ -538,3 +538,57 @@ def test_prompt_library_shapes():
         template="Q: {query} C: {context}"
     )
     assert tpl.format(query="q1", context="c1") == "Q: q1 C: c1"
+
+
+def test_rag_summarize_query_and_context_docs():
+    """summarize endpoint + answer with return_context_docs (reference:
+    question_answering.py BaseRAGQuestionAnswerer summarize/answer)."""
+    from pathway_tpu.xpacks.llm.question_answering import (
+        BaseRAGQuestionAnswerer,
+    )
+
+    store = _store()
+    rag = BaseRAGQuestionAnswerer(
+        llm=FakeChatModel(lambda messages: "summary: ok"),
+        indexer=store,
+    )
+
+    sq = pw.debug.table_from_rows(
+        rag.SummarizeQuerySchema,
+        [(pw.Json(["text a", "text b"]), None)],
+    )
+    res = rag.summarize_query(sq)
+    (cap,) = run_tables(res)
+    ((summary,),) = cap.state.rows.values()
+    assert "summary" in str(summary)
+
+    pw.G.clear()
+    store2 = _store()
+    rag2 = BaseRAGQuestionAnswerer(
+        llm=FakeChatModel(lambda messages: "the answer"),
+        indexer=store2,
+    )
+    aq = pw.debug.table_from_rows(
+        rag2.AnswerQuerySchema,
+        [("apple tart", None, None, None, "gpt-fake", True)],
+    )
+    res2 = rag2.answer_query(aq)
+    (cap2,) = run_tables(res2)
+    ((packed,),) = cap2.state.rows.values()
+    payload = packed.value if isinstance(packed, pw.Json) else packed
+    assert "the answer" in str(payload)
+    assert "context_docs" in str(payload) or "apple" in str(payload)
+
+
+def test_vector_store_server_class_surface():
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+    docs = _docs_table()
+    server = VectorStoreServer(docs, embedder=FakeEmbedder())
+    queries = pw.debug.table_from_rows(
+        DocumentStore.RetrieveQuerySchema, [("apple tart", 1, None, None)]
+    )
+    res = server.document_store.retrieve_query(queries)
+    (cap,) = run_tables(res)
+    ((result,),) = cap.state.rows.values()
+    assert "apple" in str(result)
