@@ -72,6 +72,21 @@ class SentenceEncoder:
         ids, mask = encode_batch(
             self.tokenizer, list(texts), max_len=self.max_len
         )
+        if self.mesh is not None:
+            # data-parallel dispatch: the (bucketed, power-of-two) batch
+            # axis shards over the mesh's 'dp'/first axis — XLA splits the
+            # encoder across devices with no code change (scaling-book
+            # recipe: annotate shardings, let the compiler place the rest)
+            import jax
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            axis = "dp" if "dp" in self.mesh.axis_names else self.mesh.axis_names[0]
+            n_dev = self.mesh.shape[axis]
+            if ids.shape[0] % n_dev == 0:
+                sharding = NamedSharding(self.mesh, P(axis, None))
+                ids = jax.device_put(ids, sharding)
+                mask = jax.device_put(mask, sharding)
         pooled = self.lm(ids, mask)
         return np.asarray(pooled)[: len(texts)]
 

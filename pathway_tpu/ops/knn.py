@@ -176,7 +176,15 @@ class DeviceKnnIndex:
         self.mesh = mesh
         min_cap = 8
         if mesh is not None:
-            min_cap = max(min_cap, 2 * mesh.shape[mesh.axis_names[0]])
+            n_dev = mesh.shape[mesh.axis_names[0]]
+            if n_dev & (n_dev - 1):
+                raise ValueError(
+                    f"DeviceKnnIndex mesh axis {mesh.axis_names[0]!r} has "
+                    f"{n_dev} devices; a power of two is required (the "
+                    "index buffer is bucketed to power-of-two capacities "
+                    "and shards evenly only then)"
+                )
+            min_cap = max(min_cap, 2 * n_dev)
         self.capacity = _next_bucket(max(reserved_space, min_cap))
         self._buffer = jnp.zeros((self.capacity, self.d), dtype=jnp.float32)
         self._valid_dev = jnp.zeros((self.capacity,), dtype=bool)
@@ -366,7 +374,7 @@ class DeviceKnnIndex:
 
 
 @functools.lru_cache(maxsize=None)
-def _compiled_fused_search(config, metric: str, k: int):
+def _compiled_fused_search(config, metric: str, k: int, mesh=None, n_rows: int = 0):
     import jax
     import jax.numpy as jnp
 
@@ -378,8 +386,15 @@ def _compiled_fused_search(config, metric: str, k: int):
         # batch, which matters when the chip is a network hop away
         ids, mask = ids_mask[0], ids_mask[1]
         emb = forward(params, config, ids, mask)
-        scores = _similarity(buffer, valid, emb, metric)
-        top_scores, top_idx = jax.lax.top_k(scores, k)
+        if mesh is not None:
+            # per-shard top-k + [Q, k] all-gather merge over the sharded
+            # buffer (NOT a full-buffer gather), still inside this one jit
+            top_scores, top_idx = _sharded_search_body(
+                mesh, n_rows, k, metric
+            )(buffer, valid, emb)
+        else:
+            scores = _similarity(buffer, valid, emb, metric)
+            top_scores, top_idx = jax.lax.top_k(scores, k)
         return jnp.concatenate(
             [top_scores, top_idx.astype(jnp.float32)], axis=1
         )
@@ -399,11 +414,15 @@ class FusedEmbedSearch:
         self.index = index
 
     def _fn(self, k: int):
-        # process-global cache keyed on (config, metric, k): a fresh
-        # FusedEmbedSearch (e.g. a rebuilt DocumentStore) reuses the already
-        # compiled executable instead of retracing per instance
+        # process-global cache keyed on (config, metric, k[, mesh]): a
+        # fresh FusedEmbedSearch (e.g. a rebuilt DocumentStore) reuses the
+        # already compiled executable instead of retracing per instance
         return _compiled_fused_search(
-            self.encoder.config, self.index.metric, k
+            self.encoder.config,
+            self.index.metric,
+            k,
+            mesh=self.index.mesh,
+            n_rows=self.index.capacity if self.index.mesh is not None else 0,
         )
 
     def embed_and_add(self, keys, texts) -> None:
@@ -440,11 +459,9 @@ class FusedEmbedSearch:
         return _format_rows(scores, idx, self.index._key_of_slot)
 
 
-@functools.lru_cache(maxsize=None)
-def _compiled_sharded_search(mesh, n_rows: int, k: int, metric: str):
-    """Compile-once per (mesh, capacity, k, metric): the serving hot path
-    calls this per query batch and must hit jit's trace cache, exactly
-    like the dense `_compiled_search`."""
+def _sharded_search_body(mesh, n_rows: int, k: int, metric: str):
+    """shard_map'd per-shard top-k + all-gather merge; composable inside
+    a larger jit (the fused embed+search path) or jitted standalone."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -482,14 +499,23 @@ def _compiled_sharded_search(mesh, n_rows: int, k: int, metric: str):
         merged_idx = jnp.take_along_axis(all_idx, merged_pos, axis=1)
         return merged_scores, merged_idx
 
-    fn = shard_map(
+    return shard_map(
         local_search,
         mesh=mesh,
         in_specs=(P(axis, None), P(axis), P(None, None)),
         out_specs=(P(None, None), P(None, None)),
         **_rep_kwargs,
     )
-    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_sharded_search(mesh, n_rows: int, k: int, metric: str):
+    """Compile-once per (mesh, capacity, k, metric): the serving hot path
+    calls this per query batch and must hit jit's trace cache, exactly
+    like the dense `_compiled_search`."""
+    import jax
+
+    return jax.jit(_sharded_search_body(mesh, n_rows, k, metric))
 
 
 def sharded_knn_search(mesh, index, valid, queries, k: int, metric: str = "cos"):

@@ -34,9 +34,9 @@ class USearchMetricKind(enum.Enum):
 
 
 class _KnnIndexImpl(IndexImpl):
-    def __init__(self, dimensions: int, metric: str, reserved_space: int):
+    def __init__(self, dimensions: int, metric: str, reserved_space: int, mesh=None):
         self.knn = DeviceKnnIndex(
-            dimensions, metric=metric, reserved_space=reserved_space
+            dimensions, metric=metric, reserved_space=reserved_space, mesh=mesh
         )
         self.metadata: dict = {}
 
@@ -86,11 +86,12 @@ class _FusedKnnIndexImpl(IndexImpl):
     embeddings are computed and scattered into the device index without ever
     leaving HBM. This is the framework wiring of SURVEY §3.4's hot path."""
 
-    def __init__(self, encoder, metric: str, reserved_space: int):
+    def __init__(self, encoder, metric: str, reserved_space: int, mesh=None):
         from pathway_tpu.ops.knn import DeviceKnnIndex, FusedEmbedSearch
 
         self.knn = DeviceKnnIndex(
-            encoder.dimension, metric=metric, reserved_space=reserved_space
+            encoder.dimension, metric=metric, reserved_space=reserved_space,
+            mesh=mesh,
         )
         self.fused = FusedEmbedSearch(encoder, self.knn)
         self.metadata: dict = {}
@@ -162,21 +163,27 @@ class BruteForceKnn(InnerIndex):
         reserved_space: int = 512,
         metric: BruteForceKnnMetricKind = BruteForceKnnMetricKind.COS,
         embedder=None,
+        mesh=None,
     ):
         super().__init__(data_column, metadata_column)
         self.dimensions = dimensions
         self.reserved_space = reserved_space
         self.metric = metric
         self.embedder = embedder
+        # mesh: shard the device index over the mesh's first axis
+        # (sharded_knn_search); None = single-device buffer
+        self.mesh = mesh
 
     def _make_impl(self) -> IndexImpl:
         encoder = _local_jax_encoder(self.embedder)
         if encoder is not None:
             return _FusedKnnIndexImpl(
-                encoder, self.metric.value, self.reserved_space
+                encoder, self.metric.value, self.reserved_space,
+                mesh=self.mesh,
             )
         return _KnnIndexImpl(
-            self.dimensions, self.metric.value, self.reserved_space
+            self.dimensions, self.metric.value, self.reserved_space,
+            mesh=self.mesh,
         )
 
     def _query_preprocess(self, query_column):
@@ -353,6 +360,7 @@ class BruteForceKnnFactory:
     reserved_space: int = 512
     metric: BruteForceKnnMetricKind = BruteForceKnnMetricKind.COS
     embedder: Any = None
+    mesh: Any = None
 
     def build_inner_index(self, data_column, metadata_column=None) -> InnerIndex:
         dimensions = self.dimensions
@@ -365,6 +373,7 @@ class BruteForceKnnFactory:
             reserved_space=self.reserved_space,
             metric=self.metric,
             embedder=self.embedder,
+            mesh=self.mesh,
         )
 
     def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
