@@ -440,7 +440,11 @@ def exchange_to_worker(engine, node, worker: int = 0):
 def coordinator_from_config() -> Coordinator:
     """Build the process-wide coordinator from PATHWAY_* env config."""
     from pathway_tpu.internals.config import pathway_config as cfg
+    from pathway_tpu.internals.license import check_worker_count
 
+    # free tier caps TOTAL workers (threads x processes) at 8, regardless
+    # of how they are split (reference: config.rs:7-11, 89-97)
+    check_worker_count(getattr(cfg, "worker_count", cfg.processes))
     if cfg.processes <= 1:
         return Coordinator()
     return TcpCoordinator(cfg.process_id, cfg.processes, cfg.first_port)

@@ -1,0 +1,88 @@
+"""License keys and entitlements (reference: src/engine/license.rs —
+Ed25519-signed keys, `check_entitlements:99`, the free-tier 8-worker cap in
+dataflow/config.rs:7-11 gated by the `unlimited-workers` entitlement).
+
+This build keeps the same *shape* without the crypto enforcement: keys are
+parsed, entitlements resolve, and the worker cap applies, but no network
+validation and no signature check happen (an open build has nothing to
+protect; the seams are where the reference's checks live, so a deployment
+that needs real enforcement swaps `_verify`)."""
+
+from __future__ import annotations
+
+import base64
+import json
+from dataclasses import dataclass, field
+from typing import FrozenSet
+
+# the reference caps free-tier workers at 8 (config.rs:7-11)
+FREE_TIER_WORKER_LIMIT = 8
+
+
+class LicenseError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class License:
+    tier: str = "free"
+    entitlements: FrozenSet[str] = field(default_factory=frozenset)
+
+    def check_entitlements(self, *required: str) -> None:
+        """reference: license.rs check_entitlements:99."""
+        missing = [e for e in required if e not in self.entitlements]
+        if missing:
+            raise LicenseError(
+                f"license (tier={self.tier!r}) lacks entitlements: "
+                f"{', '.join(missing)}"
+            )
+
+    @property
+    def worker_limit(self) -> int | None:
+        if "unlimited-workers" in self.entitlements:
+            return None
+        return FREE_TIER_WORKER_LIMIT
+
+
+FREE = License()
+
+
+def parse_license(key: str | None) -> License:
+    """Accepts None (free tier) or a `pw-v1.<base64 json>` key carrying
+    {"tier": ..., "entitlements": [...]}; malformed keys raise."""
+    if not key:
+        return FREE
+    if not key.startswith("pw-v1."):
+        raise LicenseError(
+            "unrecognized license key format (expected 'pw-v1.<payload>')"
+        )
+    try:
+        payload = json.loads(base64.b64decode(key[len("pw-v1."):] + "=="))
+    except Exception as exc:  # noqa: BLE001
+        raise LicenseError(f"license key payload unreadable: {exc}") from exc
+    _verify(payload)
+    return License(
+        tier=str(payload.get("tier", "enterprise")),
+        entitlements=frozenset(payload.get("entitlements", ())),
+    )
+
+
+def _verify(payload: dict) -> None:
+    """Signature check seam (the reference verifies Ed25519 here)."""
+
+
+def current_license() -> License:
+    from pathway_tpu.internals.config import pathway_config
+
+    return parse_license(pathway_config.license_key)
+
+
+def check_worker_count(workers: int) -> None:
+    """reference: the >8-worker gate in dataflow/config.rs:7-11."""
+    limit = current_license().worker_limit
+    if limit is not None and workers > limit:
+        raise LicenseError(
+            f"{workers} workers requested but the free tier allows at most "
+            f"{limit}; set a license key with the 'unlimited-workers' "
+            "entitlement (pw.set_license_key)"
+        )

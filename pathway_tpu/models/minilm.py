@@ -53,6 +53,15 @@ class SentenceEncoder:
             vocab_size=self.config.vocab_size
         )
         self.lm = TransformerLM(self.config, params=params, seed=seed)
+        if mesh is not None:
+            axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
+            n_dev = mesh.shape[axis]
+            if n_dev & (n_dev - 1):
+                raise ValueError(
+                    f"SentenceEncoder mesh axis {axis!r} has {n_dev} "
+                    "devices; a power of two is required (batches bucket "
+                    "to powers of two and would never shard evenly)"
+                )
         self.mesh = mesh
 
     @classmethod

@@ -135,3 +135,42 @@ def test_device_knn_mesh_sharded_search_matches_dense():
     sharded.remove(top_key)
     rows_after = sharded.search_keys(queries[:1], 4)
     assert top_key not in [k for k, _ in rows_after[0]]
+
+
+def test_fused_embed_search_mesh_matches_single_device():
+    """The fused tokenize->embed->search executable with a sharded buffer
+    (shard_map merge inside the jit) must return the same neighbors as the
+    unsharded fused path."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from pathway_tpu.models.minilm import SentenceEncoder
+    from pathway_tpu.models.transformer import TransformerConfig
+    from pathway_tpu.ops.knn import DeviceKnnIndex, FusedEmbedSearch
+
+    tiny = TransformerConfig(
+        vocab_size=256, hidden=32, layers=1, heads=2, mlp_dim=64,
+        max_len=32, dtype="float32",
+    )
+    enc = SentenceEncoder("fused-mesh-test", config=tiny, max_len=16, seed=9)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("knn",))
+
+    docs = [f"document body {i}" for i in range(32)]
+    plain = FusedEmbedSearch(
+        enc, DeviceKnnIndex(enc.dimension, reserved_space=64)
+    )
+    sharded = FusedEmbedSearch(
+        enc, DeviceKnnIndex(enc.dimension, reserved_space=64, mesh=mesh)
+    )
+    plain.embed_and_add(range(32), docs)
+    sharded.embed_and_add(range(32), docs)
+
+    queries = [docs[5], docs[21], "something else entirely"]
+    rows_plain = plain.search_texts(queries, 3)
+    rows_sharded = sharded.search_texts(queries, 3)
+    for rp, rs in zip(rows_plain, rows_sharded):
+        assert [k for k, _ in rp] == [k for k, _ in rs]
+        np.testing.assert_allclose(
+            [s for _, s in rp], [s for _, s in rs], rtol=1e-4, atol=1e-5
+        )

@@ -307,3 +307,47 @@ def test_sql_aggregates_and_having():
 
     (cap,) = run_tables(res)
     assert sorted(cap.state.rows.values()) == [("a", 4, 2, 2.0), ("b", 10, 1, 10.0)]
+
+
+def test_license_entitlements_and_worker_cap():
+    """License parsing, entitlement checks, the free-tier 8-worker gate
+    (reference: src/engine/license.rs:99, dataflow/config.rs:7-11)."""
+    import base64
+    import json as json_mod
+
+    from pathway_tpu.internals.license import (
+        FREE_TIER_WORKER_LIMIT,
+        LicenseError,
+        check_worker_count,
+        parse_license,
+    )
+
+    free = parse_license(None)
+    assert free.worker_limit == FREE_TIER_WORKER_LIMIT
+    with pytest.raises(LicenseError, match="entitlements"):
+        free.check_entitlements("xpack-sharepoint")
+
+    payload = base64.b64encode(
+        json_mod.dumps(
+            {"tier": "enterprise", "entitlements": ["unlimited-workers"]}
+        ).encode()
+    ).decode()
+    ent = parse_license("pw-v1." + payload)
+    assert ent.worker_limit is None
+    ent.check_entitlements("unlimited-workers")
+
+    with pytest.raises(LicenseError, match="format"):
+        parse_license("not-a-key")
+
+    # the gate reads the configured key
+    import pathway_tpu as pw
+
+    pw.set_license_key(None)
+    with pytest.raises(LicenseError, match="free tier"):
+        check_worker_count(16)
+    check_worker_count(8)  # at the limit is fine
+    pw.set_license_key("pw-v1." + payload)
+    try:
+        check_worker_count(64)  # unlimited with the entitlement
+    finally:
+        pw.set_license_key(None)
