@@ -139,19 +139,18 @@ class RecursiveSplitter(BaseSplitter):
                         current = part
             if current.strip():
                 chunks.append(current)
-            if self.chunk_overlap > 0 and len(chunks) > 1:
-                overlapped = [chunks[0]]
-                for prev, cur in zip(chunks, chunks[1:]):
-                    tail = prev[-self.chunk_overlap :]
-                    overlapped.append(tail + cur)
-                chunks = overlapped
             return chunks
 
         def split(text: str, metadata) -> list:
             meta = _meta(metadata)
-            return [
-                (chunk, dict(meta))
-                for chunk in split_recursive(text, self.separators)
-            ]
+            chunks = split_recursive(text, self.separators)
+            # overlap applies ONCE over the final chunk list (inside the
+            # recursion it compounds tails across levels)
+            if self.chunk_overlap > 0 and len(chunks) > 1:
+                chunks = [chunks[0]] + [
+                    prev[-self.chunk_overlap :] + cur
+                    for prev, cur in zip(chunks, chunks[1:])
+                ]
+            return [(chunk, dict(meta)) for chunk in chunks]
 
         self.func = split
