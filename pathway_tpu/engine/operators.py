@@ -621,14 +621,17 @@ class UpdateRowsNode(Node):
 class FlattenNode(Node):
     """flatten a sequence column into one row per element (reference:
     flatten_table, graph.rs). Element keys derive deterministically from
-    (parent key, position) by multiplicative mixing — unique, stable, and
-    ~50x cheaper than a cryptographic hash on the bulk-ingest path; they
-    never collide with the parent key (position offsets by 1)."""
+    (parent key, position) via an xor-multiply-shift finalizer — non-linear,
+    so numerically adjacent parent keys cannot alias (key_a + i_a == key_b +
+    i_b no longer collides), stable across workers/restarts, and still much
+    cheaper than a cryptographic hash on the bulk-ingest path."""
 
     name = "flatten"
 
-    # odd 128-bit mix constant (golden-ratio style) — invertible mod 2^128
+    # odd 128-bit mix constants (golden-ratio style)
     _MIX = 0x9E3779B97F4A7C15F39CC0605CEDC835
+    _MIX2 = 0xC6A4A7935BD1E995C2B2AE3D27D4EB4F
+    _MASK = (1 << 128) - 1
 
     def __init__(self, engine: Engine, input_: Node, flat_idx: int):
         super().__init__(engine, [input_])
@@ -636,7 +639,16 @@ class FlattenNode(Node):
 
     @classmethod
     def _derive_key(cls, key: Pointer, i: int) -> Pointer:
-        return Pointer(((key.value + i + 1) * cls._MIX) & ((1 << 128) - 1))
+        # splitmix-style 128-bit finalizer over (key, position): xor then
+        # multiply then xor-shift twice.  The xor/shift steps break the
+        # additive structure a bare multiply preserves.
+        x = (key.value ^ ((i + 1) * cls._MIX2)) & cls._MASK
+        x ^= x >> 67
+        x = (x * cls._MIX) & cls._MASK
+        x ^= x >> 64
+        x = (x * cls._MIX2) & cls._MASK
+        x ^= x >> 67
+        return Pointer(x)
 
     def process(self, time: int) -> None:
         deltas = self.take(0)
@@ -805,7 +817,7 @@ class GradualBroadcastNode(Node):
 
     name = "gradual_broadcast"
 
-    snapshot_attrs = ("rows", "threshold", "cache")
+    snapshot_attrs = ("rows", "threshold_rows", "threshold", "cache")
 
     def __init__(
         self,
@@ -827,6 +839,7 @@ class GradualBroadcastNode(Node):
         self.value_prog = value_prog
         self.upper_prog = upper_prog
         self.rows: Dict[Pointer, tuple] = {}
+        self.threshold_rows: Dict[Pointer, tuple] = {}
         self.threshold: tuple | None = None
         self.cache = _DiffCache()
 
@@ -854,15 +867,30 @@ class GradualBroadcastNode(Node):
         if not data_deltas and not thr_deltas:
             return
         out: List[Delta] = []
+        changed_threshold = False
         if thr_deltas:
-            keys = [d[0] for d in thr_deltas if d[2] > 0]
-            rows = ([d[1] for d in thr_deltas if d[2] > 0],)
-            if keys:
+            # maintain the threshold table as keyed state so the result is
+            # independent of delta order within a batch, and a
+            # retraction-only update clears/recomputes the threshold
+            for key, row, diff in thr_deltas:
+                if diff > 0:
+                    self.threshold_rows[key] = row
+                else:
+                    self.threshold_rows.pop(key, None)
+            old = self.threshold
+            if self.threshold_rows:
+                # deterministic choice among survivors: max key (latest-ish,
+                # stable regardless of arrival order)
+                pick = max(self.threshold_rows)
+                keys = [pick]
+                rows = ([self.threshold_rows[pick]],)
                 lowers = self.lower_prog(keys, rows)
                 values = self.value_prog(keys, rows)
                 uppers = self.upper_prog(keys, rows)
                 self.threshold = (lowers[-1], values[-1], uppers[-1])
-        changed_threshold = bool(thr_deltas)
+            else:
+                self.threshold = None
+            changed_threshold = self.threshold != old
         for key, row, diff in data_deltas:
             if diff > 0:
                 self.rows[key] = row

@@ -10,6 +10,7 @@ connector image via docker; tests inject a runner emitting protocol lines.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
 import subprocess
@@ -181,8 +182,18 @@ _CLOUD_RUN_WRAPPER = (
     'echo $AIRBYTE_STATE_B64 | base64 -d > /tmp/state.json; '
     'STATE_ARGS="--state /tmp/state.json"; fi; '
     '$AIRBYTE_ENTRYPOINT read --config /tmp/config.json '
-    '--catalog /tmp/catalog.json $STATE_ARGS'
+    '--catalog /tmp/catalog.json $STATE_ARGS; '
+    # terminal sentinel: Cloud Logging ingestion is eventually consistent,
+    # so the reader polls until it sees this line (or times out) before
+    # trusting that the tail of the protocol stream has landed.  Preserve
+    # the connector's exit status so a crashed connector still fails the
+    # job (and `--wait` still raises) instead of echo masking it with 0.
+    'rc=$?; echo PATHWAY_AIRBYTE_SYNC_DONE; exit $rc'
 )
+
+# how long to keep polling Cloud Logging for the sync's tail to land
+_LOG_POLL_TIMEOUT_S = 120.0
+_LOG_POLL_INTERVAL_S = 3.0
 
 
 class CloudRunAirbyteSource(AirbyteSourceRunner):
@@ -202,9 +213,14 @@ class CloudRunAirbyteSource(AirbyteSourceRunner):
         region: str = "europe-west1",
         job_name: str | None = None,
         env_vars: dict | None = None,
+        log_poll_timeout: float = _LOG_POLL_TIMEOUT_S,
+        log_poll_interval: float = _LOG_POLL_INTERVAL_S,
         _execute=None,
     ):
         import uuid
+
+        self.log_poll_timeout = log_poll_timeout
+        self.log_poll_interval = log_poll_interval
 
         self.image = image
         self.config = config
@@ -252,15 +268,58 @@ class CloudRunAirbyteSource(AirbyteSourceRunner):
                 "--format", "value(metadata.name)",
             ]
         ).strip()
-        logs = self._exec(
-            [
-                "gcloud", "logging", "read",
-                'resource.type="cloud_run_job" AND '
-                f'labels."run.googleapis.com/execution_name"="{execution}"',
-                "--format", "value(textPayload)",
-                "--order", "asc",
-            ]
+        exec_filter = (
+            'resource.type="cloud_run_job" AND '
+            f'labels."run.googleapis.com/execution_name"="{execution}"'
         )
+        sentinel_cmd = [
+            "gcloud", "logging", "read",
+            exec_filter + ' AND textPayload="PATHWAY_AIRBYTE_SYNC_DONE"',
+            "--format", "value(textPayload)",
+            "--limit", "1",
+        ]
+        read_cmd = [
+            "gcloud", "logging", "read",
+            exec_filter,
+            "--format", "value(textPayload)",
+            "--order", "asc",
+        ]
+        # `jobs execute --wait` returning does NOT mean the logs have been
+        # ingested: Cloud Logging lags by seconds, and a missing final
+        # STATE message silently causes re-reads or gaps on the next
+        # incremental sync.  Phase 1: poll a cheap sentinel-only query (so
+        # large syncs are not re-downloaded every 3s) until the wrapper's
+        # terminal line is ingested or we time out.
+        deadline = time_mod.monotonic() + self.log_poll_timeout
+        while (
+            "PATHWAY_AIRBYTE_SYNC_DONE" not in self._exec(sentinel_cmd)
+            and time_mod.monotonic() < deadline
+        ):
+            time_mod.sleep(self.log_poll_interval)
+        # Phase 2: full ordered read.  Cloud Logging does not guarantee
+        # cross-entry ingestion order, so the sentinel landing first does
+        # not mean the tail did — re-read until the line count is stable
+        # across two consecutive reads (still bounded by the deadline).
+        logs = self._exec(read_cmd)
+        while time_mod.monotonic() < deadline:
+            time_mod.sleep(self.log_poll_interval)
+            again = self._exec(read_cmd)
+            if again.count("\n") == logs.count("\n"):
+                logs = again
+                break
+            logs = again
+        if "PATHWAY_AIRBYTE_SYNC_DONE" not in logs:
+            # settle for what has landed, but loudly: a missing tail can
+            # drop the final STATE message and cause re-reads/gaps on the
+            # next incremental sync
+            logging.getLogger(__name__).warning(
+                "airbyte cloud-run sync %s: log stream still incomplete "
+                "after %.0fs of polling; the final STATE message may be "
+                "missing and the next incremental sync may re-read or "
+                "skip records",
+                execution,
+                self.log_poll_timeout,
+            )
         yield from self._parse_protocol(logs.splitlines())
 
     def cleanup(self) -> None:

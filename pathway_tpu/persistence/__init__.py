@@ -14,8 +14,28 @@ from __future__ import annotations
 
 import os
 import pickle
+import re
 import threading
 from typing import Any, Dict, List, Optional, Tuple
+
+
+# Bump whenever the meaning of persisted state changes — key derivation
+# schemes, delta encodings, snapshot layouts.  Restores from a different
+# version fall back to full replay instead of silently mixing old keys
+# with new derivation (v2: FlattenNode key finalizer changed).
+SNAPSHOT_FORMAT_VERSION = 2
+
+
+def graph_fingerprint(engine) -> List[Tuple[int, str, str, int]]:
+    """Stable per-node identity: (position, class name, operator name,
+    input arity) for every engine node.  Restoring pickled operator state
+    by index is only safe when the whole sequence matches — a changed
+    filter predicate or two reordered operators keep the node COUNT equal
+    while shifting what each index means."""
+    return [
+        (idx, type(node).__name__, getattr(node, "name", ""), len(node.inputs))
+        for idx, node in enumerate(engine.nodes)
+    ]
 
 
 class PersistenceBackend:
@@ -424,7 +444,9 @@ class OperatorSnapshotManager:
                 {
                     "time": time,
                     "epoch": epoch,
+                    "format_version": SNAPSHOT_FORMAT_VERSION,
                     "node_count": len(engine.nodes),
+                    "graph_fingerprint": graph_fingerprint(engine),
                     "state_nodes": [idx for idx, _ in states],
                     "folded_through": folded_through,
                 }
@@ -455,7 +477,19 @@ class OperatorSnapshotManager:
     def load_states(self, engine, manifest: dict) -> Dict[int, dict] | None:
         """Phase 1: read + unpickle every state blob WITHOUT touching the
         engine. None = unusable (graph changed / blob missing / corrupt)."""
+        # a snapshot written under another format version (or before
+        # versioning existed) may encode keys/state the current code
+        # derives differently — full replay is the only safe restore
+        if manifest.get("format_version") != SNAPSHOT_FORMAT_VERSION:
+            return None
         if manifest.get("node_count") != len(engine.nodes):
+            return None
+        # same node COUNT is not the same GRAPH: a changed predicate or a
+        # reordered pair of operators would restore state into the wrong
+        # nodes by index.  Refuse on any per-node fingerprint mismatch so
+        # the caller falls back to consolidated-base full replay (the
+        # reference keys snapshots by stable persistent operator ids).
+        if manifest.get("graph_fingerprint") != graph_fingerprint(engine):
             return None
         epoch = manifest.get("epoch", manifest.get("time"))
         states: Dict[int, dict] = {}
@@ -524,15 +558,21 @@ class InputSnapshotWriter:
     def _segment_key(self, seg: int) -> str:
         return f"{self.prefix}/events.{seg:08d}"
 
+    _SEGMENT_RE = re.compile(r"events\.(\d{8})(?:$|/)")
+
     def list_segments(self) -> List[int]:
+        # Extract the segment id from the `events.<seg>` path component
+        # itself.  ObjectStoreBackend emulates append by storing chunks
+        # under `<key>/log.<n>`, so the final dot-suffix of a listed key is
+        # the CHUNK number, not the segment number — splitting on the last
+        # '.' would invent phantom segments there.
         out = []
         marker = self.prefix.replace("/", "__") + "__events."
         for key in self.backend.list_keys():
             if marker in key.replace("/", "__"):
-                try:
-                    out.append(int(key.rsplit(".", 1)[1][:8]))
-                except ValueError:
-                    continue
+                m = self._SEGMENT_RE.search(key)
+                if m:
+                    out.append(int(m.group(1)))
         return sorted(set(out))
 
     def start_new_segment(self) -> int:

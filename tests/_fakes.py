@@ -1,0 +1,22 @@
+"""Shared test doubles for the persistence/recovery suites."""
+
+
+class FakeObjectClient:
+    """In-memory object store with the minimal put/get/delete/list
+    interface (stands in for boto3/azure clients behind
+    ObjectStoreBackend)."""
+
+    def __init__(self):
+        self.objects = {}
+
+    def put(self, key, value):
+        self.objects[key] = bytes(value)
+
+    def get(self, key):
+        return self.objects.get(key)
+
+    def delete(self, key):
+        self.objects.pop(key, None)
+
+    def list(self, prefix):
+        return [k for k in self.objects if k.startswith(prefix)]

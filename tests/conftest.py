@@ -26,3 +26,4 @@ def _clear_parse_graph():
     G.clear()
     yield
     G.clear()
+

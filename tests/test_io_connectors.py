@@ -734,13 +734,19 @@ def test_airbyte_cloud_run_runner():
             "record": {"stream": "s", "data": {"k": 1}},
         }
         state = {"type": "STATE", "state": {"cursor": "c1"}}
-        return json_mod.dumps(record) + "\n" + json_mod.dumps(state)
+        return (
+            json_mod.dumps(record)
+            + "\n"
+            + json_mod.dumps(state)
+            + "\nPATHWAY_AIRBYTE_SYNC_DONE"
+        )
 
     runner = CloudRunAirbyteSource(
         "airbyte/source-faker",
         {"count": 1},
         ["s"],
         job_name="pw-test-job",
+        log_poll_interval=0.01,
         _execute=fake_execute,
     )
     msgs = list(runner.sync(None))

@@ -152,24 +152,7 @@ def test_kill_restart_resumes_from_snapshot(tmp_path):
     assert final == expected, (final, expected)
 
 
-class _FakeObjectClient:
-    """In-memory object store with the minimal put/get/delete/list
-    interface (stands in for boto3/azure clients)."""
-
-    def __init__(self):
-        self.objects = {}
-
-    def put(self, key, value):
-        self.objects[key] = bytes(value)
-
-    def get(self, key):
-        return self.objects.get(key)
-
-    def delete(self, key):
-        self.objects.pop(key, None)
-
-    def list(self, prefix):
-        return [k for k in self.objects if k.startswith(prefix)]
+from _fakes import FakeObjectClient as _FakeObjectClient
 
 
 def test_object_store_backend_append_truncate():
