@@ -16,6 +16,7 @@ Run: python benchmarks/engine_bench.py   (prints one JSON line per metric)
 from __future__ import annotations
 
 import json
+import os as _os
 import random
 import time as _time
 
@@ -67,7 +68,12 @@ def bench_group_update_flatness(sizes=(1_000, 10_000, 100_000), n_updates=200):
     return flat_ratio
 
 
-def bench_wordcount(n_rows=1_000_000, vocab=10_000, batch=20_000):
+def bench_wordcount(n_rows=5_000_000, vocab=10_000, batch=200_000):
+    """Streaming wordcount through the engine (TimedSource -> vector
+    groupby-count -> capture), 5M rows by default to match the reference
+    harness scale (reference: integration_tests/wordcount/base.py:19
+    DEFAULT_INPUT_SIZE).  Batch size mirrors what a 100 ms autocommit
+    produces at this throughput."""
     rng = random.Random(7)
     words = [f"w{i}" for i in range(vocab)]
     schema = schema_from_types(word=str)
@@ -95,6 +101,130 @@ def bench_wordcount(n_rows=1_000_000, vocab=10_000, batch=20_000):
     return rps
 
 
+def bench_wordcount_multiworker(n_rows=2_000_000, workers=(1, 2, 4)):
+    """Same wordcount through the full multi-process data-parallel path:
+    N workers, replicated fs json source (each keeps its key shard), TCP
+    exchange before the reduce, per-worker csv output parts.  Reports
+    rows/s at each worker count so exchange overhead is measured, not
+    guessed (reference: wordcount integration harness runs under
+    `pathway spawn`)."""
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+    import textwrap
+
+    from benchmarks.wordcount_bench import generate_input
+
+    script = textwrap.dedent(
+        """
+        import os, sys, time
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import pathway_tpu as pw
+
+        tmp = sys.argv[1]
+
+        class InputSchema(pw.Schema):
+            word: str
+
+        words = pw.io.fs.read(
+            path=os.path.join(tmp, "input"), schema=InputSchema,
+            format="json", mode="static",
+        )
+        result = words.groupby(words.word).reduce(
+            words.word, count=pw.reducers.count()
+        )
+        pw.io.csv.write(result, os.path.join(tmp, "out.csv"))
+        t0 = time.perf_counter()
+        pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+        print(f"ELAPSED {time.perf_counter() - t0:.3f}")
+        """
+    )
+
+    def free_port_base(n):
+        for _ in range(50):
+            socks = []
+            try:
+                s0 = socket.socket()
+                s0.bind(("127.0.0.1", 0))
+                base = s0.getsockname()[1]
+                socks.append(s0)
+                if base + n >= 65535:
+                    continue
+                for i in range(1, n):
+                    s = socket.socket()
+                    s.bind(("127.0.0.1", base + i))
+                    socks.append(s)
+                return base
+            except OSError:
+                continue
+            finally:
+                for s in socks:
+                    s.close()
+        raise RuntimeError("no free ports")
+
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    results = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        _os.makedirs(_os.path.join(tmp, "input"))
+        generate_input(_os.path.join(tmp, "input"), n_rows)
+        spath = _os.path.join(tmp, "wc.py")
+        with open(spath, "w") as fh:
+            fh.write(script)
+        for n in workers:
+            base = free_port_base(n)
+            procs = []
+            t0 = _time.perf_counter()
+            for wid in range(n):
+                env = dict(_os.environ)
+                env.update(
+                    PATHWAY_PROCESSES=str(n),
+                    PATHWAY_PROCESS_ID=str(wid),
+                    PATHWAY_FIRST_PORT=str(base),
+                    JAX_PLATFORMS="cpu",
+                    PYTHONPATH=repo,
+                )
+                procs.append(subprocess.Popen(
+                    [sys.executable, spath, tmp], env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                ))
+            for wid, p in enumerate(procs):
+                out, err = p.communicate(timeout=600)
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"worker {wid}/{n} rc={p.returncode}: "
+                        f"{err.decode()[-1500:]}"
+                    )
+            elapsed = _time.perf_counter() - t0
+            # union of per-worker part files (out.csv, out.csv.1, ...)
+            import glob as glob_mod
+
+            total = 0
+            for path in glob_mod.glob(_os.path.join(tmp, "out.csv*")):
+                with open(path) as fh:
+                    fh.readline()
+                    for line in fh:
+                        if line.strip():
+                            fields = line.rstrip().split(",")
+                            total += int(fields[1]) * int(fields[-1])
+                _os.remove(path)
+            assert total == n_rows, (n, total, n_rows)
+            results[n] = round(n_rows / elapsed)
+    print(json.dumps({
+        "metric": "wordcount_multiworker_rows_per_sec",
+        "value": results[max(workers)],
+        "unit": "rows/s",
+        "n_rows": n_rows,
+        "per_worker_count": {str(k): v for k, v in results.items()},
+    }))
+    return results
+
+
 if __name__ == "__main__":
-    bench_group_update_flatness()
-    bench_wordcount()
+    import sys as _sys
+
+    if "--multiworker" in _sys.argv:
+        bench_wordcount_multiworker()
+    else:
+        bench_group_update_flatness()
+        bench_wordcount()

@@ -1,0 +1,89 @@
+"""End-to-end wordcount harness modeled on the reference integration test
+(reference: integration_tests/wordcount/base.py:19 DEFAULT_INPUT_SIZE=5M,
+pw_wordcount.py: fs json read -> groupby(word).count -> csv write).
+
+Measures the FULL framework path: file generation excluded, everything
+from connector read through csv output included.
+
+Run: python benchmarks/wordcount_bench.py [n_rows]
+Prints one JSON line: {"metric": "wordcount_e2e_rows_per_sec", ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+
+def generate_input(directory: str, n_rows: int, vocab: int = 10_000) -> None:
+    rng = random.Random(7)
+    words = [f"word{i}" for i in range(vocab)]
+    rows_per_file = max(n_rows // 8, 1)
+    i = 0
+    fidx = 0
+    while i < n_rows:
+        count = min(rows_per_file, n_rows - i)
+        with open(os.path.join(directory, f"in_{fidx}.jsonl"), "w") as fh:
+            fh.write(
+                "\n".join(
+                    json.dumps({"word": rng.choice(words)})
+                    for _ in range(count)
+                )
+            )
+        i += count
+        fidx += 1
+
+
+def run_wordcount(n_rows: int) -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import pathway_tpu as pw
+
+    with tempfile.TemporaryDirectory() as tmp:
+        in_dir = os.path.join(tmp, "input")
+        os.makedirs(in_dir)
+        generate_input(in_dir, n_rows)
+        out_path = os.path.join(tmp, "out.csv")
+
+        class InputSchema(pw.Schema):
+            word: str
+
+        t0 = time.perf_counter()
+        words = pw.io.fs.read(
+            path=in_dir,
+            schema=InputSchema,
+            format="json",
+            mode="static",
+        )
+        result = words.groupby(words.word).reduce(
+            words.word, count=pw.reducers.count()
+        )
+        pw.io.csv.write(result, out_path)
+        pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+        elapsed = time.perf_counter() - t0
+
+        total = 0
+        with open(out_path) as fh:
+            header = fh.readline()
+            assert "word" in header and "count" in header, header
+            for line in fh:
+                if not line.strip():
+                    continue
+                total += int(line.rsplit(",")[1])
+        assert total == n_rows, (total, n_rows)
+    return {
+        "metric": "wordcount_e2e_rows_per_sec",
+        "value": round(n_rows / elapsed),
+        "unit": "rows/s",
+        "n_rows": n_rows,
+        "elapsed_s": round(elapsed, 2),
+        "includes": "fs json connector -> vector groupby count -> csv write",
+    }
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 5_000_000
+    print(json.dumps(run_wordcount(n)))

@@ -20,6 +20,7 @@ Scheduling model:
 
 from __future__ import annotations
 
+import gc
 import threading
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -59,25 +60,53 @@ class Node:
         self.inputs = inputs
         self.downstream: List[Tuple["Node", int]] = []
         self.pending: Dict[int, List[Delta]] = {}
+        self._pending_clean: Dict[int, bool] = {}
         self.trace: Any = None  # user frame info
         for port, inp in enumerate(inputs):
             inp.downstream.append((self, port))
         engine.register(self)
 
     # -- wiring -----------------------------------------------------------
-    def receive(self, port: int, deltas: List[Delta]) -> None:
-        self.pending.setdefault(port, []).extend(deltas)
+    def receive(
+        self, port: int, deltas: List[Delta], clean: bool = False
+    ) -> None:
+        cur = self.pending.get(port)
+        if cur is None:
+            self.pending[port] = list(deltas)
+            self._pending_clean[port] = clean
+        else:
+            cur.extend(deltas)
+            # merged chunks may interleave per-key updates
+            self._pending_clean[port] = False
 
     def emit(self, time: int, deltas: Iterable[Delta]) -> None:
         out = consolidate(deltas)
         if not out:
             return
         self.engine.stats_rows += len(out)
+        # receive() copies into its own pending list, so sharing `out`
+        # across downstream nodes is safe
         for node, port in self.downstream:
-            node.receive(port, list(out))
+            node.receive(port, out, clean=True)
+
+    def emit_consolidated(self, time: int, deltas: List[Delta]) -> None:
+        """emit() for batches the producer guarantees are already minimal
+        (no duplicate (key, values) pairs; retractions precede insertions
+        per key) — skips the consolidation pass."""
+        if not deltas:
+            return
+        self.engine.stats_rows += len(deltas)
+        for node, port in self.downstream:
+            node.receive(port, deltas, clean=True)
 
     def take(self, port: int = 0) -> List[Delta]:
+        self._pending_clean.pop(port, None)
         return self.pending.pop(port, [])
+
+    def take_with_clean(self, port: int = 0) -> Tuple[List[Delta], bool]:
+        """take() plus whether the batch is known already-consolidated."""
+        clean = self._pending_clean.pop(port, False)
+        return self.pending.pop(port, []), clean
 
     def has_pending(self) -> bool:
         return bool(self.pending)
@@ -150,6 +179,7 @@ class Engine:
         self.error_log: List[ErrorLogEntry] = []
         self.error_log_nodes: List["ErrorLogNode"] = []
         self._scheduled_times: set[int] = set()
+        self._gc_ticks = 0
         self.current_time: int = 0
         self.stats_rows = 0
         self.now_fn: Callable[[], int] | None = None  # engine-time provider
@@ -214,17 +244,47 @@ class Engine:
             self.current_node = None
         for node in self.nodes:
             node.on_time_end(time)
+        self._gc_pulse()
+
+    def _gc_pulse(self) -> None:
+        """Keep cyclic-GC pauses off the hot loop.  Engine state (delta
+        tuples, Pointers, group dicts) is acyclic but gc-tracked, so at
+        millions of rows every gen-2 collection stalls a tick for seconds
+        scanning live state.  Every 16 ticks: collect the young gens
+        (recent cyclic garbage, cheap), then freeze survivors into the
+        permanent generation so automatic collections stop rescanning
+        them.  Every 1024 ticks a full unfreeze+collect reclaims any
+        frozen cycles (e.g. abandoned UDF closures).  `finish()` always
+        unfreezes, so repeated runs in one process don't pin garbage."""
+        self._gc_ticks += 1
+        if self._gc_ticks % 1024 == 0:
+            gc.unfreeze()
+            gc.collect()
+            gc.freeze()
+        elif self._gc_ticks % 16 == 0:
+            gc.collect(1)
+            gc.freeze()
 
     def run_static(self) -> None:
         """Batch mode: all inputs at time 0, then drain scheduled times
         (temporal buffers flush at +inf on end)."""
-        self.process_time(0)
-        while True:
-            t = self.global_next_time()
-            if t is None:
-                break
-            self.process_time(t)
-        self.finish()
+        try:
+            self.process_time(0)
+            while True:
+                t = self.global_next_time()
+                if t is None:
+                    break
+                self.process_time(t)
+            self.finish()
+        finally:
+            # finish() unfreezes on the success path; this covers
+            # exceptions mid-run so the process's GC is never left frozen
+            self._gc_unfreeze()
+
+    def _gc_unfreeze(self) -> None:
+        if self._gc_ticks >= 16:
+            self._gc_ticks = 0
+            gc.unfreeze()
 
     def _drain(self) -> None:
         # A delta can traverse at most the full node chain per pass, so a
@@ -246,12 +306,15 @@ class Engine:
             )
 
     def finish(self) -> None:
-        for node in self.nodes:
-            node.on_flush()
-        self._drain()
-        for node in self.nodes:
-            node.on_end()
-        self._drain()
+        try:
+            for node in self.nodes:
+                node.on_flush()
+            self._drain()
+            for node in self.nodes:
+                node.on_end()
+            self._drain()
+        finally:
+            self._gc_unfreeze()
 
 
 # ---------------------------------------------------------------------------
@@ -273,6 +336,9 @@ class StaticSource(Node):
     def process(self, time: int) -> None:
         if not self._emitted and time >= 0:
             self._emitted = True
+            if self.engine.coord.worker_count == 1:
+                self.emit(time, [(k, v, 1) for k, v in self.rows.items()])
+                return
             owns = self.engine.owns_key
             self.emit(
                 time, [(k, v, 1) for k, v in self.rows.items() if owns(k)]
@@ -289,14 +355,38 @@ class TimedSource(Node):
     def __init__(self, engine: Engine, events: List[Tuple[int, Delta]]):
         super().__init__(engine, [])
         self._by_time: Dict[int, List[Delta]] = {}
-        for time, delta in events:
-            self._by_time.setdefault(time, []).append(delta)
-        for time in self._by_time:
+        by_time = self._by_time
+        try:
+            # bulk shape: contiguous runs per time slice at C speed instead
+            # of a per-event setdefault/append
+            import numpy as _np
+
+            times = _np.asarray([e[0] for e in events], dtype=_np.int64)
+            if len(times):
+                bounds = (_np.nonzero(_np.diff(times))[0] + 1).tolist()
+                starts = [0] + bounds
+                ends = bounds + [len(times)]
+                for s, e in zip(starts, ends):
+                    t = int(times[s])
+                    chunk = [ev[1] for ev in events[s:e]]
+                    prev = by_time.get(t)
+                    if prev is None:
+                        by_time[t] = chunk
+                    else:
+                        prev.extend(chunk)
+        except (TypeError, ValueError, OverflowError):
+            by_time.clear()
+            for time, delta in events:
+                by_time.setdefault(time, []).append(delta)
+        for time in by_time:
             engine.schedule_time(time)
 
     def process(self, time: int) -> None:
         deltas = self._by_time.pop(time, None)
         if deltas:
+            if self.engine.coord.worker_count == 1:
+                self.emit(time, deltas)
+                return
             # multi-worker: each worker emits only its shard of the
             # (identical) event script
             owns = self.engine.owns_key
@@ -354,11 +444,25 @@ class RowwiseNode(Node):
         batch_fn: Callable[[List[Pointer], Tuple[List[tuple], ...]], List[tuple]],
         *,
         deterministic: bool = True,
+        projection: tuple | None = None,
     ):
         super().__init__(engine, inputs)
         self.batch_fn = batch_fn
         self.multi = len(inputs) > 1
         self.deterministic = deterministic
+        # pure column projection: emit via one itemgetter pass
+        self._proj = None
+        self._proj_idx: tuple | None = None
+        self._ident: bool | None = None
+        if projection is not None and not self.multi and deterministic:
+            import operator as _op
+
+            self._proj_idx = projection
+            if len(projection) == 1:
+                idx = projection[0]
+                self._proj = lambda v, _i=idx: (v[_i],)
+            else:
+                self._proj = _op.itemgetter(*projection)
         if self.multi or not deterministic:
             self.in_states = [TableState() for _ in inputs]
             self.out_state: Dict[Pointer, tuple] = {}
@@ -370,8 +474,26 @@ class RowwiseNode(Node):
 
     def process(self, time: int) -> None:
         if not self.multi and self.deterministic:
-            deltas = self.take(0)
+            deltas, clean = self.take_with_clean(0)
             if not deltas:
+                return
+            proj = self._proj
+            if proj is not None:
+                if self._ident is None and deltas:
+                    # identity projection: same columns, same order
+                    w = len(deltas[0][1])
+                    self._ident = self._proj_idx == tuple(range(w))
+                if self._ident:
+                    # rows pass through untouched; a clean input batch
+                    # stays clean (keys, values, diffs all unchanged)
+                    if clean:
+                        self.emit_consolidated(time, deltas)
+                    else:
+                        self.emit(time, deltas)
+                    return
+                # non-identity projections can collapse distinct values
+                # into cancellable pairs, so always re-consolidate
+                self.emit(time, [(k, proj(v), d) for k, v, d in deltas])
                 return
             keys = [d[0] for d in deltas]
             rows = ([d[1] for d in deltas],)
@@ -504,7 +626,7 @@ class CaptureNode(Node):
             return
         self.state.apply(deltas, source=self.name)
         if self.record_stream:
-            self.stream.extend((time, d) for d in deltas)
+            self.stream.extend([(time, d) for d in deltas])
 
 
 class SubscribeNode(Node):

@@ -25,33 +25,54 @@ def consolidate(deltas: Iterable[Delta]) -> List[Delta]:
     if not isinstance(deltas, list):
         deltas = list(deltas)
     # fast path: pure insert batches with distinct keys (the bulk-ingest
-    # shape) need no value hashing at all — only key uniqueness matters
-    seen_keys: set = set()
-    for key, _values, diff in deltas:
-        if diff < 0 or key in seen_keys:
+    # shape) need no value hashing at all — only key uniqueness matters.
+    # Both checks are single C-speed passes.
+    all_insert = True
+    for d in deltas:
+        if d[2] < 0:
+            all_insert = False
             break
-        seen_keys.add(key)
-    else:
+    if all_insert and len({d[0] for d in deltas}) == len(deltas):
         return deltas
     acc: dict = {}
-    order: list = []
-    for key, values, diff in deltas:
-        try:
-            group = (key, _hashable(values))
-        except TypeError:
-            group = (key, id(values))
-        if group in acc:
-            acc[group][2] += diff
-        else:
-            entry = [key, values, diff]
-            acc[group] = entry
-            order.append(entry)
-    out = [
-        (key, values, diff) for key, values, diff in order if diff != 0
-    ]
+    get = acc.get
+    try:
+        # common case: values tuples of plain hashables — group directly
+        # (key, values) -> summed diff; dict insertion order preserves
+        # first-seen order
+        for key, values, diff in deltas:
+            g = (key, values)
+            prev = get(g)
+            acc[g] = diff if prev is None else prev + diff
+    except TypeError:
+        # some values hold ndarrays/lists/dicts — redo with the
+        # normalizing walk (rare path; correctness over speed)
+        acc = {}
+        originals: dict = {}
+        for key, values, diff in deltas:
+            try:
+                g = (key, _hashable(values))
+            except TypeError:
+                g = (key, id(values))
+            prev = acc.get(g)
+            acc[g] = diff if prev is None else prev + diff
+            if prev is None:
+                originals[g] = values
+        neg = []
+        pos = []
+        for g, diff in acc.items():
+            if diff == 0:
+                continue
+            (neg if diff < 0 else pos).append((g[0], originals[g], diff))
+        return neg + pos
     # retractions first, insertions second; stable within each class
-    out.sort(key=lambda d: 0 if d[2] < 0 else 1)
-    return out
+    neg = []
+    pos = []
+    for (key, values), diff in acc.items():
+        if diff == 0:
+            continue
+        (neg if diff < 0 else pos).append((key, values, diff))
+    return neg + pos
 
 
 def _hashable(values: tuple):
@@ -70,6 +91,9 @@ def _hashable_one(v: Any):
     return v
 
 
+_ABSENT = object()
+
+
 class TableState:
     """Materialized current content of a stream: key -> values tuple.
 
@@ -82,24 +106,40 @@ class TableState:
         self.rows: dict = {}
 
     def apply(self, deltas: Iterable[Delta], *, source: str = "") -> None:
+        rows = self.rows
+        pop = rows.pop
+        get = rows.get
         for key, values, diff in deltas:
-            if diff < 0:
+            if diff == -1:
+                if pop(key, _ABSENT) is _ABSENT:
+                    raise KeyError(
+                        f"{source}: retraction of absent key {key!r}"
+                    )
+            elif diff == 1:
+                prev = get(key)
+                if prev is not None and not values_equal_tuple(prev, values):
+                    raise KeyError(
+                        f"{source}: duplicate key {key!r}: "
+                        f"{prev!r} vs {values!r}"
+                    )
+                rows[key] = values
+            elif diff < 0:
                 for _ in range(-diff):
-                    if key not in self.rows:
+                    if pop(key, _ABSENT) is _ABSENT:
                         raise KeyError(
                             f"{source}: retraction of absent key {key!r}"
                         )
-                    del self.rows[key]
             else:
                 for _ in range(diff):
-                    if key in self.rows and not values_equal_tuple(
-                        self.rows[key], values
+                    prev = get(key)
+                    if prev is not None and not values_equal_tuple(
+                        prev, values
                     ):
                         raise KeyError(
                             f"{source}: duplicate key {key!r}: "
-                            f"{self.rows[key]!r} vs {values!r}"
+                            f"{prev!r} vs {values!r}"
                         )
-                    self.rows[key] = values
+                    rows[key] = values
 
     def snapshot_deltas(self) -> List[Delta]:
         return [(k, v, 1) for k, v in self.rows.items()]
@@ -108,6 +148,25 @@ class TableState:
 def values_equal_tuple(a: tuple, b: tuple) -> bool:
     if a is b:
         return True
+    try:
+        # plain scalars compare at C speed; ndarrays make `==` return an
+        # array whose truthiness raises, falling through to the slow path
+        eq = a == b
+        if eq is True:
+            return True
+        if eq is False and _all_scalar(a) and _all_scalar(b):
+            return False
+    except (TypeError, ValueError):
+        pass
     if len(a) != len(b):
         return False
     return all(values_equal(x, y) for x, y in zip(a, b))
+
+
+# float excluded: values_equal treats NaN == NaN as True, so a False from
+# plain tuple comparison is not authoritative when floats are present
+_SCALAR_TYPES = (str, int, bool, bytes, type(None), Pointer)
+
+
+def _all_scalar(values: tuple) -> bool:
+    return all(isinstance(v, _SCALAR_TYPES) for v in values)

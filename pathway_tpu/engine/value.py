@@ -71,11 +71,16 @@ class Pointer:
     Optionally remembers the values it was derived from for debug printing.
     """
 
-    __slots__ = ("value", "_origin")
+    __slots__ = ("value", "_origin", "_h")
 
     def __init__(self, value: int, origin: tuple | None = None):
-        self.value = value & _KEY_MASK
+        value &= _KEY_MASK
+        self.value = value
         self._origin = origin
+        # dict lookups keyed by Pointer dominate the engine's host hot
+        # loop; hashing the 128-bit int once at construction beats
+        # rehashing it on every lookup
+        self._h = hash(value)
 
     def __eq__(self, other):
         return isinstance(other, Pointer) and self.value == other.value
@@ -93,7 +98,7 @@ class Pointer:
         return self.value >= other.value
 
     def __hash__(self):
-        return hash(self.value)
+        return self._h
 
     def __repr__(self):
         if self._origin is not None and len(self._origin) == 1:
@@ -157,6 +162,31 @@ def hash_values(*values: Any) -> int:
     for v in values:
         _serialize_for_hash(v, out)
     return _hash_bytes(b"".join(out))
+
+
+_SEQ_MIX1 = 0x9E3779B97F4A7C15F39CC0605CEDC835
+_SEQ_MIX2 = 0xC6A4A7935BD1E995C2B2AE3D27D4EB4F
+
+
+def seq_key(seed: int, counter: int) -> Pointer:
+    """Auto-assigned connector row key: splitmix-style finalizer over a
+    per-source 128-bit seed and a sequential counter.  ~20x cheaper than
+    the blake2b in ref_scalar, bijective in `counter` for a fixed seed
+    (collision-free within a source), uniformly mixed so the low shard
+    bits balance across workers.  Stable across runs: the seed derives
+    from the source name and the counter is persisted subject state."""
+    x = (seed ^ ((counter + 1) * _SEQ_MIX2)) & _KEY_MASK
+    x ^= x >> 67
+    x = (x * _SEQ_MIX1) & _KEY_MASK
+    x ^= x >> 64
+    x = (x * _SEQ_MIX2) & _KEY_MASK
+    x ^= x >> 67
+    return Pointer(x)
+
+
+def seq_key_seed(*name_parts: Any) -> int:
+    """Per-source seed for seq_key (one blake2b at source setup)."""
+    return hash_values(*name_parts)
 
 
 def ref_scalar(*values: Any, optional: bool = False, instance: Any = None) -> Pointer:

@@ -90,6 +90,59 @@ class GroupedTable:
         sort_by = self._sort_by
         n_group = len(grouping)
 
+        # group-key caching (and the fused raw-value code map) relies on
+        # dict equality agreeing with ref_scalar's key derivation.  Python
+        # dicts equate True == 1 == 1.0 while ref_scalar separates bool
+        # from numbers, so caching is only sound when the group column
+        # dtypes preclude mixed bool/number values — i.e. concrete
+        # non-ANY dtypes.  (int vs float is safe: ref_scalar hashes
+        # integral floats and ints identically, matching dict equality.)
+        _CACHEABLE_GROUP_DTYPES = (
+            dt.STR, dt.INT, dt.FLOAT, dt.BOOL, dt.BYTES, dt.POINTER,
+            dt.DATE_TIME_NAIVE, dt.DATE_TIME_UTC, dt.DURATION,
+        )
+
+        def _cacheable_dtype(d) -> bool:
+            if isinstance(d, dt.Optionalized):
+                d = dt.unoptionalize(d)
+            return d in _CACHEABLE_GROUP_DTYPES
+
+        group_keys_cacheable = True
+        for g in grouping:
+            try:
+                if not _cacheable_dtype(self._infer_on_source(g)):
+                    group_keys_cacheable = False
+                    break
+            except Exception:  # noqa: BLE001
+                group_keys_cacheable = False
+                break
+
+        # static gate for the columnar reduce path (engine/vector_reduce.py):
+        # vector reducers only, bare non-optional numeric argument columns,
+        # deterministic args (retractions recompute them from the
+        # retraction row), default grouping keys, no ordering dependence
+        use_vector = sort_by is None and id_expr is None
+        if use_vector:
+            from pathway_tpu.engine.vector_reduce import VECTOR_REDUCERS
+            from pathway_tpu.internals.table import _expr_deterministic
+
+            for red in reducers:
+                if red._reducer.name not in VECTOR_REDUCERS:
+                    use_vector = False
+                    break
+                if not all(_expr_deterministic(a) for a in red._args):
+                    use_vector = False
+                    break
+                if red._args:
+                    try:
+                        adt = self._infer_on_source(red._args[0])
+                    except Exception:  # noqa: BLE001
+                        use_vector = False
+                        break
+                    if adt not in (dt.INT, dt.FLOAT, dt.BOOL):
+                        use_vector = False
+                        break
+
         def build(ctx):
             from pathway_tpu.engine.operators import ReduceNode
             from pathway_tpu.engine.value import ERROR, Error, Pointer, ref_scalar
@@ -106,6 +159,12 @@ class GroupedTable:
                 _compile_on(ctx, [source], sort_by) if sort_by is not None else None
             )
 
+            # (gvals, instance) -> (gkey, gvals): streams revisit the same
+            # groups every batch, and the 128-bit blake2b in ref_scalar is
+            # ~10x a dict hit.  Bounded: cleared when it outgrows the cap.
+            key_cache: dict = {}
+            _CACHE_CAP = 1 << 20
+
             def group_fn(keys, rows):
                 gcols = [p(keys, rows) for p in group_progs]
                 instances = (
@@ -113,21 +172,73 @@ class GroupedTable:
                 )
                 ids = id_prog(keys, rows) if id_prog is not None else None
                 out = []
+                if len(key_cache) > _CACHE_CAP:
+                    key_cache.clear()
                 for i in range(len(keys)):
                     gvals = tuple(c[i] for c in gcols)
+                    if ids is not None:
+                        if isinstance(gvals, tuple) and any(
+                            isinstance(v, Error) for v in gvals
+                        ):
+                            out.append((ERROR, gvals))
+                            continue
+                        out.append((ids[i], gvals))
+                        continue
+                    inst = instances[i] if instances is not None else None
+                    if group_keys_cacheable:
+                        try:
+                            cached = key_cache.get((gvals, inst))
+                        except TypeError:
+                            cached = None
+                            gvals_key = None
+                        else:
+                            gvals_key = (gvals, inst)
+                        if cached is not None:
+                            out.append((cached, gvals))
+                            continue
+                    else:
+                        gvals_key = None
                     if any(isinstance(v, Error) for v in gvals):
                         # an Error grouping value must exclude the row (and
                         # log), not silently form its own Error-group
                         # (reference: group_by error handling, reduce.rs)
                         out.append((ERROR, gvals))
                         continue
-                    if ids is not None:
-                        gkey = ids[i]
-                    else:
-                        inst = instances[i] if instances is not None else None
-                        gkey = ref_scalar(*gvals, instance=inst)
+                    gkey = ref_scalar(*gvals, instance=inst)
+                    if gvals_key is not None:
+                        key_cache[gvals_key] = gkey
                     out.append((gkey, gvals))
                 return out
+
+            if use_vector:
+                from pathway_tpu.engine.vector_reduce import VectorReduceNode
+
+                arg_col_fns = []
+                for red in reducers:
+                    if red._args:
+                        prog = _compile_on(ctx, [source], red._args[0])
+                        arg_col_fns.append(prog)
+                    else:
+                        arg_col_fns.append(None)
+                return VectorReduceNode(
+                    ctx.engine,
+                    node,
+                    group_fn,
+                    [r._reducer for r in reducers],
+                    arg_col_fns,
+                    gval_width=n_group,
+                    # fused raw-value -> group-code mapping works only for
+                    # default-keyed grouping without instances, and (like
+                    # key_cache) only when dict equality over the group
+                    # values cannot alias distinct ref_scalar keys
+                    group_col_progs=(
+                        group_progs
+                        if instance is None
+                        and group_progs
+                        and group_keys_cacheable
+                        else None
+                    ),
+                )
 
             args_fns = []
             for red in reducers:

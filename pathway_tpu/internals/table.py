@@ -875,7 +875,28 @@ def _rowwise_build(primary: Table, cols: Dict[str, ColumnExpression]):
             return list(zip(*columns))
 
         deterministic = all(_expr_deterministic(e) for e in cols.values())
-        return RowwiseNode(ctx.engine, nodes, batch_fn, deterministic=deterministic)
+        # pure column projection off the primary input compiles to one
+        # C-speed itemgetter pass instead of per-column programs + rezip
+        projection = None
+        if n_cols and len(nodes) == 1:
+            idxs = []
+            for e in cols.values():
+                if type(e) is ColumnReference and not isinstance(e, IdReference):
+                    loc = ectx.resolve(e)
+                    if loc is not None and loc != ("id",) and loc[0] == 0:
+                        idxs.append(loc[1])
+                        continue
+                idxs = None
+                break
+            if idxs is not None:
+                projection = tuple(idxs)
+        return RowwiseNode(
+            ctx.engine,
+            nodes,
+            batch_fn,
+            deterministic=deterministic,
+            projection=projection,
+        )
 
     return build
 

@@ -38,6 +38,21 @@ def _hashable(values: tuple) -> tuple:
     return tuple(out)
 
 
+def _values_tuples(rows: List[dict], names: List[str]) -> List[tuple]:
+    """Row dicts -> values tuples; specialized for narrow schemas (the
+    per-row genexpr inside tuple() dominates otherwise)."""
+    if len(names) == 1:
+        n0 = names[0]
+        return [(r.get(n0),) for r in rows]
+    if len(names) == 2:
+        n0, n1 = names
+        return [(r.get(n0), r.get(n1)) for r in rows]
+    if len(names) == 3:
+        n0, n1, n2 = names
+        return [(r.get(n0), r.get(n1), r.get(n2)) for r in rows]
+    return [tuple(r.get(c) for c in names) for r in rows]
+
+
 class LiveSource:
     """One streaming input: a subject factory + the engine node it feeds.
 
@@ -106,7 +121,7 @@ def connector_table(
             subject._bind(collector)
             subject.run()
             subject.on_stop()
-            return StaticSource(ctx.engine, collector.rows)
+            return StaticSource(ctx.engine, collector.all_rows())
 
         return Table(schema=schema, universe=Universe(), build=build_static)
 
@@ -134,25 +149,46 @@ class _StaticCollector:
     """Synchronously drains a subject in static mode."""
 
     def __init__(self, schema):
+        from pathway_tpu.engine.value import seq_key_seed
+
         self.schema = schema
         self.names = list(schema.keys())
         self.pk = schema.primary_key_columns()
         self.rows: Dict[Pointer, tuple] = {}
         self._counter = 0
+        self._seed = seq_key_seed("static", schema.__name__)
+        # keyless retraction bookkeeping is lazy: bulk loads log batches
+        # and the values->keys dict materializes on the first retraction
         self._keys_by_values: Dict[tuple, List] = {}
+        self._kv_log: List[tuple] = []  # (values_list, keys_list)
+
+    def _materialize_kv(self) -> Dict[tuple, List]:
+        kv = self._keys_by_values
+        if self._kv_log:
+            rows = self.rows
+            for values_list, keys_list in self._kv_log:
+                rows.update(zip(keys_list, values_list))
+                for v, k in zip(values_list, keys_list):
+                    kv.setdefault(_hashable(v), []).append(k)
+            self._kv_log.clear()
+        return kv
 
     def push_row(self, row: dict, diff: int = 1) -> None:
+        from pathway_tpu.engine.value import seq_key
+
         values = tuple(row.get(c) for c in self.names)
         if self.pk:
             key = ref_scalar(*(row.get(c) for c in self.pk))
         elif diff > 0:
             self._counter += 1
-            key = ref_scalar(self.schema.__name__, self._counter)
+            key = seq_key(self._seed, self._counter)
+            if self._kv_log:
+                self._materialize_kv()
             self._keys_by_values.setdefault(_hashable(values), []).append(key)
         else:
             # retraction without a primary key: cancel the key assigned to
             # an earlier insert of the same values
-            stack = self._keys_by_values.get(_hashable(values))
+            stack = self._materialize_kv().get(_hashable(values))
             if not stack:
                 return
             key = stack.pop()
@@ -160,6 +196,32 @@ class _StaticCollector:
             self.rows[key] = values
         else:
             self.rows.pop(key, None)
+
+    def push_rows(self, rows: List[dict]) -> None:
+        """Bulk insert: one pass over the batch instead of per-row calls.
+        Keyless batches skip the dict entirely (seq keys cannot collide);
+        `all_rows()` folds the logged batches back in."""
+        from pathway_tpu.engine.value import seq_key
+
+        values_list = _values_tuples(rows, self.names)
+        if self.pk:
+            pk = self.pk
+            keys = [ref_scalar(*(r.get(c) for c in pk)) for r in rows]
+            self.rows.update(zip(keys, values_list))
+        else:
+            seed = self._seed
+            c0 = self._counter
+            keys = [seq_key(seed, c0 + i + 1) for i in range(len(rows))]
+            self._counter = c0 + len(rows)
+            self._kv_log.append((values_list, keys))
+
+    def all_rows(self) -> Dict[Pointer, tuple]:
+        """Final key -> values map (push_row inserts + logged batches)."""
+        if self._kv_log:
+            rows = self.rows
+            for values_list, keys_list in self._kv_log:
+                rows.update(zip(keys_list, values_list))
+        return self.rows
 
     def commit(self) -> None:
         pass
@@ -183,6 +245,16 @@ class ConnectorSubjectBase:
     # -- API used by subclasses ------------------------------------------
     def next(self, **kwargs) -> None:
         self._sink.push_row(kwargs)
+
+    def next_batch(self, rows: List[dict]) -> None:
+        """Bulk insert of row dicts — one sink call for the whole chunk
+        (the readers' bulk-ingest fast path)."""
+        push_rows = getattr(self._sink, "push_rows", None)
+        if push_rows is not None:
+            push_rows(rows)
+        else:
+            for r in rows:
+                self._sink.push_row(r)
 
     def next_json(self, message: dict) -> None:
         self.next(**message)
@@ -234,17 +306,22 @@ class _QueueSink:
     """Routes a live subject's rows into the driver queue."""
 
     def __init__(self, driver_queue, live: LiveSource):
+        from pathway_tpu.engine.value import seq_key_seed
+
         self.queue = driver_queue
         self.live = live
         self.names = list(live.schema.keys())
         self.pk = live.schema.primary_key_columns()
         self._counter = 0
+        self._seed = seq_key_seed("live", live.name)
         self._keys_by_values: Dict[tuple, List] = {}
         self.subject = None  # bound by the driver
 
     persistence_enabled = False
 
     def push_row(self, row: dict, diff: int = 1) -> None:
+        from pathway_tpu.engine.value import seq_key
+
         if self.live.sync_group is not None and diff > 0:
             # throttle until the group's other sources catch up (reference:
             # src/connectors/synchronization.rs)
@@ -258,7 +335,7 @@ class _QueueSink:
             key = ref_scalar(*(row.get(c) for c in self.pk))
         elif diff > 0:
             self._counter += 1
-            key = ref_scalar(self.live.name, self._counter)
+            key = seq_key(self._seed, self._counter)
             self._keys_by_values.setdefault(_hashable(values), []).append(key)
         else:
             # retraction on a keyless schema must reuse the insert's key,
@@ -270,6 +347,35 @@ class _QueueSink:
         # the counter rides every data message so autocommit-flushed
         # batches persist a correct resume point even without commit()
         self.queue.put(("data", self.live, (key, values, diff), self._counter))
+
+    def push_rows(self, rows: List[dict]) -> None:
+        """Bulk inserts: one queue message for the whole batch.  Falls
+        back to push_row when per-row handling is needed (sync groups,
+        explicit keys).  Contract: batches are homogeneous w.r.t.
+        `_pw_key` — either every row carries one or none does (the
+        readers guarantee this; schema-filtered rows never carry it)."""
+        from pathway_tpu.engine.value import seq_key
+
+        if self.live.sync_group is not None or (
+            rows and "_pw_key" in rows[0]
+        ):
+            for r in rows:
+                self.push_row(r)
+            return
+        values_list = _values_tuples(rows, self.names)
+        if self.pk:
+            pk = self.pk
+            keys = [ref_scalar(*(r.get(c) for c in pk)) for r in rows]
+        else:
+            seed = self._seed
+            c0 = self._counter
+            keys = [seq_key(seed, c0 + i + 1) for i in range(len(rows))]
+            self._counter = c0 + len(rows)
+            kv = self._keys_by_values
+            for v, k in zip(values_list, keys):
+                kv.setdefault(_hashable(v), []).append(k)
+        deltas = [(k, v, 1) for k, v in zip(keys, values_list)]
+        self.queue.put(("data_batch", self.live, deltas, self._counter))
 
     def commit(self) -> None:
         state = None
@@ -318,6 +424,14 @@ class StreamingDriver:
         return writer
 
     def run(self, sources: List[LiveSource]) -> None:
+        try:
+            self._run(sources)
+        finally:
+            # finish() unfreezes on the success path; this also covers
+            # exceptions mid-stream (engine._gc_pulse freezes the gc)
+            self.engine._gc_unfreeze()
+
+    def _run(self, sources: List[LiveSource]) -> None:
         threads = []
         active = 0
         replayed: Dict[LiveSource, List] = {}
@@ -571,6 +685,8 @@ class StreamingDriver:
                 counters[live] = max(counters.get(live, 0), counter)
                 if kind == "data":
                     pending.setdefault(live, []).append(payload)
+                elif kind == "data_batch":
+                    pending.setdefault(live, []).extend(payload)
                 elif kind == "commit":
                     if payload is not None:
                         states[live] = payload

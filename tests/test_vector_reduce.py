@@ -1,0 +1,305 @@
+"""VectorReduceNode (engine/vector_reduce.py) — the columnar groupby hot
+path must be indistinguishable from the classic ReduceNode.
+
+Strategy: run the same pipeline twice — once as built (vector path when
+eligible) and once with the vector gate disabled — and require identical
+final tables and identical minimal update streams.
+"""
+
+import random
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_events, table_from_markdown
+from pathway_tpu.engine.value import ref_scalar
+from pathway_tpu.internals.runner import run_tables
+from pathway_tpu.internals.schema import schema_from_types
+
+
+def _rows(table):
+    (capture,) = run_tables(table)
+    return sorted(capture.state.rows.values())
+
+
+def _is_vector(table) -> bool:
+    from pathway_tpu.engine.vector_reduce import VectorReduceNode
+    from pathway_tpu.internals.runner import run_tables as rt
+
+    (capture,) = rt(table)
+    return any(
+        isinstance(n, VectorReduceNode) for n in capture.engine.nodes
+    )
+
+
+def test_vector_node_chosen_for_count_sum_min_max():
+    t = table_from_markdown(
+        """
+        k | v
+        a | 1
+        a | 2
+        b | 5
+        """
+    )
+    res = t.groupby(t.k).reduce(
+        t.k,
+        c=pw.reducers.count(),
+        s=pw.reducers.sum(t.v),
+        lo=pw.reducers.min(t.v),
+        hi=pw.reducers.max(t.v),
+    )
+    assert _is_vector(res)
+    assert set(_rows(res)) == {("a", 2, 3, 1, 2), ("b", 1, 5, 5, 5)}
+
+
+def test_classic_node_for_nonvector_reducers():
+    pw.G.clear()
+    t = table_from_markdown(
+        """
+        k | v
+        a | 1
+        a | 2
+        """
+    )
+    res = t.groupby(t.k).reduce(t.k, xs=pw.reducers.tuple(t.v))
+    assert not _is_vector(res)
+
+
+def test_classic_node_for_optional_dtype_args():
+    pw.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=pw.internals.dtype.Optionalized(
+            pw.internals.dtype.INT
+        )),
+        [("a", 1), ("a", None)],
+    )
+    res = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+    assert not _is_vector(res)
+
+
+def _random_stream_events(seed, n_rows, vocab, retract_frac=0.3):
+    """Insert/retract event script over a small key space; retractions
+    always target a currently-live row (clean stream)."""
+    rng = random.Random(seed)
+    words = [f"w{i}" for i in range(vocab)]
+    events = []
+    live = {}
+    t = 2
+    for i in range(n_rows):
+        if live and rng.random() < retract_frac:
+            key = rng.choice(list(live))
+            events.append((t, (key, live.pop(key), -1)))
+        else:
+            key = ref_scalar(i)
+            row = (rng.choice(words), rng.randint(-50, 50))
+            live[key] = row
+            events.append((t, (key, row, 1)))
+        if rng.random() < 0.1:
+            t += 2
+    return events
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_vector_matches_classic_on_random_streams(seed):
+    import pathway_tpu.internals.groupbys as gb
+    from pathway_tpu.engine import vector_reduce
+
+    events = _random_stream_events(seed, 400, vocab=6)
+    schema = schema_from_types(k=str, v=int)
+
+    def build():
+        t = table_from_events(schema, events)
+        return t.groupby(t.k).reduce(
+            t.k,
+            c=pw.reducers.count(),
+            s=pw.reducers.sum(t.v),
+            lo=pw.reducers.min(t.v),
+            hi=pw.reducers.max(t.v),
+        )
+
+    pw.G.clear()
+    res = build()
+    assert _is_vector(res)
+    pw.G.clear()
+    (vec_cap,) = run_tables(build(), record_stream=True)
+
+    # disable the vector gate -> classic node
+    saved = vector_reduce.VECTOR_REDUCERS
+    vector_reduce.VECTOR_REDUCERS = frozenset()
+    try:
+        pw.G.clear()
+        res2 = build()
+        assert not _is_vector(res2)
+        pw.G.clear()
+        (cls_cap,) = run_tables(build(), record_stream=True)
+    finally:
+        vector_reduce.VECTOR_REDUCERS = saved
+
+    assert sorted(vec_cap.state.rows.items()) == sorted(
+        cls_cap.state.rows.items()
+    )
+    # both paths must emit minimal streams: identical per-key sequences
+    def per_key(stream):
+        out = {}
+        for t, (k, v, d) in stream:
+            out.setdefault(k, []).append((t, v, d))
+        return out
+
+    assert per_key(vec_cap.stream) == per_key(cls_cap.stream)
+
+
+def test_vector_absent_retraction_ignored():
+    """Retraction of a never-inserted key is dropped, as in the classic
+    node (bucket.pop miss)."""
+    events = [
+        (2, (ref_scalar(1), ("a", 5), 1)),
+        (4, (ref_scalar(99), ("a", 5), -1)),  # never inserted
+        (4, (ref_scalar(2), ("a", 7), 1)),
+    ]
+    t = table_from_events(schema_from_types(k=str, v=int), events)
+    res = t.groupby(t.k).reduce(
+        t.k, c=pw.reducers.count(), s=pw.reducers.sum(t.v)
+    )
+    assert _is_vector(res)
+    assert _rows(res) == [("a", 2, 12)]
+
+
+def test_vector_group_emptied_and_reborn():
+    key = ref_scalar(1)
+    events = [
+        (2, (key, ("a", 5), 1)),
+        (4, (key, ("a", 5), -1)),  # group empties
+        (6, (ref_scalar(2), ("a", 3), 1)),  # reborn
+    ]
+    t = table_from_events(schema_from_types(k=str, v=int), events)
+    res = t.groupby(t.k).reduce(
+        t.k, c=pw.reducers.count(), s=pw.reducers.sum(t.v),
+        hi=pw.reducers.max(t.v),
+    )
+    (cap,) = run_tables(res, record_stream=True)
+    assert sorted(cap.state.rows.values()) == [("a", 1, 3, 3)]
+    # the empty interval really retracted the group row
+    diffs = [d for _t, (_k, _v, d) in cap.stream]
+    assert diffs.count(-1) >= 1
+
+
+def test_vector_max_retract_extremum_rescan():
+    k1, k2, k3 = ref_scalar(1), ref_scalar(2), ref_scalar(3)
+    events = [
+        (2, (k1, ("a", 10), 1)),
+        (2, (k2, ("a", 7), 1)),
+        (2, (k3, ("a", 7), 1)),
+        (4, (k1, ("a", 10), -1)),  # retract the max -> rescan to 7
+    ]
+    t = table_from_events(schema_from_types(k=str, v=int), events)
+    res = t.groupby(t.k).reduce(t.k, hi=pw.reducers.max(t.v), lo=pw.reducers.min(t.v))
+    assert _rows(res) == [("a", 7, 7)]
+
+
+def test_vector_duplicate_value_multiplicity():
+    """Two rows with the same extremum value: retracting one keeps it."""
+    k1, k2 = ref_scalar(1), ref_scalar(2)
+    events = [
+        (2, (k1, ("a", 9), 1)),
+        (2, (k2, ("a", 9), 1)),
+        (4, (k1, ("a", 9), -1)),
+    ]
+    t = table_from_events(schema_from_types(k=str, v=int), events)
+    res = t.groupby(t.k).reduce(t.k, hi=pw.reducers.max(t.v))
+    assert _rows(res) == [("a", 9)]
+
+
+def test_vector_sum_big_ints_exact():
+    big = 1 << 80
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int),
+        [("a", big), ("a", big), ("a", 1)],
+    )
+    res = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+    assert _is_vector(res)
+    assert _rows(res) == [("a", 2 * big + 1)]
+
+
+def test_vector_sum_floats():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=float),
+        [("a", 0.5), ("a", 1.25), ("b", -2.0)],
+    )
+    res = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+    assert _rows(res) == [("a", 1.75), ("b", -2.0)]
+    # int-typed sums stay ints through the vector lane
+    pw.G.clear()
+    t2 = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int), [("a", 2), ("a", 3)]
+    )
+    res2 = t2.groupby(t2.k).reduce(t2.k, s=pw.reducers.sum(t2.v))
+    (cap,) = run_tables(res2)
+    (row,) = cap.state.rows.values()
+    assert row == ("a", 5) and type(row[1]) is int
+
+
+def test_vector_multi_column_grouping():
+    t = table_from_markdown(
+        """
+        a | b | v
+        x | 1 | 10
+        x | 1 | 20
+        x | 2 | 5
+        y | 1 | 7
+        """
+    )
+    res = t.groupby(t.a, t.b).reduce(
+        t.a, t.b, s=pw.reducers.sum(t.v), c=pw.reducers.count()
+    )
+    assert _is_vector(res)
+    assert set(_rows(res)) == {
+        ("x", 1, 30, 2), ("x", 2, 5, 1), ("y", 1, 7, 1)
+    }
+
+
+def test_vector_streaming_updates_minimal():
+    events = [
+        (2, (ref_scalar(1), ("a",), 1)),
+        (2, (ref_scalar(2), ("a",), 1)),
+        (4, (ref_scalar(3), ("a",), 1)),
+    ]
+    t = table_from_events(schema_from_types(k=str), events)
+    res = t.groupby(t.k).reduce(t.k, c=pw.reducers.count())
+    (cap,) = run_tables(res, record_stream=True)
+    stream = [(t_, v, d) for t_, (_k, v, d) in cap.stream]
+    assert stream == [
+        (2, ("a", 2), 1),
+        (4, ("a", 2), -1),
+        (4, ("a", 3), 1),
+    ]
+
+
+def test_grouping_bool_vs_int_not_aliased():
+    """dict equality says True == 1, but they are distinct group keys
+    (ref_scalar separates bool from numbers) — the group-key caches must
+    not merge them (review regression: ANY-typed group column)."""
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=pw.internals.dtype.ANY, v=int),
+        [(True, 10), (1, 20), (True, 30), (1.0, 40)],
+    )
+    res = t.groupby(t.k).reduce(t.k, c=pw.reducers.count(), s=pw.reducers.sum(t.v))
+    rows = _rows(res)
+    # True forms its own group; 1 and 1.0 share one (ref_scalar hashes
+    # integral floats and ints identically)
+    by_count = sorted((r[1], r[2]) for r in rows)
+    assert by_count == [(2, 40), (2, 60)], rows
+
+
+def test_grouping_bool_vs_int_streaming_cache_warm():
+    """Same aliasing check when the cache is warm from an earlier batch."""
+    events = [
+        (2, (ref_scalar(1), (True, 1), 1)),
+        (4, (ref_scalar(2), (1, 1), 1)),
+        (6, (ref_scalar(3), (True, 1), 1)),
+    ]
+    t = table_from_events(
+        schema_from_types(k=pw.internals.dtype.ANY, v=int), events
+    )
+    res = t.groupby(t.k).reduce(t.k, c=pw.reducers.count())
+    rows = _rows(res)
+    assert sorted(r[1] for r in rows) == [1, 2], rows
