@@ -187,7 +187,13 @@ class TcpCoordinator(Coordinator):
                 with self._cv:
                     if kind == "data":
                         _, channel, time, deltas = msg
-                        self._data.setdefault((channel, time), []).extend(deltas)
+                        # keep per-sender order: the merged batch is later
+                        # concatenated by worker id, which is deterministic
+                        # without any per-row sort (each sender's local
+                        # order is SPMD-deterministic)
+                        self._data.setdefault((channel, time), {}).setdefault(
+                            peer, []
+                        ).extend(deltas)
                     elif kind == "punct":
                         _, channel, time = msg
                         self._punct.setdefault((channel, time), set()).add(peer)
@@ -242,7 +248,7 @@ class TcpCoordinator(Coordinator):
 
     def collect(self, channel: int, time: int, timeout: float = 600.0) -> list:
         """Block until every peer punctuated channel@time; return received
-        deltas."""
+        deltas concatenated in sender-id order (deterministic merge)."""
         need = self.worker_count - 1
         deadline = time_mod.monotonic() + timeout
         with self._cv:
@@ -250,7 +256,11 @@ class TcpCoordinator(Coordinator):
                 got = self._punct.get((channel, time), set())
                 if len(got) >= need:
                     self._punct.pop((channel, time), None)
-                    return self._data.pop((channel, time), [])
+                    by_sender = self._data.pop((channel, time), {})
+                    out: list = []
+                    for sender in sorted(by_sender):
+                        out.extend(by_sender[sender])
+                    return out
                 if self._dead:
                     break
                 if not self._cv.wait(timeout=min(1.0, deadline - time_mod.monotonic())):
@@ -348,20 +358,23 @@ def _make_exchange_node():
                         parts[sh % w_count].append(d)
             for w in range(w_count):
                 if w != me and parts[w]:
-                    coord.send_data(w, self.channel, time, parts[w])
+                    # chunked sends bound peak pickle/socket buffers on
+                    # bulk-ingest batches (a single million-row message
+                    # costs hundreds of MB on both ends)
+                    part = parts[w]
+                    for s in range(0, len(part), 65536):
+                        coord.send_data(
+                            w, self.channel, time, part[s : s + 65536]
+                        )
             coord.punctuate(self.channel, time)
             received = coord.collect(self.channel, time)
-            combined = parts[me] + received
-            # deterministic cross-worker merge order (arrival order from N
-            # sockets is racy; order-sensitive consumers like deduplicate
-            # need a stable total order within the batch)
-            combined.sort(
-                key=lambda d: (
-                    0 if d[2] < 0 else 1,
-                    d[0].value if hasattr(d[0], "value") else 0,
-                )
-            )
-            self.emit(time, combined)
+            # deterministic merge without a per-row sort: received deltas
+            # arrive concatenated in sender-id order (each sender's local
+            # order is SPMD-deterministic), own part appended last — the
+            # same convention on every run.  Per-key retraction-before-
+            # insertion within the merged batch is restored by emit()'s
+            # consolidation.
+            self.emit(time, received + parts[me])
 
     return _ExchangeNode
 

@@ -100,6 +100,21 @@ class Pointer:
     def __hash__(self):
         return self._h
 
+    def __reduce__(self):
+        # exchange/persistence serialization: ship only the 128-bit value.
+        # _origin is a debug-repr nicety that can triple message size (it
+        # holds the values the key was derived from), and _h is recomputed
+        # by __init__.
+        return (Pointer, (self.value,))
+
+    def __setstate__(self, state):
+        # Pointers pickled before the _h slot existed restore via default
+        # slots-state without running __init__ — recompute the hash cache
+        slots = state[1] if isinstance(state, tuple) else state
+        self.value = slots["value"]
+        self._origin = slots.get("_origin")
+        self._h = slots.get("_h", hash(self.value))
+
     def __repr__(self):
         if self._origin is not None and len(self._origin) == 1:
             return f"^{self._origin[0]}"
@@ -164,24 +179,42 @@ def hash_values(*values: Any) -> int:
     return _hash_bytes(b"".join(out))
 
 
-_SEQ_MIX1 = 0x9E3779B97F4A7C15F39CC0605CEDC835
-_SEQ_MIX2 = 0xC6A4A7935BD1E995C2B2AE3D27D4EB4F
+_M64 = (1 << 64) - 1
+
+
+def _splitmix64(x: int) -> int:
+    """Standard splitmix64 finalizer (bijective on 64-bit ints)."""
+    x = (x + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
 
 
 def seq_key(seed: int, counter: int) -> Pointer:
-    """Auto-assigned connector row key: splitmix-style finalizer over a
-    per-source 128-bit seed and a sequential counter.  ~20x cheaper than
-    the blake2b in ref_scalar, bijective in `counter` for a fixed seed
-    (collision-free within a source), uniformly mixed so the low shard
-    bits balance across workers.  Stable across runs: the seed derives
-    from the source name and the counter is persisted subject state."""
-    x = (seed ^ ((counter + 1) * _SEQ_MIX2)) & _KEY_MASK
-    x ^= x >> 67
-    x = (x * _SEQ_MIX1) & _KEY_MASK
-    x ^= x >> 64
-    x = (x * _SEQ_MIX2) & _KEY_MASK
-    x ^= x >> 67
-    return Pointer(x)
+    """Auto-assigned connector row key: high 64 bits carry the source seed,
+    low 64 bits are splitmix64 of (counter ^ seed-low) — bijective in
+    `counter` for a fixed seed (collision-free within a source), uniformly
+    mixed so the low shard bits balance across workers, and ~50x cheaper
+    than the blake2b in ref_scalar.  Stable across runs: the seed derives
+    from the source name and the counter is persisted subject state.  The
+    batch variant (`seq_keys_batch`) computes the same keys vectorized."""
+    lo = _splitmix64((counter ^ seed) & _M64)
+    return Pointer(((seed >> 64) << 64) | lo)
+
+
+def seq_keys_batch(seed: int, start_counter: int, n: int) -> list:
+    """`[seq_key(seed, start_counter + 1 + i) for i in range(n)]`, with the
+    64-bit mixing done in one numpy pass."""
+    hi = (seed >> 64) << 64
+    with np.errstate(over="ignore"):
+        x = np.arange(
+            start_counter + 1, start_counter + n + 1, dtype=np.uint64
+        ) ^ np.uint64(seed & _M64)
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> np.uint64(31))
+    return [Pointer(hi | v) for v in x.tolist()]
 
 
 def seq_key_seed(*name_parts: Any) -> int:

@@ -61,12 +61,18 @@ class _VecSum:
     """sum: int64/float64 vector lanes with an exact object fallback.
 
     Totals live in a per-group Python list so int sums stay exact
-    arbitrary-precision (`_SumAcc` parity); the int64 vector lane is used
-    only while values are small enough that per-batch contributions cannot
-    overflow."""
+    arbitrary-precision (`_SumAcc` parity).  Lanes are gated on BOTH the
+    declared column dtype and the batch's natural numpy dtype: an
+    int column whose values exceed int64 range lands in uint64/float64
+    under `np.asarray` (silent wrap / precision loss), so anything that
+    does not convert to a clean matching kind takes the object lane."""
 
     kind = "sum"
     needs_col = True
+
+    def __init__(self, arg_kind: str = "i"):
+        # declared dtype kind: 'i' (int/bool) or 'f' (float)
+        self.arg_kind = arg_kind
 
     def state_init(self):
         # tot: per-group Python numbers; err: per-group Error multiplicity
@@ -78,18 +84,17 @@ class _VecSum:
             tot.append(0)
             err.append(0)
         n = len(col)
-        # lane dispatch on the column's NATURAL dtype: asarray with a
-        # forced dtype would silently truncate floats to ints; without
-        # one, big ints / None / Error land in object dtype and take the
-        # exact object lane
         try:
             arr0 = np.asarray(col)
             kind = arr0.dtype.kind
         except (TypeError, ValueError):
             kind = "O"
-        if kind in ("b", "i", "u"):
-            # int lane.  Per-batch contributions ride float64 inside
-            # bincount, so keep them provably below 2^52 for exactness
+        if self.arg_kind == "i" and kind in ("b", "i"):
+            # int lane — kind 'u' (values >= 2^63) and 'f' (mixed
+            # magnitudes promoted by asarray) would wrap or lose
+            # precision, so they fall through to the exact object lane.
+            # Per-batch contributions ride float64 inside bincount, so
+            # keep them provably below 2^52 for exactness.
             arr = arr0.astype(np.int64)
             if not n or int(np.abs(arr).max()) <= (1 << 52) // n:
                 contrib = np.bincount(
@@ -98,7 +103,7 @@ class _VecSum:
                 for g in np.nonzero(contrib)[0]:
                     tot[g] = tot[g] + int(contrib[g])
                 return
-        elif kind == "f":
+        elif self.arg_kind == "f" and kind in ("b", "i", "f"):
             contrib = np.bincount(
                 codes,
                 weights=arr0.astype(np.float64) * signs,
@@ -183,11 +188,11 @@ class _VecExtremum:
         return state["cur"][g]
 
 
-def make_vector_reducer(name: str):
+def make_vector_reducer(name: str, arg_kind: str = "i"):
     if name == "count":
         return _VecCount()
     if name == "sum":
-        return _VecSum()
+        return _VecSum(arg_kind)
     if name in ("min", "max"):
         return _VecExtremum(name)
     return None
@@ -215,6 +220,7 @@ class VectorReduceNode(Node):
         *,
         gval_width: int,
         group_col_progs: Optional[List[Callable]] = None,
+        arg_kinds: Optional[List[str]] = None,
     ):
         from pathway_tpu.engine.exchange import exchange_by_value
 
@@ -231,7 +237,10 @@ class VectorReduceNode(Node):
         # raw group-column programs enable the fused value->code lookup
         # (one dict get per row); None falls back to group_fn pairs
         self.group_col_progs = group_col_progs
-        self.vecs = [make_vector_reducer(r.name) for r in reducers]
+        kinds = arg_kinds or ["i"] * len(reducers)
+        self.vecs = [
+            make_vector_reducer(r.name, k) for r, k in zip(reducers, kinds)
+        ]
         assert all(v is not None for v in self.vecs)
         self.gid: Dict[Pointer, int] = {}
         self.gkeys: List[Pointer] = []

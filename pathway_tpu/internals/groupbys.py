@@ -214,12 +214,16 @@ class GroupedTable:
                 from pathway_tpu.engine.vector_reduce import VectorReduceNode
 
                 arg_col_fns = []
+                arg_kinds = []
                 for red in reducers:
                     if red._args:
                         prog = _compile_on(ctx, [source], red._args[0])
                         arg_col_fns.append(prog)
+                        adt = self._infer_on_source(red._args[0])
+                        arg_kinds.append("f" if adt == dt.FLOAT else "i")
                     else:
                         arg_col_fns.append(None)
+                        arg_kinds.append("i")
                 return VectorReduceNode(
                     ctx.engine,
                     node,
@@ -227,6 +231,7 @@ class GroupedTable:
                     [r._reducer for r in reducers],
                     arg_col_fns,
                     gval_width=n_group,
+                    arg_kinds=arg_kinds,
                     # fused raw-value -> group-code mapping works only for
                     # default-keyed grouping without instances, and (like
                     # key_cache) only when dict equality over the group

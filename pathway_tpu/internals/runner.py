@@ -73,6 +73,15 @@ def run_tables(
     return captures
 
 
+_last_engine = None
+
+
+def last_engine():
+    """The engine of the most recent pw.run in this process (benchmarks
+    and tests inspect coordinator/tick counters post-run)."""
+    return _last_engine
+
+
 def run(
     *,
     debug: bool = False,
@@ -83,9 +92,11 @@ def run(
 ) -> None:
     """pw.run — execute every registered sink (reference:
     internals/run.py:11)."""
+    global _last_engine
     from pathway_tpu.internals import telemetry
 
     engine = _make_engine()
+    _last_engine = engine
     ctx = RunContext(engine)
     with telemetry.span("graph_runner.build"):
         for sink in G.sinks:

@@ -201,7 +201,7 @@ class _StaticCollector:
         """Bulk insert: one pass over the batch instead of per-row calls.
         Keyless batches skip the dict entirely (seq keys cannot collide);
         `all_rows()` folds the logged batches back in."""
-        from pathway_tpu.engine.value import seq_key
+        from pathway_tpu.engine.value import seq_keys_batch
 
         values_list = _values_tuples(rows, self.names)
         if self.pk:
@@ -209,10 +209,8 @@ class _StaticCollector:
             keys = [ref_scalar(*(r.get(c) for c in pk)) for r in rows]
             self.rows.update(zip(keys, values_list))
         else:
-            seed = self._seed
-            c0 = self._counter
-            keys = [seq_key(seed, c0 + i + 1) for i in range(len(rows))]
-            self._counter = c0 + len(rows)
+            keys = seq_keys_batch(self._seed, self._counter, len(rows))
+            self._counter += len(rows)
             self._kv_log.append((values_list, keys))
 
     def all_rows(self) -> Dict[Pointer, tuple]:
@@ -354,7 +352,7 @@ class _QueueSink:
         explicit keys).  Contract: batches are homogeneous w.r.t.
         `_pw_key` — either every row carries one or none does (the
         readers guarantee this; schema-filtered rows never carry it)."""
-        from pathway_tpu.engine.value import seq_key
+        from pathway_tpu.engine.value import seq_keys_batch
 
         if self.live.sync_group is not None or (
             rows and "_pw_key" in rows[0]
@@ -367,10 +365,8 @@ class _QueueSink:
             pk = self.pk
             keys = [ref_scalar(*(r.get(c) for c in pk)) for r in rows]
         else:
-            seed = self._seed
-            c0 = self._counter
-            keys = [seq_key(seed, c0 + i + 1) for i in range(len(rows))]
-            self._counter = c0 + len(rows)
+            keys = seq_keys_batch(self._seed, self._counter, len(rows))
+            self._counter += len(rows)
             kv = self._keys_by_values
             for v, k in zip(values_list, keys):
                 kv.setdefault(_hashable(v), []).append(k)
@@ -580,6 +576,7 @@ class StreamingDriver:
             worker reaches the same tick — that is the frontier protocol."""
             nonlocal time, last_flush, last_snapshot, done
             nonlocal dirty_since_snapshot
+            self.engine.flush_ticks = getattr(self.engine, "flush_ticks", 0) + 1
             has_data = any(
                 (committed_upto.get(live, 0) > 0 or not gate_commits
                  or live not in ever_committed)
@@ -592,19 +589,30 @@ class StreamingDriver:
                 time_mod.monotonic() - last_snapshot
             ) >= snap_interval
             if multiworker:
-                # termination (and snapshot cadence) ride the vote so every
-                # worker exits/snapshots at the same round (a unilateral
-                # break would strand peers in agree() until the dead-peer
-                # timeout; a unilateral snapshot would diverge manifests)
+                # ONE agreement round per tick: termination, snapshot
+                # cadence AND the earliest scheduled temporal time all ride
+                # the same vote (a unilateral break would strand peers in
+                # agree(); a unilateral snapshot would diverge manifests;
+                # a separate global_next_time round would double the
+                # coordination cost of every idle tick)
                 votes = self.engine.coord.agree(
-                    (has_data, local_done, term, snap_due)
+                    (
+                        has_data,
+                        local_done,
+                        term,
+                        snap_due,
+                        self.engine.next_scheduled_time(),
+                    )
                 )
                 any_data = any(v[0] for v in votes)
                 done = all(v[1] for v in votes) or any(v[2] for v in votes)
                 snap_due = any(v[3] for v in votes)
+                nxt_votes = [v[4] for v in votes if v[4] is not None]
+                agreed_next = min(nxt_votes) if nxt_votes else None
             else:
                 any_data = has_data
                 done = local_done or term
+                agreed_next = self.engine.next_scheduled_time()
             if any_data:
                 for live in list(pending.keys()):
                     deltas = pending[live]
@@ -644,13 +652,20 @@ class StreamingDriver:
                 op_mgr.save(self.engine, time - 2, snapshot_writers)
                 last_snapshot = time_mod.monotonic()
                 dirty_since_snapshot = False
-            # run scheduled times that are due (global_next_time agrees, and
-            # every worker sees the same nxt sequence — lockstep preserved)
-            while True:
-                nxt = self.engine.global_next_time()
-                if nxt is None or nxt > time:
-                    break
+            # run scheduled times that are due.  Multi-worker: the first
+            # due time came from the tick vote (no extra round) — times
+            # scheduled DURING this tick surface on the next vote, one
+            # autocommit later, which keeps the agreement sequence
+            # identical on every worker.  Single-worker re-samples locally
+            # (free), so cascades still flush immediately.
+            nxt = (
+                agreed_next
+                if multiworker
+                else self.engine.next_scheduled_time()
+            )
+            while nxt is not None and nxt <= time:
                 self.engine.process_time(nxt)
+                nxt = self.engine.global_next_time()
             last_flush = time_mod.monotonic()
 
         while not done:

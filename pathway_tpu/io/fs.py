@@ -130,13 +130,35 @@ class _FsSubject(ConnectorSubjectBase):
                         objs = [loads(ln) for ln in block]
                     if plain:
                         # drop fields outside the schema (incl. _pw_key,
-                        # which the sink would honor as a raw engine key)
-                        rows = [
-                            obj
-                            if obj.keys() == names
-                            else {k: v for k, v in obj.items() if k in names}
-                            for obj in objs
-                        ]
+                        # which the sink would honor as a raw engine key);
+                        # schema-violating nested values (dict/list under a
+                        # scalar dtype) still go through coercion so they
+                        # reach the engine as hashable Json, as on the
+                        # non-plain path
+                        rows = []
+                        rows_append = rows.append
+                        for obj in objs:
+                            if any(
+                                type(v) is dict or type(v) is list
+                                for v in obj.values()
+                            ):
+                                rows_append(
+                                    {
+                                        k: coerce(v, schema[k].dtype)
+                                        for k, v in obj.items()
+                                        if k in names
+                                    }
+                                )
+                            elif obj.keys() == names:
+                                rows_append(obj)
+                            else:
+                                rows_append(
+                                    {
+                                        k: v
+                                        for k, v in obj.items()
+                                        if k in names
+                                    }
+                                )
                         if meta:
                             for row in rows:
                                 row.update(meta)

@@ -263,8 +263,8 @@ def test_compaction_base_preserves_history_when_restore_refused(tmp_path):
     # double-fold of already-compacted history)
     assert mgr.save(engine, time=20, writers={"src": writer})
     base2, _ = mgr.read_base("src")
-    assert sorted(base2, key=repr) == sorted(
-        [(k2, ("b",), 1), (k1, ("a2",), 1)], key=repr
+    assert sorted(base2, key=lambda d: d[0].value) == sorted(
+        [(k2, ("b",), 1), (k1, ("a2",), 1)], key=lambda d: d[0].value
     )
 
 
@@ -341,6 +341,6 @@ def test_segment_pointer_survives_full_compaction(tmp_path):
     # and the next save folds it into the base instead of deleting it
     assert mgr.save(engine, time=20, writers={"src": writer2})
     base, _ = mgr.read_base("src")
-    assert sorted(base, key=repr) == sorted(
-        [(k1, ("a",), 1), (k2, ("b",), 1)], key=repr
+    assert sorted(base, key=lambda d: d[0].value) == sorted(
+        [(k1, ("a",), 1), (k2, ("b",), 1)], key=lambda d: d[0].value
     )

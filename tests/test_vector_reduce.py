@@ -303,3 +303,41 @@ def test_grouping_bool_vs_int_streaming_cache_warm():
     res = t.groupby(t.k).reduce(t.k, c=pw.reducers.count())
     rows = _rows(res)
     assert sorted(r[1] for r in rows) == [1, 2], rows
+
+
+def test_vector_sum_int64_boundary_values():
+    """uint64/float64 promotion by np.asarray must not wrap or lose
+    precision — huge ints take the exact object lane (review regression)."""
+    pw.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int), [("a", 2**63)]
+    )
+    res = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+    assert _rows(res) == [("a", 2**63)]
+
+    pw.G.clear()
+    t2 = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int), [("a", 1), ("a", 2**64 - 1)]
+    )
+    res2 = t2.groupby(t2.k).reduce(t2.k, s=pw.reducers.sum(t2.v))
+    (cap,) = run_tables(res2)
+    (row,) = cap.state.rows.values()
+    assert row == ("a", 2**64) and type(row[1]) is int
+
+
+def test_pointer_unpickles_from_pre_hash_cache_state():
+    """Pointers pickled before the _h slot existed must restore (old
+    persisted event logs carry them)."""
+    import pickle
+
+    p = ref_scalar("x")
+    # emulate the old default slots-state pickle (no __reduce__, no _h)
+    old_style = pickle.loads(
+        pickle.dumps((None, {"value": p.value, "_origin": None}))
+    )
+    q = Pointer.__new__(Pointer)
+    q.__setstate__(old_style)
+    assert q == p and hash(q) == hash(p)
+
+
+from pathway_tpu.engine.value import Pointer  # noqa: E402
