@@ -310,6 +310,188 @@ class TcpCoordinator(Coordinator):
 
 
 # ---------------------------------------------------------------------------
+# In-process thread workers (workers = threads x processes; reference:
+# src/engine/dataflow/config.rs:89-97 — the reference builds
+# threads-per-process timely workers the same way)
+# ---------------------------------------------------------------------------
+
+
+class ThreadGroupCoordinator:
+    """Shared state for T thread-workers inside one process, optionally
+    bridged across processes by a TcpCoordinator.
+
+    Global worker id = process_id * T + thread_index; total workers =
+    T x processes.  Intra-process exchange stays in memory; cross-process
+    traffic multiplexes thread pairs onto the process mesh by widening the
+    channel id: wire(channel, dest_t, sender_t) = (channel*T + dest_t)*T
+    + sender_t, so per-sender streams stay segregated (deterministic
+    merges) and punctuation counts stay exact.
+
+    Agreement runs ONE TCP round per agree() regardless of T: threads
+    rendezvous on a barrier, thread 0 exchanges the aggregated local vote
+    list with peer processes, and the flattened result (global worker
+    order) is shared back through the barrier."""
+
+    def __init__(
+        self,
+        threads: int,
+        *,
+        tcp: Optional[TcpCoordinator] = None,
+        process_id: int = 0,
+    ):
+        self.threads = threads
+        self.tcp = tcp
+        self.processes = tcp.worker_count if tcp is not None else 1
+        self.process_id = tcp.worker_id if tcp is not None else process_id
+        self.total = threads * self.processes
+        self._cv = threading.Condition()
+        self._barrier = threading.Barrier(threads)
+        self._votes: List[Any] = [None] * threads
+        self._result: Any = None
+        self._aborted = False
+        # (dest_thread, channel, time) -> {sender_global: [deltas]}
+        self._data: Dict[tuple, dict] = {}
+        # (dest_thread, channel, time) -> {sender_global}
+        self._punct: Dict[tuple, set] = {}
+
+    def facade(self, thread_index: int) -> "_ThreadWorkerCoordinator":
+        return _ThreadWorkerCoordinator(self, thread_index)
+
+    def abort(self) -> None:
+        """Fail fast when a thread dies: break the barrier (wakes agree()
+        waiters) and flag + notify collect() waiters."""
+        self._aborted = True
+        self._barrier.abort()
+        with self._cv:
+            self._cv.notify_all()
+
+    # -- called by facades -------------------------------------------------
+    def agree(self, thread_index: int, payload: Any) -> List[Any]:
+        self._votes[thread_index] = payload
+        try:
+            idx = self._barrier.wait()
+            if idx == 0:
+                local = list(self._votes)
+                if self.tcp is not None:
+                    per_proc = self.tcp.agree(local)
+                    self._result = [
+                        v for proc_votes in per_proc for v in proc_votes
+                    ]
+                else:
+                    self._result = local
+            self._barrier.wait()
+        except threading.BrokenBarrierError:
+            raise ExchangeError(
+                f"thread worker {thread_index}: a sibling worker died"
+            ) from None
+        return self._result
+
+    def send_local(
+        self, dest_t: int, channel: int, time: int, sender: int, deltas: list
+    ) -> None:
+        with self._cv:
+            self._data.setdefault((dest_t, channel, time), {}).setdefault(
+                sender, []
+            ).extend(deltas)
+
+    def punct_local(
+        self, dest_t: int, channel: int, time: int, sender: int
+    ) -> None:
+        with self._cv:
+            self._punct.setdefault((dest_t, channel, time), set()).add(sender)
+            self._cv.notify_all()
+
+
+class _ThreadWorkerCoordinator(Coordinator):
+    """Coordinator facade for one thread-worker (see
+    ThreadGroupCoordinator)."""
+
+    def __init__(self, group: ThreadGroupCoordinator, thread_index: int):
+        self.group = group
+        self.thread_index = thread_index
+        self.worker_id = group.process_id * group.threads + thread_index
+        self.worker_count = group.total
+
+    def owns(self, shard: int) -> bool:
+        return shard % self.worker_count == self.worker_id
+
+    def agree(self, payload: Any) -> List[Any]:
+        return self.group.agree(self.thread_index, payload)
+
+    def _wire(self, channel: int, dest_t: int, sender_t: int) -> int:
+        T = self.group.threads
+        return (channel * T + dest_t) * T + sender_t
+
+    def send_data(self, dest: int, channel: int, time: int, deltas: list) -> None:
+        g = self.group
+        dest_p, dest_t = divmod(dest, g.threads)
+        if dest_p == g.process_id:
+            g.send_local(dest_t, channel, time, self.worker_id, deltas)
+        else:
+            g.tcp.send_data(
+                dest_p, self._wire(channel, dest_t, self.thread_index),
+                time, deltas,
+            )
+
+    def punctuate(self, channel: int, time: int) -> None:
+        g = self.group
+        for t2 in range(g.threads):
+            if t2 != self.thread_index:
+                g.punct_local(t2, channel, time, self.worker_id)
+        if g.tcp is not None:
+            for dest_t in range(g.threads):
+                g.tcp.punctuate(
+                    self._wire(channel, dest_t, self.thread_index), time
+                )
+
+    def collect(self, channel: int, time: int, timeout: float = 600.0) -> list:
+        g = self.group
+        me_t = self.thread_index
+        need_local = g.threads - 1
+        deadline = time_mod.monotonic() + timeout
+        key = (me_t, channel, time)
+        with g._cv:
+            while len(g._punct.get(key, ())) < need_local:
+                if g._aborted:
+                    raise ExchangeError(
+                        f"worker {self.worker_id}: a sibling worker died"
+                    )
+                if g.tcp is not None:
+                    g.tcp._check_dead()
+                if not g._cv.wait(
+                    timeout=min(1.0, deadline - time_mod.monotonic())
+                ):
+                    if time_mod.monotonic() >= deadline:
+                        raise ExchangeError(
+                            f"worker {self.worker_id}: timeout waiting for "
+                            f"local punctuation on channel {channel} @ "
+                            f"{time} (have "
+                            f"{sorted(g._punct.get(key, ()))})"
+                        )
+            local = g._data.pop(key, {})
+            g._punct.pop(key, None)
+        out: list = []
+        # deterministic merge: remote parts first (sender-thread-major,
+        # sender-process order inside — tcp.collect's own convention),
+        # then local parts by sender global id
+        if g.tcp is not None:
+            for sender_t in range(g.threads):
+                out.extend(
+                    g.tcp.collect(
+                        self._wire(channel, me_t, sender_t), time,
+                        timeout=max(1.0, deadline - time_mod.monotonic()),
+                    )
+                )
+        for sender in sorted(local):
+            out.extend(local[sender])
+        return out
+
+    def close(self) -> None:
+        if self.thread_index == 0 and self.group.tcp is not None:
+            self.group.tcp.close()
+
+
+# ---------------------------------------------------------------------------
 # ExchangeNode + routing helpers
 # ---------------------------------------------------------------------------
 

@@ -88,12 +88,24 @@ def run(
     monitoring_level=None,
     with_http_server: bool = False,
     persistence_config=None,
+    autocommit_duration_ms: float | None = None,
     **kwargs,
 ) -> None:
     """pw.run — execute every registered sink (reference:
     internals/run.py:11)."""
     global _last_engine
     from pathway_tpu.internals import telemetry
+    from pathway_tpu.internals.config import pathway_config as cfg
+
+    if cfg.threads > 1:
+        return _run_threaded(
+            cfg.threads,
+            monitoring_level=monitoring_level,
+            with_http_server=with_http_server,
+            persistence_config=persistence_config,
+            autocommit_duration_ms=autocommit_duration_ms,
+            **kwargs,
+        )
 
     engine = _make_engine()
     _last_engine = engine
@@ -117,7 +129,9 @@ def run(
             streaming=bool(G.sources),
         ):
             if G.sources:
-                _run_streaming(engine, ctx, persistence_config)
+                _run_streaming(
+                    engine, ctx, persistence_config, autocommit_duration_ms
+                )
             else:
                 engine.run_static()
     finally:
@@ -125,6 +139,98 @@ def run(
             monitor.stop()
         if http_server is not None:
             http_server.stop()
+
+
+def _run_threaded(
+    threads: int,
+    *,
+    monitoring_level=None,
+    with_http_server: bool = False,
+    persistence_config=None,
+    autocommit_duration_ms: float | None = None,
+    **kwargs,
+) -> None:
+    """workers = threads x processes (reference:
+    src/engine/dataflow/config.rs:89-97): every thread builds its own
+    engine over the shared parse graph and runs the same SPMD script;
+    intra-process exchange stays in memory, cross-process traffic rides
+    the process TCP mesh (engine/exchange.py ThreadGroupCoordinator)."""
+    global _last_engine
+    import threading as threading_mod
+
+    from pathway_tpu.engine.exchange import (
+        ThreadGroupCoordinator,
+        global_coordinator,
+    )
+    from pathway_tpu.internals.config import pathway_config as cfg
+    from pathway_tpu.internals.license import check_worker_count
+
+    check_worker_count(cfg.worker_count)
+    tcp = global_coordinator() if cfg.processes > 1 else None
+    group = ThreadGroupCoordinator(
+        threads, tcp=tcp, process_id=cfg.process_id
+    )
+    errors: list = []
+
+    build_lock = threading_mod.Lock()
+
+    def worker(thread_index: int) -> None:
+        global _last_engine
+        try:
+            engine = Engine(coord=group.facade(thread_index))
+            if thread_index == 0:
+                _last_engine = engine
+            # graph building mutates shared registries (G.sources) and
+            # runs user build closures — serialize it; execution below is
+            # the concurrent part
+            with build_lock:
+                ctx = RunContext(engine)
+                for sink in G.sinks:
+                    nodes = [ctx.node(t) for t in sink.tables]
+                    sink.attach(ctx, nodes)
+            _attach_monitoring(engine)
+            monitor = None
+            http_server = None
+            if thread_index == 0:
+                monitor = _maybe_start_dashboard(engine, monitoring_level)
+                if with_http_server:
+                    from pathway_tpu.internals.monitoring import (
+                        PrometheusServer,
+                    )
+
+                    http_server = PrometheusServer(
+                        engine, process_id=engine.worker_id
+                    )
+                    http_server.start()
+            try:
+                if G.sources:
+                    _run_streaming(
+                        engine, ctx, persistence_config,
+                        autocommit_duration_ms,
+                    )
+                else:
+                    engine.run_static()
+            finally:
+                if monitor is not None:
+                    monitor.stop()
+                if http_server is not None:
+                    http_server.stop()
+        except BaseException as exc:  # noqa: BLE001 — propagate to caller
+            errors.append(exc)
+            group.abort()
+
+    ts = [
+        threading_mod.Thread(
+            target=worker, args=(i,), name=f"pw-worker-{i}"
+        )
+        for i in range(threads)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errors:
+        raise errors[0]
 
 
 def _maybe_start_dashboard(engine: Engine, monitoring_level):
@@ -173,13 +279,21 @@ def _attach_monitoring(engine: Engine) -> None:
 
 
 def _run_streaming(
-    engine: Engine, ctx: RunContext, persistence_config=None
+    engine: Engine,
+    ctx: RunContext,
+    persistence_config=None,
+    autocommit_duration_ms: float | None = None,
 ) -> None:
     """Drive streaming sources: start connector threads, advance engine time
     as batches arrive (reference: Connector::run, src/connectors/mod.rs:523)."""
     from pathway_tpu.io._connector_runtime import StreamingDriver
 
     driver = StreamingDriver(
-        engine, ctx, persistence_config=persistence_config
+        engine,
+        ctx,
+        persistence_config=persistence_config,
+        autocommit_ms=(
+            100.0 if autocommit_duration_ms is None else autocommit_duration_ms
+        ),
     )
     driver.run(G.sources)

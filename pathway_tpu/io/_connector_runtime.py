@@ -132,6 +132,13 @@ def connector_table(
             ctx.engine, shard_filter=not (exclusive or partitioned)
         )
         live.node = node
+        # thread workers build one engine per thread from the same parse
+        # graph: the driver must resolve the node for ITS engine, not the
+        # last-built one
+        nodes = getattr(ctx.engine, "_live_nodes", None)
+        if nodes is None:
+            nodes = ctx.engine._live_nodes = {}
+        nodes[live] = node
         if live not in G.sources:
             G.add_source(live)
         if (exclusive or partitioned) and ctx.engine.worker_count > 1:
@@ -479,8 +486,13 @@ class StreamingDriver:
                 op_mgr.apply_states(self.engine, states)
                 restored_time = agreed
 
+        engine_nodes = getattr(self.engine, "_live_nodes", {})
+
+        def node_of(live):
+            return engine_nodes.get(live, live.node)
+
         for live in sources:
-            if live.node is None:
+            if node_of(live) is None:
                 continue  # source never built (tree-shaken)
             if live.exclusive and my_worker != live.exclusive_worker:
                 # exclusive sources (REST ingress, stateful custom subjects)
@@ -541,7 +553,7 @@ class StreamingDriver:
         time = 2 if restored_time is None else restored_time + 2
         if self.engine.global_any(bool(replayed)):
             for live, events in replayed.items():
-                live.node.push(time, events)
+                node_of(live).push(time, events)
             self.engine.process_time(time)
             time += 2
         for t in threads:
@@ -556,7 +568,7 @@ class StreamingDriver:
         snapshot_writers = {
             live.name: self._snapshot_writer(live)
             for live in sources
-            if live.node is not None and self._snapshot_writer(live) is not None
+            if node_of(live) is not None and self._snapshot_writer(live) is not None
         }
         multiworker = self.engine.worker_count > 1
         done = False
@@ -612,7 +624,7 @@ class StreamingDriver:
             else:
                 any_data = has_data
                 done = local_done or term
-                agreed_next = self.engine.next_scheduled_time()
+                agreed_next = None  # single-worker re-samples post-batch
             if any_data:
                 for live in list(pending.keys()):
                     deltas = pending[live]
@@ -640,7 +652,7 @@ class StreamingDriver:
                         state = states.pop(live, None) or {}
                         state["counter"] = counters.get(live, 0)
                         writer.write_batch(batch, state)
-                    live.node.push(time, batch)
+                    node_of(live).push(time, batch)
                 self.engine.process_time(time)
                 dirty_since_snapshot = True
                 time += 2
@@ -664,7 +676,11 @@ class StreamingDriver:
                 else self.engine.next_scheduled_time()
             )
             while nxt is not None and nxt <= time:
-                self.engine.process_time(nxt)
+                # the voted time was sampled pre-batch and may equal the
+                # batch time just processed — never reprocess a time (all
+                # workers share current_time, so the skip is lockstep-safe)
+                if nxt > self.engine.current_time:
+                    self.engine.process_time(nxt)
                 nxt = self.engine.global_next_time()
             last_flush = time_mod.monotonic()
 

@@ -20,3 +20,29 @@ class FakeObjectClient:
 
     def list(self, prefix):
         return [k for k in self.objects if k.startswith(prefix)]
+
+
+def free_port_base(n):
+    """Find n consecutive free localhost ports (worker i binds base+i)."""
+    import socket
+
+    for _ in range(50):
+        socks = []
+        try:
+            s0 = socket.socket()
+            s0.bind(("127.0.0.1", 0))
+            base = s0.getsockname()[1]
+            socks.append(s0)
+            if base + n >= 65535:
+                continue
+            for i in range(1, n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no consecutive free ports found")

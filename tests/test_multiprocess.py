@@ -23,28 +23,7 @@ import pytest
 REPO = Path(__file__).resolve().parent.parent
 
 
-def _free_port_base(n: int) -> int:
-    """Find n consecutive free localhost ports (worker i binds base+i)."""
-    for attempt in range(50):
-        socks = []
-        try:
-            s0 = socket.socket()
-            s0.bind(("127.0.0.1", 0))
-            base = s0.getsockname()[1]
-            socks.append(s0)
-            if base + n >= 65535:
-                continue
-            for i in range(1, n):
-                s = socket.socket()
-                s.bind(("127.0.0.1", base + i))
-                socks.append(s)
-            return base
-        except OSError:
-            continue
-        finally:
-            for s in socks:
-                s.close()
-    raise RuntimeError("no consecutive free ports found")
+from _fakes import free_port_base as _free_port_base
 
 
 def run_workers(
