@@ -21,6 +21,7 @@ Scheduling model:
 from __future__ import annotations
 
 import gc
+import os
 import threading
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -180,6 +181,10 @@ class Engine:
         self.error_log_nodes: List["ErrorLogNode"] = []
         self._scheduled_times: set[int] = set()
         self._gc_ticks = 0
+        # per-node wall-time introspection, enabled by env var
+        self._node_timing: dict | None = (
+            {} if os.environ.get("PATHWAY_NODE_TIMING_LOG") is not None else None
+        )
         self.current_time: int = 0
         self.stats_rows = 0
         self.now_fn: Callable[[], int] | None = None  # engine-time provider
@@ -236,15 +241,72 @@ class Engine:
     def process_time(self, time: int) -> None:
         self.current_time = time
         self._scheduled_times.discard(time)
-        try:
-            for node in self.nodes:
-                self.current_node = node
-                node.process(time)
-        finally:
-            self.current_node = None
+        if self._node_timing is not None:
+            self._process_time_instrumented(time)
+        else:
+            try:
+                for node in self.nodes:
+                    self.current_node = node
+                    node.process(time)
+            finally:
+                self.current_node = None
         for node in self.nodes:
             node.on_time_end(time)
         self._gc_pulse()
+
+    def _process_time_instrumented(self, time: int) -> None:
+        """PATHWAY_NODE_TIMING_LOG introspection (the reference's
+        DIFFERENTIAL_LOG_ADDR analogue, dataflow.rs:6489-6496): per-node
+        wall time and row counts accumulate per tick and dump as one JSON
+        line per node at finish()."""
+        import time as time_mod
+
+        timing = self._node_timing
+        try:
+            for idx, node in enumerate(self.nodes):
+                self.current_node = node
+                rows_before = self.stats_rows
+                t0 = time_mod.perf_counter()
+                node.process(time)
+                el = time_mod.perf_counter() - t0
+                ent = timing.get(idx)
+                if ent is None:
+                    ent = timing[idx] = {
+                        "node": idx,
+                        "name": node.name,
+                        "type": type(node).__name__,
+                        "calls": 0,
+                        "total_s": 0.0,
+                        "rows_out": 0,
+                    }
+                ent["calls"] += 1
+                ent["total_s"] += el
+                ent["rows_out"] += self.stats_rows - rows_before
+        finally:
+            self.current_node = None
+
+    def _dump_node_timing(self) -> None:
+        if not self._node_timing:
+            return
+        # idempotent: finish() may run more than once per engine
+        timing, self._node_timing = self._node_timing, {}
+        import json as json_mod
+        import sys
+
+        dest = os.environ.get("PATHWAY_NODE_TIMING_LOG", "")
+        lines = [
+            json_mod.dumps(
+                {**ent, "total_s": round(ent["total_s"], 6),
+                 "worker": self.worker_id}
+            )
+            for ent in timing.values()
+        ]
+        if dest in ("stderr", "-", ""):
+            for line in lines:
+                print(line, file=sys.stderr)
+        else:
+            with open(dest, "a") as fh:
+                fh.write("\n".join(lines) + "\n")
 
     def _gc_pulse(self) -> None:
         """Keep cyclic-GC pauses off the hot loop.  Engine state (delta
@@ -315,6 +377,8 @@ class Engine:
             self._drain()
         finally:
             self._gc_unfreeze()
+            if self._node_timing is not None:
+                self._dump_node_timing()
 
 
 # ---------------------------------------------------------------------------

@@ -76,13 +76,16 @@ class ExternalIndexNode(Node):
         data_width: int,
         as_of_now: bool = True,
     ):
-        # multi-worker: the index is a device-resident global structure —
-        # host it on worker 0 (host-level sharded search is a later
-        # optimization; TPU-mesh sharding lives inside ops/knn.py)
-        from pathway_tpu.engine.exchange import exchange_to_worker
+        # multi-worker: index updates BROADCAST so every worker maintains
+        # the full index and serves its own key-shard of the query stream
+        # locally — query throughput scales with workers instead of
+        # funneling through worker 0 (reference:
+        # src/engine/dataflow/operators/external_index.rs:13,70 broadcasts
+        # the index stream the same way).  TPU-mesh sharding of the index
+        # itself lives inside ops/knn.py, within each worker's device(s).
+        from pathway_tpu.engine.exchange import exchange_broadcast
 
-        data_node = exchange_to_worker(engine, data_node, 0)
-        query_node = exchange_to_worker(engine, query_node, 0)
+        data_node = exchange_broadcast(engine, data_node)
         super().__init__(engine, [data_node, query_node])
         self.index = index_impl
         self.data_value_prog = data_value_prog

@@ -53,6 +53,9 @@ class StatsMonitor:
     def refresh(self) -> None:
         self.stats.rows_processed = self.engine.stats_rows
         self.stats.current_time = self.engine.current_time
+        self.stats.input_latency_ms = getattr(
+            self.engine, "last_batch_latency_ms", None
+        )
 
     def render(self):
         from rich.table import Table as RichTable
@@ -61,8 +64,19 @@ class StatsMonitor:
         table = RichTable(title="pathway_tpu")
         table.add_column("metric")
         table.add_column("value")
-        for k, v in self.stats.snapshot().items():
+        snap = self.stats.snapshot()
+        if self.stats.input_latency_ms is not None:
+            snap["batch_latency_ms"] = round(self.stats.input_latency_ms, 2)
+        for k, v in snap.items():
             table.add_row(k, str(v))
+        # per-connector monitors (reference: connectors/monitoring.rs)
+        for name, cs in sorted(
+            getattr(self.engine, "connector_stats", {}).items()
+        ):
+            table.add_row(
+                f"source {name}",
+                f"rows={cs['rows_read']} pending={cs['pending']}",
+            )
         return table
 
     def start_live(self, refresh_per_second: float = 2.0):

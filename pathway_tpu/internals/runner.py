@@ -109,6 +109,7 @@ def run(
 
     engine = _make_engine()
     _last_engine = engine
+    telemetry.register_engine(engine)
     ctx = RunContext(engine)
     with telemetry.span("graph_runner.build"):
         for sink in G.sinks:
@@ -180,6 +181,9 @@ def _run_threaded(
             engine = Engine(coord=group.facade(thread_index))
             if thread_index == 0:
                 _last_engine = engine
+                from pathway_tpu.internals import telemetry as _tm
+
+                _tm.register_engine(engine)
             # graph building mutates shared registries (G.sources) and
             # runs user build closures — serialize it; execution below is
             # the concurrent part

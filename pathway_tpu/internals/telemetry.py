@@ -24,6 +24,7 @@ def set_monitoring_config(
     global _tracer
     _config["endpoint"] = server_endpoint
     _tracer = None  # rebuild lazily against the new endpoint
+    _meter_state["meter"] = None  # metrics too (a cached noop would stick)
 
 
 def _get_tracer():
@@ -82,3 +83,109 @@ def span(name: str, **attributes: Any):
             with contextlib.suppress(Exception):
                 s.set_attribute(key, value)
         yield s
+
+
+# ---------------------------------------------------------------------------
+# Metrics (reference: src/engine/telemetry.rs:49-58 — process memory/cpu,
+# input/output latency gauges over a periodic OTLP reader)
+# ---------------------------------------------------------------------------
+
+_meter_state: dict = {"meter": None, "engines": []}
+
+
+def register_engine(engine) -> None:
+    """Attach an engine's counters to the OTel gauges (no-op without an
+    endpoint or the OTel SDK).  Engines are held by weakref so repeated
+    runs in one process don't pin dead dataflow state, and gauge
+    callbacks only observe still-live engines."""
+    import weakref
+
+    refs = _meter_state["engines"]
+    refs[:] = [r for r in refs if r() is not None]
+    refs.append(weakref.ref(engine))
+    _ensure_meter()
+
+
+def _live_engines():
+    for r in _meter_state["engines"]:
+        eng = r()
+        if eng is not None:
+            yield eng
+
+
+def _ensure_meter():
+    if _meter_state["meter"] is not None:
+        return
+    endpoint = _config.get("endpoint")
+    if not endpoint:
+        _meter_state["meter"] = "noop"
+        return
+    try:
+        from opentelemetry.exporter.otlp.proto.grpc.metric_exporter import (
+            OTLPMetricExporter,
+        )
+        from opentelemetry.sdk.metrics import MeterProvider
+        from opentelemetry.sdk.metrics.export import (
+            PeriodicExportingMetricReader,
+        )
+
+        reader = PeriodicExportingMetricReader(
+            OTLPMetricExporter(endpoint=endpoint),
+            export_interval_millis=60_000,
+        )
+        provider = MeterProvider(metric_readers=[reader])
+        meter = provider.get_meter("pathway_tpu")
+
+        def _mem(_options):
+            import resource
+
+            from opentelemetry.metrics import Observation
+
+            usage = resource.getrusage(resource.RUSAGE_SELF)
+            yield Observation(usage.ru_maxrss * 1024)
+
+        def _cpu_user(_options):
+            from opentelemetry.metrics import Observation
+
+            yield Observation(os.times().user)
+
+        def _cpu_sys(_options):
+            from opentelemetry.metrics import Observation
+
+            yield Observation(os.times().system)
+
+        def _rows(_options):
+            from opentelemetry.metrics import Observation
+
+            for eng in _live_engines():
+                yield Observation(
+                    eng.stats_rows, {"worker": eng.worker_id}
+                )
+
+        def _latency(_options):
+            from opentelemetry.metrics import Observation
+
+            for eng in _live_engines():
+                lat = getattr(eng, "last_batch_latency_ms", None)
+                if lat is not None:
+                    yield Observation(lat, {"worker": eng.worker_id})
+
+        meter.create_observable_gauge(
+            "process.memory.usage", callbacks=[_mem], unit="By"
+        )
+        meter.create_observable_gauge(
+            "process.cpu.utime", callbacks=[_cpu_user], unit="s"
+        )
+        meter.create_observable_gauge(
+            "process.cpu.stime", callbacks=[_cpu_sys], unit="s"
+        )
+        meter.create_observable_gauge(
+            "engine.rows.processed", callbacks=[_rows]
+        )
+        meter.create_observable_gauge(
+            "latency.input", callbacks=[_latency], unit="ms"
+        )
+        _meter_state["meter"] = meter
+        _meter_state["provider"] = provider
+    except Exception:  # noqa: BLE001 — OTel not installed / endpoint down
+        _meter_state["meter"] = "noop"

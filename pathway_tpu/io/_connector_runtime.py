@@ -625,7 +625,9 @@ class StreamingDriver:
                 any_data = has_data
                 done = local_done or term
                 agreed_next = None  # single-worker re-samples post-batch
+            processed_batch = None
             if any_data:
+                flush_started = time_mod.monotonic()
                 for live in list(pending.keys()):
                     deltas = pending[live]
                     if not deltas:
@@ -654,7 +656,22 @@ class StreamingDriver:
                         writer.write_batch(batch, state)
                     node_of(live).push(time, batch)
                 self.engine.process_time(time)
+                # observability: batch latency + per-source read counters
+                # (reference: src/connectors/monitoring.rs surfaces the
+                # same per-connector numbers)
+                self.engine.last_batch_latency_ms = (
+                    time_mod.monotonic() - flush_started
+                ) * 1000.0
+                stats = getattr(self.engine, "connector_stats", None)
+                if stats is None:
+                    stats = self.engine.connector_stats = {}
+                for live_, cnt in counters.items():
+                    stats[live_.name] = {
+                        "rows_read": cnt,
+                        "pending": len(pending.get(live_, ())),
+                    }
                 dirty_since_snapshot = True
+                processed_batch = time
                 time += 2
             if snap_due and op_mgr is not None and dirty_since_snapshot:
                 # quiescent frontier: the last time is fully processed and
@@ -675,12 +692,17 @@ class StreamingDriver:
                 if multiworker
                 else self.engine.next_scheduled_time()
             )
+            first = True
             while nxt is not None and nxt <= time:
-                # the voted time was sampled pre-batch and may equal the
-                # batch time just processed — never reprocess a time (all
-                # workers share current_time, so the skip is lockstep-safe)
-                if nxt > self.engine.current_time:
+                # the voted time was sampled pre-batch: on the FIRST
+                # iteration it may equal the batch time just processed —
+                # skip that one (processed_batch and the vote are agreed,
+                # so every worker skips together).  Later iterations come
+                # from global_next_time over genuinely scheduled times
+                # (including cascades) and always process.
+                if not (first and nxt == processed_batch):
                     self.engine.process_time(nxt)
+                first = False
                 nxt = self.engine.global_next_time()
             last_flush = time_mod.monotonic()
 

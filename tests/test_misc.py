@@ -351,3 +351,67 @@ def test_license_entitlements_and_worker_cap():
         check_worker_count(64)  # unlimited with the entitlement
     finally:
         pw.set_license_key(None)
+
+
+def test_node_timing_introspection(tmp_path, monkeypatch):
+    """PATHWAY_NODE_TIMING_LOG dumps one JSON line per engine node with
+    wall time and row counts (the reference's DIFFERENTIAL_LOG_ADDR
+    analogue, dataflow.rs:6489-6496)."""
+    import json
+    import os
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals.runner import run_tables
+
+    log_path = str(tmp_path / "timing.jsonl")
+    monkeypatch.setenv("PATHWAY_NODE_TIMING_LOG", log_path)
+    t = pw.debug.table_from_markdown(
+        """
+        k | v
+        a | 1
+        a | 2
+        b | 5
+        """
+    )
+    res = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+    (cap,) = run_tables(res)
+    cap.engine.finish()  # run_tables' run_static already called it; idempotent
+    assert os.path.exists(log_path)
+    entries = [
+        json.loads(line)
+        for line in open(log_path)
+        if line.strip()
+    ]
+    assert any(e["name"] == "reduce" for e in entries)
+    assert all(
+        {"node", "name", "type", "calls", "total_s", "rows_out"} <= set(e)
+        for e in entries
+    )
+    reduce_entry = next(e for e in entries if e["name"] == "reduce")
+    assert reduce_entry["calls"] >= 1
+
+
+def test_connector_stats_surface():
+    """The streaming driver publishes per-source monitors + batch latency
+    (reference: src/connectors/monitoring.rs)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.runner import last_engine
+
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(5):
+                self.next(x=i)
+            self.commit()
+
+    class S(pw.Schema):
+        x: int
+
+    t = pw.io.python.read(Subject(), schema=S, name="monitored_src")
+    got = []
+    pw.io.subscribe(t, on_change=lambda *a, **k: got.append(1))
+    pw.run(monitoring_level=None, autocommit_duration_ms=20)
+    eng = last_engine()
+    stats = getattr(eng, "connector_stats", {})
+    assert "monitored_src" in stats, stats
+    assert stats["monitored_src"]["rows_read"] >= 5
+    assert getattr(eng, "last_batch_latency_ms", None) is not None
