@@ -149,8 +149,9 @@ class _SqlTranslator:
         if name not in self.tables:
             raise ValueError(f"unknown table {name!r}")
         table = self.tables[name]
+        alias = self._table_alias(tk, name)
         scope: Dict[str, Dict[str, str]] = {
-            name: {c: c for c in table.column_names()}
+            alias: {c: c for c in table.column_names()}
         }
         while True:
             how = None
@@ -172,6 +173,7 @@ class _SqlTranslator:
                 break
             other_name = tk.expect("ident")
             other = self.tables[other_name]
+            other_name = self._table_alias(tk, other_name)
             tk.expect("kw", "on")
             join_scope = dict(scope)
             join_scope[other_name] = {c: c for c in other.column_names()}
@@ -199,6 +201,16 @@ class _SqlTranslator:
             table = jr.select(**cols)
             scope[other_name] = other_mapping
         return table, scope
+
+    @staticmethod
+    def _table_alias(tk: _Tokens, name: str) -> str:
+        """`FROM sales AS s` / `FROM sales s` — the alias keys the scope."""
+        if tk.accept("kw", "as"):
+            return tk.expect("ident")
+        nxt = tk.peek()
+        if nxt is not None and nxt[0] == "ident":
+            return tk.next()[1]
+        return name
 
     # -- expression parsing (returns an AST of ('kind', ...) tuples) ------
     def expr(self, tk: _Tokens):

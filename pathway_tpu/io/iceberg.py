@@ -1,12 +1,18 @@
 """pw.io.iceberg — Apache Iceberg connector (reference:
 python/pathway/io/iceberg; Rust implementation
-src/connectors/data_lake/iceberg.rs — snapshot-based reads + appends).
+src/connectors/data_lake/iceberg.rs:1-560 — snapshot-based reads + appends
+over the Iceberg v2 table spec).
 
-Implemented natively over pyarrow.parquet with a simplified Iceberg-style
-metadata layout: `metadata/v<N>.metadata.json` holds the schema and the
-list of snapshots, each snapshot referencing a manifest (JSON list of data
-files). Round-trips with itself; the change stream carries the reference's
-`time`/`diff` columns.
+Implemented natively over pyarrow.parquet with spec-shaped v2 table
+metadata: `metadata/v<N>.metadata.json` carries table-uuid / schemas with
+field ids / partition-specs / sort-orders / sequence numbers /
+snapshot-log / metadata-log, each snapshot references a manifest LIST
+which references manifest files which reference parquet data files, and
+`version-hint.text` points catalogs at the current version.  Departure
+from full conformance (documented): manifest lists and manifests are JSON
+rather than Avro — the Avro container format needs an avro library this
+image does not ship; the FIELD contents follow the spec's names.  The
+change stream carries the reference's `time`/`diff` columns.
 """
 
 from __future__ import annotations
@@ -44,17 +50,56 @@ def _current_metadata(uri: str):
         return json.load(fh), v
 
 
+def _iceberg_type(dtype) -> str:
+    """pathway dtype -> Iceberg primitive type name (spec §Schemas)."""
+    core = dt.unoptionalize(dtype)
+    return {
+        dt.INT: "long",
+        dt.FLOAT: "double",
+        dt.BOOL: "boolean",
+        dt.STR: "string",
+        dt.BYTES: "binary",
+        dt.DATE_TIME_NAIVE: "timestamp",
+        dt.DATE_TIME_UTC: "timestamptz",
+        dt.DURATION: "long",
+    }.get(core, "string")
+
+
 class IcebergTableWriter(OutputWriter):
-    def __init__(self, uri: str, column_names: Sequence[str]):
+    """Appends change-stream batches as Iceberg v2 snapshots (reference:
+    iceberg.rs snapshot commit path)."""
+
+    def __init__(self, uri: str, column_names: Sequence[str], schema=None):
         import pyarrow  # noqa: F401
 
         self.uri = uri
         self.column_names = list(column_names)
+        self.schema = schema
         os.makedirs(os.path.join(uri, _META_DIR), exist_ok=True)
         os.makedirs(os.path.join(uri, _DATA_DIR), exist_ok=True)
         self._counter = 0
 
+    def _schema_fields(self) -> List[dict]:
+        fields = []
+        for i, name in enumerate(self.column_names, start=1):
+            ftype = "string"
+            if self.schema is not None and name in set(self.schema.keys()):
+                ftype = _iceberg_type(self.schema[name].dtype)
+            fields.append(
+                {"id": i, "name": name, "required": False, "type": ftype}
+            )
+        n = len(self.column_names)
+        fields.append(
+            {"id": n + 1, "name": "time", "required": True, "type": "long"}
+        )
+        fields.append(
+            {"id": n + 2, "name": "diff", "required": True, "type": "long"}
+        )
+        return fields
+
     def write_batch(self, events: Sequence[RowEvent]) -> None:
+        import uuid
+
         import pyarrow as pa
         import pyarrow.parquet as pq
 
@@ -67,30 +112,139 @@ class IcebergTableWriter(OutputWriter):
             cols["time"].append(ev.time)
             cols["diff"].append(ev.diff)
         self._counter += 1
+        now_ms = int(time_mod.time() * 1000)
         fname = os.path.join(
-            _DATA_DIR, f"data-{int(time_mod.time() * 1e6)}-{self._counter:05d}.parquet"
+            _DATA_DIR,
+            f"data-{int(time_mod.time() * 1e6)}-{self._counter:05d}.parquet",
         )
-        pq.write_table(pa.table(cols), os.path.join(self.uri, fname))
+        data_path = os.path.join(self.uri, fname)
+        pq.write_table(pa.table(cols), data_path)
+        file_size = os.path.getsize(data_path)
 
         meta, version = _current_metadata(self.uri)
+        new_version = version + 1
         if meta is None:
-            meta = {"format-version": 2, "snapshots": []}
-        manifest_name = os.path.join(_META_DIR, f"manifest-{version + 1}.json")
+            meta = {
+                "format-version": 2,
+                "table-uuid": str(uuid.uuid4()),
+                "location": os.path.abspath(self.uri),
+                "last-sequence-number": 0,
+                "last-updated-ms": now_ms,
+                "last-column-id": len(self.column_names) + 2,
+                "schemas": [
+                    {
+                        "schema-id": 0,
+                        "type": "struct",
+                        "fields": self._schema_fields(),
+                    }
+                ],
+                "current-schema-id": 0,
+                "partition-specs": [{"spec-id": 0, "fields": []}],
+                "default-spec-id": 0,
+                "last-partition-id": 999,
+                "sort-orders": [{"order-id": 0, "fields": []}],
+                "default-sort-order-id": 0,
+                "properties": {"write.format.default": "parquet"},
+                "current-snapshot-id": -1,
+                "snapshots": [],
+                "snapshot-log": [],
+                "metadata-log": [],
+            }
+        seq = meta.get("last-sequence-number", 0) + 1
+        snapshot_id = uuid.uuid4().int >> 65  # spec: arbitrary unique i64
+        parent = meta.get("current-snapshot-id", -1)
+
+        # manifest: one entry per data file (spec's manifest_entry fields;
+        # JSON container — see module docstring)
+        manifest_name = os.path.join(
+            _META_DIR, f"manifest-{snapshot_id}.json"
+        )
+        manifest_entries = [
+            {
+                "status": 1,  # ADDED
+                "snapshot_id": snapshot_id,
+                "sequence_number": seq,
+                "data_file": {
+                    "content": 0,  # DATA
+                    "file_path": fname,
+                    "file_format": "PARQUET",
+                    "partition": {},
+                    "record_count": len(events),
+                    "file_size_in_bytes": file_size,
+                },
+            }
+        ]
         with open(os.path.join(self.uri, manifest_name), "w") as fh:
-            json.dump({"data_files": [fname]}, fh)
+            json.dump({"entries": manifest_entries}, fh)
+        manifest_len = os.path.getsize(os.path.join(self.uri, manifest_name))
+
+        # manifest list: one entry per manifest (spec's manifest_file)
+        mlist_name = os.path.join(
+            _META_DIR, f"snap-{snapshot_id}-manifest-list.json"
+        )
+        with open(os.path.join(self.uri, mlist_name), "w") as fh:
+            json.dump(
+                {
+                    "manifests": [
+                        {
+                            "manifest_path": manifest_name,
+                            "manifest_length": manifest_len,
+                            "partition_spec_id": 0,
+                            "content": 0,
+                            "sequence_number": seq,
+                            "added_snapshot_id": snapshot_id,
+                            "added_files_count": 1,
+                            "existing_files_count": 0,
+                            "deleted_files_count": 0,
+                            "added_rows_count": len(events),
+                        }
+                    ]
+                },
+                fh,
+            )
+
         meta["snapshots"].append(
             {
-                "snapshot-id": version + 1,
-                "timestamp-ms": int(time_mod.time() * 1000),
-                "manifest": manifest_name,
+                "snapshot-id": snapshot_id,
+                "parent-snapshot-id": parent if parent != -1 else None,
+                "sequence-number": seq,
+                "timestamp-ms": now_ms,
+                "manifest-list": mlist_name,
+                "summary": {
+                    "operation": "append",
+                    "added-data-files": "1",
+                    "added-records": str(len(events)),
+                },
+                "schema-id": 0,
             }
         )
-        meta["current-snapshot-id"] = version + 1
-        path = os.path.join(self.uri, _META_DIR, f"v{version + 1}.metadata.json")
+        meta["current-snapshot-id"] = snapshot_id
+        meta["last-sequence-number"] = seq
+        meta["last-updated-ms"] = now_ms
+        meta.setdefault("snapshot-log", []).append(
+            {"snapshot-id": snapshot_id, "timestamp-ms": now_ms}
+        )
+        if version:
+            meta.setdefault("metadata-log", []).append(
+                {
+                    "metadata-file": os.path.join(
+                        _META_DIR, f"v{version}.metadata.json"
+                    ),
+                    "timestamp-ms": now_ms,
+                }
+            )
+        path = os.path.join(
+            self.uri, _META_DIR, f"v{new_version}.metadata.json"
+        )
         tmp = path + ".tmp"
         with open(tmp, "w") as fh:
             json.dump(meta, fh)
         os.rename(tmp, path)
+        # catalogs resolve the current version through the hint file
+        hint = os.path.join(self.uri, _META_DIR, "version-hint.text")
+        with open(hint + ".tmp", "w") as fh:
+            fh.write(str(new_version))
+        os.rename(hint + ".tmp", hint)
 
 
 def write(
@@ -109,7 +263,13 @@ def write(
     uri = warehouse or catalog_uri
     if namespace or table_name:
         uri = os.path.join(uri, *(namespace or []), table_name or "")
-    attach_writer(table, IcebergTableWriter(uri, table.column_names()), name=name)
+    attach_writer(
+        table,
+        IcebergTableWriter(
+            uri, table.column_names(), schema=getattr(table, "schema", None)
+        ),
+        name=name,
+    )
 
 
 class _IcebergSubject(ConnectorSubjectBase):
@@ -134,9 +294,25 @@ class _IcebergSubject(ConnectorSubjectBase):
             if sid in self._seen_snapshots:
                 continue
             self._seen_snapshots.add(sid)
-            with open(os.path.join(self.uri, snap["manifest"])) as fh:
-                manifest = json.load(fh)
-            for fname in manifest.get("data_files", []):
+            data_files: List[str] = []
+            if "manifest-list" in snap:
+                with open(os.path.join(self.uri, snap["manifest-list"])) as fh:
+                    mlist = json.load(fh)
+                for mf in mlist.get("manifests", []):
+                    with open(
+                        os.path.join(self.uri, mf["manifest_path"])
+                    ) as fh:
+                        manifest = json.load(fh)
+                    for entry in manifest.get("entries", []):
+                        if entry.get("status") != 2:  # not DELETED
+                            data_files.append(
+                                entry["data_file"]["file_path"]
+                            )
+            else:  # pre-spec layout written by older versions
+                with open(os.path.join(self.uri, snap["manifest"])) as fh:
+                    manifest = json.load(fh)
+                data_files = manifest.get("data_files", [])
+            for fname in data_files:
                 for rec in pq.read_table(os.path.join(self.uri, fname)).to_pylist():
                     row = {
                         k: _coerce_delta(rec.get(k), self.schema[k].dtype)

@@ -209,13 +209,24 @@ def encode_batch(
     batch = len(encoded)
     padded_batch = bucket_length(max(batch, 1), minimum=8, maximum=1 << 16) if batch_bucket else batch
     pad_id = getattr(tokenizer, "pad_id", PAD_ID)
-    ids = np.full((padded_batch, seq_len), pad_id, dtype=np.int32)
-    mask = np.zeros((padded_batch, seq_len), dtype=np.int32)
+    dtype = _wire_dtype(tokenizer)
+    ids = np.full((padded_batch, seq_len), pad_id, dtype=dtype)
+    mask = np.zeros((padded_batch, seq_len), dtype=dtype)
     for i, e in enumerate(encoded):
         e = e[:seq_len]
         ids[i, : len(e)] = e
         mask[i, : len(e)] = 1
     return ids, mask
+
+
+def _wire_dtype(tokenizer):
+    """int16 halves the host->device transfer of every token batch — the
+    dominant upload on a tunneled chip; XLA gathers cast indices anyway.
+    Falls back to int32 for vocabularies beyond int16 range."""
+    nvocab = getattr(tokenizer, "vocab_size", None)
+    if nvocab is None:
+        nvocab = len(getattr(tokenizer, "vocab", ())) or (1 << 31)
+    return np.int16 if nvocab < (1 << 15) else np.int32
 
 
 def _try_native(tokenizer, texts, max_len, batch_bucket):
@@ -238,8 +249,9 @@ def _try_native(tokenizer, texts, max_len, batch_bucket):
     ids_full, mask_full = result
     longest = int(mask_full.sum(axis=1).max()) if batch else 1
     seq_len = bucket_length(max(longest, 1), maximum=max_len)
-    ids = np.full((padded_batch, seq_len), PAD_ID, dtype=np.int32)
-    mask = np.zeros((padded_batch, seq_len), dtype=np.int32)
+    dtype = _wire_dtype(tokenizer)
+    ids = np.full((padded_batch, seq_len), PAD_ID, dtype=dtype)
+    mask = np.zeros((padded_batch, seq_len), dtype=dtype)
     ids[:batch] = ids_full[:, :seq_len]
     mask[:batch] = mask_full[:, :seq_len]
     return ids, mask

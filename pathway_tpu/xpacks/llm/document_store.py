@@ -270,6 +270,16 @@ class DocumentStoreClient:
 
 
 def _merge_meta(doc_meta, part_meta) -> Json:
+    # bulk-ingest fast path: parsers without per-chunk metadata (Utf8 on
+    # the hot path) pass the document metadata through untouched — no new
+    # Json per row.  Only for dict-valued metadata: non-dicts must still
+    # normalize to Json({}) like the slow path.
+    if (
+        (not part_meta or (isinstance(part_meta, Json) and not part_meta.value))
+        and isinstance(doc_meta, Json)
+        and isinstance(doc_meta.value, dict)
+    ):
+        return doc_meta
     base = doc_meta.value if isinstance(doc_meta, Json) else (doc_meta or {})
     extra = part_meta.value if isinstance(part_meta, Json) else (part_meta or {})
     if not isinstance(base, dict):

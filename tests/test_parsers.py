@@ -1,0 +1,237 @@
+"""Parser depth: real bytes through every chunking mode + PDF cleanup
+(reference: python/pathway/xpacks/llm/parsers.py:87-330, 1019-1093)."""
+
+import zlib
+
+import pytest
+
+from pathway_tpu.xpacks.llm.parsers import (
+    CHUNKING_MODES,
+    DoclingParser,
+    Element,
+    PypdfParser,
+    UnstructuredParser,
+    Utf8Parser,
+    chunk,
+    clean_pdf_text,
+    extract_pdf_text_builtin,
+    partition_builtin,
+)
+
+MARKDOWN_DOC = b"""# Introduction
+
+Streaming dataflow engines process unbounded inputs incrementally.
+They maintain operator state across batches.
+
+## Architecture
+
+The engine shards rows by key across workers.
+
+- exchange by key
+- reduce per group
+
+# Evaluation
+
+Throughput is measured on a five million row harness.
+"""
+
+HTML_DOC = b"""<!doctype html>
+<html><head><title>t</title><style>p {color: red}</style></head>
+<body>
+<h1>Release Notes</h1>
+<p>The engine now vectorizes reductions.</p>
+<h2>Performance</h2>
+<p>Wordcount runs at hundreds of thousands of rows per second.</p>
+<ul><li>faster consolidate</li><li>cheaper keys</li></ul>
+<script>ignored()</script>
+</body></html>
+"""
+
+
+def test_partition_markdown_titles_and_lists():
+    els = partition_builtin(MARKDOWN_DOC)
+    cats = [(e.category, e.text) for e in els]
+    titles = [t for c, t in cats if c == "Title"]
+    assert titles == ["Introduction", "Architecture", "Evaluation"]
+    assert any(c == "ListItem" and t == "exchange by key" for c, t in cats)
+    assert any("incrementally" in t for c, t in cats if c == "NarrativeText")
+
+
+def test_partition_html_strips_script_and_style():
+    els = partition_builtin(HTML_DOC)
+    text = " ".join(e.text for e in els)
+    assert "ignored()" not in text and "color" not in text
+    assert [e.text for e in els if e.category == "Title"] == [
+        "Release Notes",
+        "Performance",
+    ]
+    assert sum(1 for e in els if e.category == "ListItem") == 2
+
+
+def test_chunking_mode_single():
+    parser = UnstructuredParser(chunking_mode="single")
+    (doc,) = parser.func(MARKDOWN_DOC)
+    text, meta = doc
+    assert "Introduction" in text and "five million" in text
+    assert meta["category"] == ["Title", "NarrativeText", "ListItem"]
+
+
+def test_chunking_mode_elements():
+    parser = UnstructuredParser(chunking_mode="elements")
+    docs = parser.func(HTML_DOC)
+    assert len(docs) >= 5
+    assert ("Release Notes", ) == (docs[0][0],)
+    assert docs[0][1]["category"] == "Title"
+
+
+def test_chunking_mode_by_title():
+    parser = UnstructuredParser(chunking_mode="by_title")
+    docs = parser.func(MARKDOWN_DOC)
+    # sections: Introduction(+Architecture? no — every Title starts one)
+    first_words = [d[0].split("\n")[0] for d in docs]
+    assert first_words[0].startswith("Introduction")
+    assert any(d[0].startswith("Architecture") for d in docs)
+    assert any(d[0].startswith("Evaluation") for d in docs)
+
+
+def test_chunking_mode_basic_packs_to_budget():
+    parser = UnstructuredParser(
+        chunking_mode="basic", chunking_kwargs={"max_characters": 120}
+    )
+    docs = parser.func(MARKDOWN_DOC)
+    assert len(docs) >= 3
+    assert all(len(text) <= 120 for text, _m in docs)
+    # nothing lost
+    joined = " ".join(t for t, _ in docs)
+    assert "Introduction" in joined and "harness" in joined
+
+
+def test_chunking_mode_paged():
+    paged_doc = b"page one text\n\x0cpage two text\n"
+    parser = UnstructuredParser(chunking_mode="paged")
+    docs = parser.func(paged_doc)
+    assert len(docs) == 2
+    assert "page one" in docs[0][0] and docs[0][1]["page_number"] == 1
+    assert "page two" in docs[1][0] and docs[1][1]["page_number"] == 2
+
+
+def test_chunking_mode_validation():
+    with pytest.raises(ValueError):
+        UnstructuredParser(chunking_mode="nope")
+
+
+def test_post_processors_apply():
+    parser = UnstructuredParser(
+        chunking_mode="single", post_processors=[str.upper]
+    )
+    (doc,) = parser.func(b"hello world")
+    assert doc[0] == "HELLO WORLD"
+
+
+def _tiny_pdf(lines, compress=False) -> bytes:
+    """Hand-assembled single-page PDF with Tj text operators."""
+    content = b"BT /F1 12 Tf 50 700 Td " + b" ".join(
+        b"(%s) Tj 0 -14 Td" % ln.encode("latin-1") for ln in lines
+    ) + b" ET"
+    if compress:
+        body = zlib.compress(content)
+        filt = b"/Filter /FlateDecode "
+    else:
+        body = content
+        filt = b""
+    objs = [
+        b"1 0 obj << /Type /Catalog /Pages 2 0 R >> endobj",
+        b"2 0 obj << /Type /Pages /Kids [3 0 R] /Count 1 >> endobj",
+        b"3 0 obj << /Type /Page /Parent 2 0 R /Contents 4 0 R "
+        b"/MediaBox [0 0 612 792] >> endobj",
+        b"4 0 obj << %s/Length %d >> stream\n%s\nendstream endobj"
+        % (filt, len(body), body),
+    ]
+    return b"%PDF-1.4\n" + b"\n".join(objs) + b"\n%%EOF"
+
+
+def test_pdf_builtin_extraction_plain_and_flate():
+    for compress in (False, True):
+        pdf = _tiny_pdf(
+            ["Incremental data-", "flow engines main-", "tain state."],
+            compress=compress,
+        )
+        pages = extract_pdf_text_builtin(pdf)
+        assert len(pages) == 1
+        assert "Incremental" in pages[0]
+
+
+def test_pypdf_parser_cleanup_end_to_end():
+    pdf = _tiny_pdf(["Incremental data-", "flow engines are", "fast."])
+    parser = PypdfParser(apply_text_cleanup=True)
+    docs = parser.func(pdf)
+    assert len(docs) == 1
+    text, meta = docs[0]
+    # hyphenated line break rejoined, wrapped lines unwrapped
+    assert "dataflow engines are fast." in text
+    assert meta == {"page": 0}
+    # cleanup off keeps the raw break
+    raw_docs = PypdfParser(apply_text_cleanup=False).func(pdf)
+    assert "data-" in raw_docs[0][0]
+
+
+def test_clean_pdf_text_rules():
+    assert clean_pdf_text("data-\nflow") == "dataflow"
+    assert clean_pdf_text("line one\nline two") == "line one line two"
+    assert clean_pdf_text("End.\nNew sentence") == "End.\nNew sentence"
+    assert clean_pdf_text("a   b\t c") == "a b c"
+
+
+def test_docling_genuinely_gated():
+    parser = DoclingParser()
+    try:
+        import docling  # noqa: F401
+
+        has_docling = True
+    except ImportError:
+        has_docling = False
+    if not has_docling:
+        with pytest.raises(ImportError, match="docling"):
+            parser.func(b"%PDF-1.4")
+
+
+def test_utf8_parser_batched():
+    parser = Utf8Parser()
+    out = parser.func([b"abc", "def", b"\xff\xfe"])
+    assert out[0] == [("abc", {})]
+    assert out[1] == [("def", {})]
+    assert isinstance(out[2][0][0], str)
+
+
+def test_chunk_modes_cover_all():
+    els = [Element("T", "Title", 1), Element("body text", "NarrativeText", 1)]
+    for mode in CHUNKING_MODES:
+        docs = chunk(els, mode)
+        assert docs and all(isinstance(t, str) for t, _ in docs)
+
+
+def test_pdf_octal_escapes():
+    from pathway_tpu.xpacks.llm.parsers import _pdf_unescape
+
+    assert _pdf_unescape(rb"ab\8cd") == "ab8cd"  # \8 invalid octal: dropped escape? no — digit path
+    # 1- and 2-digit octal escapes terminated by non-digits
+    assert _pdf_unescape(rb"a\0x") == "a\x00x"
+    assert _pdf_unescape(rb"a\12x") == "a\nx"
+    assert _pdf_unescape(rb"a\101b") == "aAb"
+
+
+def test_partition_html_without_bs4(monkeypatch):
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_bs4(name, *a, **k):
+        if name.startswith("bs4"):
+            raise ImportError("no bs4")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_bs4)
+    els = partition_builtin(HTML_DOC)
+    text = " ".join(e.text for e in els)
+    assert "vectorizes reductions" in text
+    assert "ignored()" not in text
