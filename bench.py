@@ -281,7 +281,7 @@ def main() -> None:
                 "device_rtt_floor_ms": round(rtt, 2),
                 "n_docs": N_DOCS,
                 "device": _device_name(),
-                **_mfu_facts(docs_per_sec),
+                **_mfu_facts(docs_per_sec, docs),
             }
         )
     )
@@ -296,26 +296,37 @@ def _device_name() -> str:
         return "unknown"
 
 
-def _mfu_facts(docs_per_sec: float) -> dict:
-    """tokens/s and achieved MFU of the ingest phase, computed from the
-    encoder's actual config (per-token forward FLOPs ~= per-layer
-    2*(4*h^2 attention projections + 2*h*ffn MLP) + attention scores)."""
+def _mfu_facts(docs_per_sec: float, docs: list[str]) -> dict:
+    """tokens/s and achieved MFU of the ingest phase.  Tokens/doc is the
+    REAL mask count from tokenizing the benchmark corpus (not max_len —
+    bucketing pads, but padding is not useful work); per-token forward
+    FLOPs ~= per-layer 2*(4*h^2 attention projections + 2*h*ffn MLP) +
+    attention scores at the actual sequence length."""
     from pathway_tpu.models.minilm import SentenceEncoder
+    from pathway_tpu.models.tokenizer import encode_batch
 
     enc = SentenceEncoder.cached("all-MiniLM-L6-v2", max_len=64)
     cfg = enc.config
     h = cfg.hidden
     ffn = cfg.mlp_dim
     layers = cfg.layers
-    seq = enc.max_len
+    sample = docs[:512]
+    _ids, mask = encode_batch(
+        enc.tokenizer, sample, max_len=enc.max_len
+    )
+    tokens_per_doc = float(np.asarray(mask, dtype=np.float64).sum()) / len(
+        sample
+    )
+    seq = tokens_per_doc
     per_token = layers * (
         2 * (4 * h * h + 2 * h * ffn)  # qkvo projections + mlp
         + 2 * 2 * seq * h  # attention scores + mix (per token, s*h each)
     )
-    tokens_per_sec = docs_per_sec * seq
+    tokens_per_sec = docs_per_sec * tokens_per_doc
     flops = tokens_per_sec * per_token
     peak = _device_peak_flops()
     return {
+        "tokens_per_doc": round(tokens_per_doc, 1),
         "tokens_per_sec": round(tokens_per_sec),
         "model_tflops_per_sec": round(flops / 1e12, 2),
         "mfu_pct": round(100.0 * flops / peak, 2) if peak else None,

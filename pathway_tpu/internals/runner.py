@@ -110,6 +110,8 @@ def run(
     engine = _make_engine()
     _last_engine = engine
     telemetry.register_engine(engine)
+    # static connector builds need it (object cache binding at build time)
+    engine._persistence_config = persistence_config
     ctx = RunContext(engine)
     with telemetry.span("graph_runner.build"):
         for sink in G.sinks:
@@ -179,6 +181,7 @@ def _run_threaded(
         global _last_engine
         try:
             engine = Engine(coord=group.facade(thread_index))
+            engine._persistence_config = persistence_config
             if thread_index == 0:
                 _last_engine = engine
                 from pathway_tpu.internals import telemetry as _tm

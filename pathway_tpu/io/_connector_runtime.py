@@ -119,6 +119,13 @@ def connector_table(
             subject = subject_factory()
             collector = _StaticCollector(schema)
             subject._bind(collector)
+            pcfg = getattr(ctx.engine, "_persistence_config", None)
+            if pcfg is not None:
+                from pathway_tpu.persistence import CachedObjectStorage
+
+                subject._bind_object_cache(
+                    CachedObjectStorage(pcfg.backend._backend, name)
+                )
             subject.run()
             subject.on_stop()
             return StaticSource(ctx.engine, collector.all_rows())
@@ -243,9 +250,16 @@ class ConnectorSubjectBase:
     def __init__(self):
         self._sink = None
         self._closed = False
+        self._object_cache = None  # CachedObjectStorage under persistence
 
     def _bind(self, sink) -> None:
         self._sink = sink
+
+    def _bind_object_cache(self, cache) -> None:
+        """Persistence-backed source-object cache (reference:
+        cached_object_storage.rs): downloading connectors consult it to
+        skip re-fetching unchanged objects after a restart."""
+        self._object_cache = cache
 
     # -- API used by subclasses ------------------------------------------
     def next(self, **kwargs) -> None:
@@ -504,6 +518,14 @@ class StreamingDriver:
             sink.subject = subject
             sink.persistence_enabled = self.persistence_config is not None
             subject._bind(sink)
+            if self.persistence_config is not None:
+                from pathway_tpu.persistence import CachedObjectStorage
+
+                subject._bind_object_cache(
+                    CachedObjectStorage(
+                        self.persistence_config.backend._backend, live.name
+                    )
+                )
             writer = self._snapshot_writer(live)
             if writer is not None:
                 if restored_time is not None:

@@ -119,16 +119,31 @@ class _GDriveSubject(ConnectorSubjectBase):
 
     def run(self) -> None:
         client = self.client_factory()
+        first_poll = True
         while True:
             tree = client.tree(self.object_id)
             changed = False
+            cache = self._object_cache
+            if first_poll and cache is not None:
+                # files deleted while the pipeline was down never enter
+                # _seen — reconcile the persistent cache against the
+                # remote listing once so stale blobs don't accumulate
+                for stale_id in set(cache.list_objects()) - set(tree):
+                    cache.evict(stale_id)
+            first_poll = False
             for fid, meta in tree.items():
                 old = self._seen.get(fid)
-                if old is not None and old["meta"].get("modifiedTime") == meta.get(
-                    "modifiedTime"
-                ):
+                version = meta.get("modifiedTime")
+                if old is not None and old["meta"].get("modifiedTime") == version:
                     continue
-                payload = client.download(meta)
+                # persistence-backed object cache: a restart re-serves
+                # unchanged files without re-downloading (reference:
+                # cached_object_storage.rs)
+                payload = cache.get(fid, version) if cache is not None else None
+                if payload is None:
+                    payload = client.download(meta)
+                    if cache is not None:
+                        cache.put(fid, version, payload)
                 if old is not None:
                     # retract the exact row emitted earlier (same seen_at)
                     self._remove(old["row"])
@@ -140,6 +155,8 @@ class _GDriveSubject(ConnectorSubjectBase):
                 if fid not in tree:
                     old = self._seen.pop(fid)
                     self._remove(old["row"])
+                    if cache is not None:
+                        cache.evict(fid)
                     changed = True
             if changed:
                 self.commit()
@@ -175,4 +192,9 @@ def read(
             _client_factory, object_id, mode, refresh_interval, with_metadata
         )
 
-    return connector_table(schema, factory, mode=mode, name=name)
+    # stable default name: persistence scopes (input snapshots, the
+    # source-object cache) must survive restarts, and the global
+    # source_<n> counter does not
+    return connector_table(
+        schema, factory, mode=mode, name=name or f"gdrive_{object_id}"
+    )

@@ -178,14 +178,29 @@ class IcebergTableWriter(OutputWriter):
             json.dump({"entries": manifest_entries}, fh)
         manifest_len = os.path.getsize(os.path.join(self.uri, manifest_name))
 
-        # manifest list: one entry per manifest (spec's manifest_file)
+        # manifest list: the spec requires a snapshot's manifest list to
+        # represent FULL table state, so carry every prior manifest
+        # forward and append the new one
+        prior_manifests: List[dict] = []
+        cur_id = meta.get("current-snapshot-id", -1)
+        for prev_snap in meta.get("snapshots", []):
+            if prev_snap["snapshot-id"] == cur_id and "manifest-list" in prev_snap:
+                try:
+                    with open(
+                        os.path.join(self.uri, prev_snap["manifest-list"])
+                    ) as fh:
+                        prior_manifests = json.load(fh).get("manifests", [])
+                except OSError:
+                    prior_manifests = []
+                break
         mlist_name = os.path.join(
             _META_DIR, f"snap-{snapshot_id}-manifest-list.json"
         )
         with open(os.path.join(self.uri, mlist_name), "w") as fh:
             json.dump(
                 {
-                    "manifests": [
+                    "manifests": prior_manifests
+                    + [
                         {
                             "manifest_path": manifest_name,
                             "manifest_length": manifest_len,
@@ -280,6 +295,9 @@ class _IcebergSubject(ConnectorSubjectBase):
         self.mode = mode
         self.refresh_interval = refresh_interval
         self._seen_snapshots: set[int] = set()
+        # manifest lists carry full table state; incremental reads must
+        # dedupe at the data-file level
+        self._seen_files: set[str] = set()
 
     def _poll(self) -> bool:
         import pyarrow.parquet as pq
@@ -305,13 +323,19 @@ class _IcebergSubject(ConnectorSubjectBase):
                         manifest = json.load(fh)
                     for entry in manifest.get("entries", []):
                         if entry.get("status") != 2:  # not DELETED
-                            data_files.append(
-                                entry["data_file"]["file_path"]
-                            )
+                            path = entry["data_file"]["file_path"]
+                            if path not in self._seen_files:
+                                self._seen_files.add(path)
+                                data_files.append(path)
             else:  # pre-spec layout written by older versions
                 with open(os.path.join(self.uri, snap["manifest"])) as fh:
                     manifest = json.load(fh)
-                data_files = manifest.get("data_files", [])
+                data_files = [
+                    f
+                    for f in manifest.get("data_files", [])
+                    if f not in self._seen_files
+                ]
+                self._seen_files.update(data_files)
             for fname in data_files:
                 for rec in pq.read_table(os.path.join(self.uri, fname)).to_pylist():
                     row = {
@@ -335,11 +359,15 @@ class _IcebergSubject(ConnectorSubjectBase):
             time_mod.sleep(self.refresh_interval)
 
     def _persisted_state(self):
-        return {"seen": sorted(self._seen_snapshots)}
+        return {
+            "seen": sorted(self._seen_snapshots),
+            "seen_files": sorted(self._seen_files),
+        }
 
     def _restore_persisted_state(self, state) -> None:
         if state:
             self._seen_snapshots.update(state.get("seen", []))
+            self._seen_files.update(state.get("seen_files", []))
 
 
 def read(

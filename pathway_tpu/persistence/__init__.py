@@ -621,3 +621,82 @@ class InputSnapshotWriter:
             return pickle.loads(blob)
         except Exception:  # noqa: BLE001
             return None
+
+
+class CachedObjectStorage:
+    """Persistence-backed cache of downloaded source objects (reference:
+    src/persistence/cached_object_storage.rs — 833 LoC of exactly this
+    contract): bytes fetched from slow external sources (GDrive,
+    SharePoint) are stored under (object id, version) so a restarted
+    pipeline re-serves them from the persistent store instead of
+    re-downloading and re-parsing.
+
+    Keys are hashed into the backend namespace; the object id and version
+    live inside the blob, so listing works on plain key enumeration."""
+
+    def __init__(self, backend: PersistenceBackend, scope: str):
+        import hashlib as _hashlib
+
+        self.backend = backend
+        self.scope = scope
+        self._h = lambda s: _hashlib.blake2b(
+            s.encode(), digest_size=12
+        ).hexdigest()
+
+    def _key(self, object_id: str) -> str:
+        return f"objcache/{self._h(self.scope)}/{self._h(object_id)}"
+
+    def get(self, object_id: str, version: Any) -> Optional[bytes]:
+        """Cached bytes for this exact (id, version); None on miss."""
+        blob = self.backend.get_value(self._key(object_id))
+        if blob is None:
+            return None
+        try:
+            entry = pickle.loads(blob)
+        except Exception:  # noqa: BLE001 — torn write
+            return None
+        if entry.get("version") != version:
+            return None
+        return entry.get("payload")
+
+    def put(
+        self,
+        object_id: str,
+        version: Any,
+        payload: bytes,
+        metadata: Any = None,
+    ) -> None:
+        self.backend.put_value(
+            self._key(object_id),
+            pickle.dumps(
+                {
+                    "object_id": object_id,
+                    "version": version,
+                    "payload": payload,
+                    "metadata": metadata,
+                }
+            ),
+        )
+
+    def evict(self, object_id: str) -> None:
+        self.backend.truncate(self._key(object_id))
+
+    def list_objects(self) -> Dict[str, Any]:
+        """object_id -> version for every cached object in this scope."""
+        prefix_raw = f"objcache/{self._h(self.scope)}/"
+        prefix_flat = prefix_raw.replace("/", "__")
+        out: Dict[str, Any] = {}
+        for key in self.backend.list_keys():
+            if not (
+                key.startswith(prefix_raw) or key.startswith(prefix_flat)
+            ):
+                continue
+            blob = self.backend.get_value(key)
+            if blob is None:
+                continue
+            try:
+                entry = pickle.loads(blob)
+            except Exception:  # noqa: BLE001
+                continue
+            out[entry["object_id"]] = entry["version"]
+        return out

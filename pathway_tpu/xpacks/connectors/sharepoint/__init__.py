@@ -139,7 +139,14 @@ class _SharePointSubject(ConnectorSubjectBase):
                 prev = self._seen.get(path)
                 if prev is not None and prev[0] == modified:
                     continue
-                payload = client.download(path)
+                cache = self._object_cache
+                payload = (
+                    cache.get(path, modified) if cache is not None else None
+                )
+                if payload is None:
+                    payload = client.download(path)
+                    if cache is not None:
+                        cache.put(path, modified, payload)
                 row = self._row(payload, path, modified, created)
                 if prev is not None:
                     self._remove(prev[1])
@@ -149,6 +156,8 @@ class _SharePointSubject(ConnectorSubjectBase):
                 if path not in current_paths:
                     _mtime, row = self._seen.pop(path)
                     self._remove(row)
+                    if self._object_cache is not None:
+                        self._object_cache.evict(path)
             self.commit()
             if self.mode == "static":
                 return
@@ -218,5 +227,11 @@ def read(
         )
 
     return connector_table(
-        schema, factory, mode=mode, name=name or "sharepoint", exclusive=True
+        schema,
+        factory,
+        mode=mode,
+        # site url + path: two sites sharing a root_path must not share a
+        # persistence scope (object cache / input snapshots)
+        name=name or f"sharepoint_{url}_{root_path}",
+        exclusive=True
     )
