@@ -128,6 +128,33 @@ from pathway_tpu.stdlib import ordered as _ordered  # noqa: E402
 
 Table.diff = _ordered.diff
 
+# graft the temporal join/window surface onto Table, exactly as the
+# reference does (reference: python/pathway/__init__.py:184-214)
+Table.asof_join = temporal.asof_join
+Table.asof_join_left = temporal.asof_join_left
+Table.asof_join_right = temporal.asof_join_right
+Table.asof_join_outer = temporal.asof_join_outer
+
+Table.asof_now_join = temporal.asof_now_join
+Table.asof_now_join_inner = temporal.asof_now_join_inner
+Table.asof_now_join_left = temporal.asof_now_join_left
+
+Table.window_join = temporal.window_join
+Table.window_join_inner = temporal.window_join_inner
+Table.window_join_left = temporal.window_join_left
+Table.window_join_right = temporal.window_join_right
+Table.window_join_outer = temporal.window_join_outer
+
+Table.interval_join = temporal.interval_join
+Table.interval_join_inner = temporal.interval_join_inner
+Table.interval_join_left = temporal.interval_join_left
+Table.interval_join_right = temporal.interval_join_right
+Table.interval_join_outer = temporal.interval_join_outer
+
+Table.windowby = temporal.windowby
+Table.interpolate = statistical.interpolate
+Table.inactivity_detection = temporal.inactivity_detection
+
 
 def __getattr__(name):
     if name == "xpacks":

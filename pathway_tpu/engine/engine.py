@@ -678,9 +678,16 @@ class CaptureNode(Node):
     name = "capture"
     snapshot_attrs = ('state', 'stream')
 
-    def __init__(self, engine: Engine, input_: Node, *, record_stream: bool = False):
+    def __init__(
+        self,
+        engine: Engine,
+        input_: Node,
+        *,
+        record_stream: bool = False,
+        multiset: bool = False,
+    ):
         super().__init__(engine, [input_])
-        self.state = TableState()
+        self.state = TableState(multiset=multiset)
         self.record_stream = record_stream
         self.stream: List[Tuple[int, Delta]] = []
 

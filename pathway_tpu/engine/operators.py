@@ -17,7 +17,12 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from pathway_tpu.engine.engine import Engine, Node
 from pathway_tpu.engine.exchange import exchange_by_value
-from pathway_tpu.engine.stream import Delta, TableState, values_equal_tuple
+from pathway_tpu.engine.stream import (
+    Delta,
+    TableState,
+    consolidate,
+    values_equal_tuple,
+)
 from pathway_tpu.engine.value import ERROR, Error, Pointer, ref_scalar
 
 
@@ -906,3 +911,142 @@ class GradualBroadcastNode(Node):
             else:
                 self.cache.diff(key, {}, out)
         self.emit(time, out)
+
+
+class ToStreamNode(Node):
+    """Turn a changing table into an append-only event stream (reference:
+    python/pathway/internals/table.py to_stream:2782; engine op
+    dataflow.rs table_to_stream:3098 — insertions sorted first, a
+    deletion is skipped when the same batch carries an insertion).
+
+    Events keep the original row key (so ``stream_to_table`` can replay
+    them into keyed state); the output is a multiset event stream in
+    which a key may recur across batches.
+    """
+
+    name = "to_stream"
+
+    def __init__(self, engine: Engine, input_: Node):
+        super().__init__(engine, [input_])
+
+    def process(self, time: int) -> None:
+        deltas, clean = self.take_with_clean(0)
+        if not deltas:
+            return
+        if not clean:
+            # merged chunks may carry a net-zero insert+retract for a key;
+            # consolidating first keeps phantom rows out of the event stream
+            deltas = consolidate(deltas)
+        inserts: Dict[Pointer, tuple] = {}
+        deletes: Dict[Pointer, tuple] = {}
+        order: List[Pointer] = []
+        for key, values, diff in deltas:
+            if key not in inserts and key not in deletes:
+                order.append(key)
+            if diff > 0:
+                inserts[key] = values
+            else:
+                deletes[key] = values
+        out: List[Delta] = []
+        for key in order:
+            if key in inserts:
+                out.append((key, inserts[key] + (True,), 1))
+            else:
+                out.append((key, deletes[key] + (False,), 1))
+        # bypass emit(): its consolidation assumes unique keys per batch,
+        # but an event stream is a multiset — batches here are minimal
+        self.emit_consolidated(time, out)
+
+
+class StreamToTableNode(Node):
+    """Replay an upsert/delete event stream into keyed table state
+    (reference: table.py stream_to_table:2836, StreamToTableContext)."""
+
+    name = "stream_to_table"
+    snapshot_attrs = ("state",)
+
+    def __init__(self, engine: Engine, input_: Node, upsert_prog: BatchFn):
+        super().__init__(engine, [input_])
+        self.upsert_prog = upsert_prog
+        self.state: Dict[Pointer, tuple] = {}
+
+    def process(self, time: int) -> None:
+        deltas = self.take(0)
+        events = [(k, v) for k, v, d in deltas if d > 0]
+        if not events:
+            return
+        keys = [e[0] for e in events]
+        rows = ([e[1] for e in events],)
+        flags = self.upsert_prog(keys, rows)
+        out: List[Delta] = []
+        for (key, values), flag in zip(events, flags):
+            if isinstance(flag, Error):
+                self.log_error("stream_to_table: Error in is_upsert column")
+                continue
+            old = self.state.get(key)
+            if flag:
+                if old is not None:
+                    if values_equal_tuple(old, values):
+                        continue
+                    out.append((key, old, -1))
+                self.state[key] = values
+                out.append((key, values, 1))
+            elif old is not None:
+                del self.state[key]
+                out.append((key, old, -1))
+        self.emit(time, out)
+
+
+class MergeStreamsNode(Node):
+    """Merge an updates stream (port 0) and a deletions stream (port 1) into
+    keyed table state (reference: table.py from_streams:2891,
+    MergeStreamsToTableContext). Only ids matter on the deletion side."""
+
+    name = "from_streams"
+    snapshot_attrs = ("state",)
+
+    def __init__(self, engine: Engine, updates: Node, deletions: Node):
+        super().__init__(engine, [updates, deletions])
+        self.state: Dict[Pointer, tuple] = {}
+
+    def process(self, time: int) -> None:
+        ups = self.take(0)
+        dels = self.take(1)
+        out: List[Delta] = []
+        for key, values, diff in ups:
+            if diff <= 0:
+                continue
+            old = self.state.get(key)
+            if old is not None and values_equal_tuple(old, values):
+                continue
+            if old is not None:
+                out.append((key, old, -1))
+            self.state[key] = values
+            out.append((key, values, 1))
+        for key, _values, diff in dels:
+            if diff <= 0:
+                continue
+            old = self.state.pop(key, None)
+            if old is not None:
+                out.append((key, old, -1))
+        self.emit(time, out)
+
+
+class AssertAppendOnlyNode(Node):
+    """Pass-through that aborts the run on any retraction (reference:
+    table.py assert_append_only:2941)."""
+
+    name = "assert_append_only"
+
+    def process(self, time: int) -> None:
+        deltas = self.take(0)
+        if not deltas:
+            return
+        for _key, _values, diff in deltas:
+            if diff < 0:
+                from pathway_tpu.engine.engine import EngineError
+
+                raise EngineError(
+                    "assert_append_only: table received a retraction"
+                )
+        self.emit(time, deltas)

@@ -98,14 +98,25 @@ class TableState:
     """Materialized current content of a stream: key -> values tuple.
 
     Enforces the unique-key-per-universe invariant (a Pathway table is a
-    keyed collection, not a general multiset)."""
+    keyed collection, not a general multiset) — except in ``multiset``
+    mode, used to materialize *event streams* (``to_stream`` outputs),
+    where the same key legitimately recurs across batches (reference:
+    dataflow.rs table_to_stream:3098 emits per-event insertions keyed by
+    the original row). There, rows are stored under synthetic
+    ``(key, seq)`` ids."""
 
-    __slots__ = ("rows",)
+    __slots__ = ("rows", "multiset", "_index", "_next")
 
-    def __init__(self):
+    def __init__(self, multiset: bool = False):
         self.rows: dict = {}
+        self.multiset = multiset
+        self._index: dict = {}
+        self._next = 0
 
     def apply(self, deltas: Iterable[Delta], *, source: str = "") -> None:
+        if self.multiset:
+            self._apply_multiset(deltas, source)
+            return
         rows = self.rows
         pop = rows.pop
         get = rows.get
@@ -141,7 +152,30 @@ class TableState:
                         )
                     rows[key] = values
 
+    def _apply_multiset(self, deltas: Iterable[Delta], source: str) -> None:
+        for key, values, diff in deltas:
+            if diff > 0:
+                for _ in range(diff):
+                    sid = self._next
+                    self._next += 1
+                    self.rows[(key, sid)] = values
+                    self._index.setdefault(key, []).append(sid)
+            else:
+                for _ in range(-diff):
+                    sids = self._index.get(key) or []
+                    for sid in sids:
+                        if values_equal_tuple(self.rows[(key, sid)], values):
+                            del self.rows[(key, sid)]
+                            sids.remove(sid)
+                            break
+                    else:
+                        raise KeyError(
+                            f"{source}: retraction of absent row {key!r}"
+                        )
+
     def snapshot_deltas(self) -> List[Delta]:
+        if self.multiset:
+            return [(k, v, 1) for (k, _sid), v in self.rows.items()]
         return [(k, v, 1) for k, v in self.rows.items()]
 
 

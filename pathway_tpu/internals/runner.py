@@ -67,7 +67,14 @@ def run_tables(
             from pathway_tpu.engine.exchange import exchange_to_worker
 
             node = exchange_to_worker(engine, node, 0)
-        captures.append(CaptureNode(engine, node, record_stream=record_stream))
+        captures.append(
+            CaptureNode(
+                engine,
+                node,
+                record_stream=record_stream,
+                multiset=getattr(t, "_event_stream", False),
+            )
+        )
     _attach_monitoring(engine)
     engine.run_static()
     return captures

@@ -83,6 +83,13 @@ class Table:
         return self._schema.dtypes()
 
     @property
+    def _event_stream(self) -> bool:
+        """True for multiset event streams (to_stream outputs and their
+        derivations) — the universe carries the property so every derived
+        table inherits it."""
+        return self._universe.multiset
+
+    @property
     def C(self) -> "ColumnNamespace":
         return ColumnNamespace(self)
 
@@ -687,6 +694,266 @@ class Table:
         )
         return Table(schema=schema, universe=self._universe, build=build)
 
+    # -- stream shaping ----------------------------------------------------
+    def _clocked(self, node_cls, time_column, threshold, **node_kwargs) -> "Table":
+        """Wrap with a clocked temporal node whose per-row threshold is
+        ``time_column + threshold`` (reference: time_column.rs — row acts
+        when max(time) so far reaches its event time plus the threshold)."""
+        mapping = self._mapping()
+        time_expr = desugar(time_column, mapping)
+        threshold_expr = BinaryOpExpression("+", time_expr, threshold)
+        self_ = self
+
+        def build(ctx):
+            node = ctx.node(self_)
+            return node_cls(
+                ctx.engine,
+                node,
+                _compile_on(ctx, [self_], threshold_expr),
+                _compile_on(ctx, [self_], time_expr),
+                **node_kwargs,
+            )
+
+        return Table(
+            schema=self._schema, universe=self._universe.subset(), build=build
+        )
+
+    def forget(
+        self,
+        time_column,
+        threshold,
+        mark_forgetting_records: bool = False,
+    ) -> "Table":
+        """Retract entries once ``time_column <= max(time_column) - threshold``
+        (reference: internals/table.py forget:670, time_column.rs forget:536).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... t | v
+        ... 1 | 1
+        ... 9 | 2
+        ... ''')
+        >>> res = t.forget(pw.this.t, 3)
+        >>> pw.debug.compute_and_print(res, include_id=False)
+        t | v
+        9 | 2
+        """
+        from pathway_tpu.engine.temporal_nodes import ForgetNode
+
+        return self._clocked(
+            ForgetNode,
+            time_column,
+            threshold,
+            mark_forgetting_records=mark_forgetting_records,
+        )
+
+    def ignore_late(self, time_column, threshold) -> "Table":
+        """Drop entries already satisfying ``time_column <= max(time_column)
+        - threshold`` on arrival; stores nothing but the clock (reference:
+        internals/table.py ignore_late:777, time_column.rs ignore_late:673)."""
+        from pathway_tpu.engine.temporal_nodes import FreezeNode
+
+        return self._clocked(FreezeNode, time_column, threshold)
+
+    def buffer(self, time_column, threshold) -> "Table":
+        """Hold entries until ``time_column <= max(time_column) - threshold``,
+        then release (reference: internals/table.py buffer:846,
+        time_column.rs postpone_core:302)."""
+        from pathway_tpu.engine.temporal_nodes import BufferNode
+
+        return self._clocked(BufferNode, time_column, threshold)
+
+    def to_stream(self, upsert_column_name: str = "is_upsert") -> "Table":
+        """Convert a changing table into an append-only stream of events with
+        a boolean action column (reference: internals/table.py
+        to_stream:2782)."""
+        if upsert_column_name in self.column_names():
+            raise ValueError(
+                f"to_stream: column {upsert_column_name!r} already exists"
+            )
+        self_ = self
+
+        def build(ctx):
+            from pathway_tpu.engine.operators import ToStreamNode
+
+            # events keep their original row keys — already worker-owned
+            return ToStreamNode(ctx.engine, ctx.node(self_))
+
+        schema_cols = {
+            name: ColumnSchema(
+                name=name, dtype=self._schema[name].dtype, append_only=True
+            )
+            for name in self.column_names()
+        }
+        schema_cols[upsert_column_name] = ColumnSchema(
+            name=upsert_column_name, dtype=dt.BOOL, append_only=True
+        )
+        return Table(
+            schema=schema_from_columns(schema_cols),
+            universe=Universe(multiset=True),
+            build=build,
+        )
+
+    def stream_to_table(self, is_upsert) -> "Table":
+        """Replay a stream of upsert/delete events into the current table
+        state (reference: internals/table.py stream_to_table:2836)."""
+        expr = desugar(is_upsert, self._mapping())
+        if self._infer(expr) not in (dt.BOOL, dt.ANY):
+            raise TypeError(
+                "stream_to_table: 'is_upsert' must evaluate to bool"
+            )
+        self_ = self
+
+        def build(ctx):
+            from pathway_tpu.engine.operators import StreamToTableNode
+
+            return StreamToTableNode(
+                ctx.engine,
+                ctx.node(self_),
+                _compile_on(ctx, [self_], expr),
+            )
+
+        # replayed state is a proper keyed table again, never a multiset
+        return Table(schema=self._schema, universe=Universe(), build=build)
+
+    def from_streams(self, deletion_stream: "Table") -> "Table":
+        """Merge an updates stream (``self``) and a deletion stream into
+        table state (reference: internals/table.py from_streams:2891)."""
+        self_ = self
+
+        def build(ctx):
+            from pathway_tpu.engine.operators import MergeStreamsNode
+
+            return MergeStreamsNode(
+                ctx.engine, ctx.node(self_), ctx.node(deletion_stream)
+            )
+
+        # replayed state is a proper keyed table again, never a multiset
+        return Table(schema=self._schema, universe=Universe(), build=build)
+
+    def remove_errors(self) -> "Table":
+        """Filter out rows containing Error values (reference:
+        internals/table.py remove_errors:2678)."""
+        self_ = self
+
+        def build(ctx):
+            from pathway_tpu.engine.engine import FilterNode
+            from pathway_tpu.engine.value import Error as EngineErrorValue
+
+            def pred(keys, rows):
+                return [
+                    not any(isinstance(v, EngineErrorValue) for v in row)
+                    for row in rows[0]
+                ]
+
+            return FilterNode(ctx.engine, ctx.node(self_), pred)
+
+        return Table(
+            schema=self._schema, universe=self._universe.subset(), build=build
+        )
+
+    def await_futures(self) -> "Table":
+        """Keep only rows whose fully-async UDF results arrived; strips the
+        ``Future`` wrapper from column dtypes (reference: internals/table.py
+        await_futures:2704)."""
+        self_ = self
+
+        def build(ctx):
+            from pathway_tpu.engine.engine import FilterNode
+            from pathway_tpu.engine.value import Pending
+
+            def pred(keys, rows):
+                return [
+                    not any(v is Pending for v in row) for row in rows[0]
+                ]
+
+            return FilterNode(ctx.engine, ctx.node(self_), pred)
+
+        schema_cols = {}
+        for name in self.column_names():
+            dtype = self._schema[name].dtype
+            if isinstance(dtype, dt.FutureDType):
+                dtype = dtype.wrapped
+            schema_cols[name] = ColumnSchema(name=name, dtype=dtype)
+        return Table(
+            schema=schema_from_columns(schema_cols),
+            universe=self._universe.subset(),
+            build=build,
+        )
+
+    @property
+    def is_append_only(self) -> bool:
+        """True when every column is known append-only (reference:
+        internals/table.py is_append_only:195)."""
+        cols = self._schema.columns()
+        return bool(cols) and all(
+            c.append_only for c in cols.values()
+        )
+
+    def assert_append_only(self) -> "Table":
+        """Declare the table append-only; verified at runtime (reference:
+        internals/table.py assert_append_only:2941)."""
+        self_ = self
+
+        def build(ctx):
+            from pathway_tpu.engine.operators import AssertAppendOnlyNode
+
+            return AssertAppendOnlyNode(ctx.engine, [ctx.node(self_)])
+
+        schema_cols = {
+            name: ColumnSchema(
+                name=name, dtype=self._schema[name].dtype, append_only=True
+            )
+            for name in self.column_names()
+        }
+        return Table(
+            schema=schema_from_columns(schema_cols),
+            universe=self._universe,
+            build=build,
+        )
+
+    def update_id_type(self, id_type, *, id_append_only: bool | None = None) -> "Table":
+        """Declare the id column's pointer type (reference: internals/table.py
+        update_id_type:2180). Our untyped-pointer engine keeps ids as raw
+        128-bit keys, so this is a schema-level declaration only."""
+        wrapped = dt.wrap(id_type)
+        core = dt.unoptionalize(wrapped)
+        if not isinstance(core, type(dt.POINTER)):
+            raise TypeError("update_id_type: id_type must be a Pointer type")
+        return self.copy()
+
+    def with_prefix(self, prefix: str) -> "Table":
+        """Rename all columns with a prefix (reference: internals/table.py
+        with_prefix:2027).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... age | owner
+        ... 10  | Alice
+        ... ''')
+        >>> t.with_prefix("u_").column_names()
+        ['u_age', 'u_owner']
+        """
+        return self.rename_by_dict(
+            {name: prefix + name for name in self.column_names()}
+        )
+
+    def with_suffix(self, suffix: str) -> "Table":
+        """Rename all columns with a suffix (reference: internals/table.py
+        with_suffix:2049).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... age | owner
+        ... 10  | Alice
+        ... ''')
+        >>> t.with_suffix("_cur").column_names()
+        ['age_cur', 'owner_cur']
+        """
+        return self.rename_by_dict(
+            {name: name + suffix for name in self.column_names()}
+        )
+
     # -- lookup -----------------------------------------------------------
     def ix(self, expression, *, optional: bool = False, context=None, allow_misses: bool = False) -> "Table":
         """`target.ix(keys)` — row lookup by pointer (reference: table.py ix,
@@ -750,9 +1017,35 @@ class Table:
 
     @staticmethod
     def from_columns(*args, **kwargs) -> "Table":
-        raise NotImplementedError(
-            "Table.from_columns: use pw.debug.table_from_pandas"
-        )
+        """Build a table from columns sharing one universe (reference:
+        internals/table.py from_columns:271).
+
+        >>> import pathway_tpu as pw
+        >>> t1 = pw.debug.table_from_markdown('''
+        ... age | pet
+        ... 10  | dog
+        ... ''')
+        >>> t2 = pw.Table.from_columns(t1.pet, qux=t1.age)
+        >>> t2.column_names()
+        ['pet', 'qux']
+        """
+        refs = [*args, *kwargs.values()]
+        if not refs:
+            raise ValueError(
+                "Table.from_columns() cannot have empty arguments list"
+            )
+        tables = {id(r._table): r._table for r in refs}
+        base = refs[0]._table
+        for other in tables.values():
+            if other is not base and not solver.query_are_equal(
+                base._universe, other._universe
+            ):
+                raise ValueError(
+                    "Universes of all arguments of Table.from_columns() "
+                    "have to be equal. Consider using "
+                    "Table.unsafe_promise_universes_are_equal() to assert it."
+                )
+        return base.select(*args, **kwargs)
 
     def _materialize_build(self, record_stream: bool = False):
         """Build closure attaching a CaptureNode (used by runner/debug)."""
@@ -762,7 +1055,10 @@ class Table:
             from pathway_tpu.engine.engine import CaptureNode
 
             return CaptureNode(
-                ctx.engine, ctx.node(self_), record_stream=record_stream
+                ctx.engine,
+                ctx.node(self_),
+                record_stream=record_stream,
+                multiset=self_._event_stream,
             )
 
         return build

@@ -16,21 +16,25 @@ _ids = itertools.count()
 
 
 class Universe:
-    __slots__ = ("id",)
+    __slots__ = ("id", "multiset")
 
-    def __init__(self):
+    def __init__(self, multiset: bool = False):
         self.id = next(_ids)
+        # event-stream universes (to_stream outputs) are multisets: a key
+        # may recur across batches; every derived universe inherits this so
+        # filter/select/copy chains materialize without the unique-key check
+        self.multiset = multiset
 
     def __repr__(self):
         return f"U{self.id}"
 
     def subset(self) -> "Universe":
-        u = Universe()
+        u = Universe(multiset=self.multiset)
         solver.register_subset(u, self)
         return u
 
     def superset(self) -> "Universe":
-        u = Universe()
+        u = Universe(multiset=self.multiset)
         solver.register_subset(self, u)
         return u
 
@@ -75,13 +79,13 @@ class UniverseSolver:
         return False
 
     def get_intersection(self, *universes: Universe) -> Universe:
-        u = Universe()
+        u = Universe(multiset=any(x.multiset for x in universes))
         for x in universes:
             self.register_subset(u, x)
         return u
 
     def get_union(self, *universes: Universe) -> Universe:
-        u = Universe()
+        u = Universe(multiset=any(x.multiset for x in universes))
         for x in universes:
             self.register_subset(x, u)
         return u

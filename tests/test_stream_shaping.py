@@ -1,0 +1,409 @@
+"""Stream-shaping Table surface: forget/ignore_late/buffer, to_stream/
+stream_to_table/from_streams, remove_errors/await_futures, append-only
+declarations, prefix/suffix renames, from_columns, and the temporal-join
+grafts (reference: internals/table.py:670,777,846,2027,2678,2704,2782,
+2836,2891,2941; python/pathway/__init__.py:184-214)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import run_tables
+
+
+def _rows(table):
+    (cap,) = run_tables(table)
+    return sorted(cap.state.rows.values())
+
+
+def _stream(table):
+    (cap,) = run_tables(table, record_stream=True)
+    return cap.stream, sorted(cap.state.rows.values())
+
+
+# -- forget / ignore_late / buffer ---------------------------------------
+
+
+def test_forget_retracts_old_rows():
+    t = pw.debug.table_from_markdown(
+        """
+        t  | v | __time__
+        1  | 1 |     2
+        2  | 1 |     2
+        4  | 2 |     4
+        8  | 3 |     6
+        """
+    )
+    res = t.forget(pw.this.t, 3)
+    stream, final = _stream(res)
+    # rows with t <= 8 - 3 are gone at the end
+    assert final == [(8, 3)]
+    # t=1 was inserted and later retracted
+    diffs_t1 = [d for _tm, (_k, vals, d) in stream if vals[0] == 1]
+    assert diffs_t1 == [1, -1]
+
+
+def test_ignore_late_drops_on_arrival():
+    t = pw.debug.table_from_markdown(
+        """
+        t  | v | __time__
+        10 | 1 |     2
+        2  | 2 |     4
+        9  | 3 |     4
+        """
+    )
+    res = t.ignore_late(pw.this.t, 3)
+    # t=2 arrives when clock=10 → 2 <= 10-3 → dropped; t=9 passes
+    assert _rows(res) == [(9, 3), (10, 1)]
+
+
+def test_buffer_delays_until_threshold():
+    t = pw.debug.table_from_markdown(
+        """
+        t | v | __time__
+        1 | 1 |     2
+        2 | 2 |     4
+        5 | 3 |     6
+        """
+    )
+    res = t.buffer(pw.this.t, 3)
+    stream, final = _stream(res)
+    # everything is flushed by end of stream
+    assert final == [(1, 1), (2, 2), (5, 3)]
+    # t=1 must not appear before the clock reaches 4 (i.e. batch time 6)
+    first_t1 = min(tm for tm, (_k, vals, d) in stream if vals[0] == 1)
+    assert first_t1 >= 6
+
+
+# -- to_stream / stream_to_table / from_streams ---------------------------
+
+
+def test_to_stream_emits_upserts_and_deletes():
+    t = pw.debug.table_from_markdown(
+        """
+        id | age | __time__ | __diff__
+         1 | 10  |     2    |     1
+         1 | 10  |     4    |    -1
+         1 | 11  |     4    |     1
+         2 | 9   |     4    |     1
+         2 | 9   |     6    |    -1
+        """
+    )
+    s = t.to_stream()
+    stream, final = _stream(s)
+    # all events are insertions (append-only stream)
+    assert all(d == 1 for _tm, (_k, _v, d) in stream)
+    events = sorted(v for _tm, (_k, v, _d) in stream)
+    assert events == [(9, False), (9, True), (10, True), (11, True)]
+    assert s.column_names() == ["age", "is_upsert"]
+
+
+def test_to_stream_rejects_column_collision():
+    t = pw.debug.table_from_markdown(
+        """
+        is_upsert
+        1
+        """
+    )
+    with pytest.raises(ValueError):
+        t.to_stream()
+
+
+def test_stream_to_table_replays_events():
+    t = pw.debug.table_from_markdown(
+        """
+        id | pet | age | is_upsert | __time__
+         1 | cat |  3  |   True    |     2
+         2 | dog | 11  |   True    |     2
+         1 | cat |  4  |   True    |     4
+         2 | dog |  0  |   False   |     4
+        """
+    )
+    res = t.stream_to_table(pw.this.is_upsert)
+    assert _rows(res) == [("cat", 4, True)]
+
+
+def test_from_streams_merges_update_and_deletion_streams():
+    ups = pw.debug.table_from_markdown(
+        """
+        id | pet | age | __time__
+         1 | cat |  3  |     2
+         2 | dog | 11  |     2
+         1 | cat |  4  |     4
+        """
+    )
+    dels = pw.debug.table_from_markdown(
+        """
+        id | pet | __time__
+         2 | dog |     4
+        """
+    )
+    res = ups.from_streams(dels)
+    assert _rows(res) == [("cat", 4)]
+
+
+# -- remove_errors / await_futures ----------------------------------------
+
+
+def test_remove_errors_filters_error_rows():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        3 | 3
+        4 | 0
+        6 | 2
+        """
+    )
+    t2 = t.with_columns(x=pw.this.a // pw.this.b)
+    res = t2.remove_errors()
+    rows = _rows(res)
+    assert rows == [(3, 3, 1), (6, 2, 3)]
+
+
+def test_await_futures_strips_pending_and_future_dtype():
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.engine.value import Pending
+
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+    marked = t.select(
+        a=pw.this.a,
+        f=pw.apply_with_type(
+            lambda a: Pending if a == 1 else a * 10, dt.Future(dt.INT), pw.this.a
+        ),
+    )
+    res = marked.await_futures()
+    assert _rows(res) == [(2, 20)]
+    assert not isinstance(res.schema["f"].dtype, dt.FutureDType)
+
+
+# -- append-only declarations ---------------------------------------------
+
+
+def test_assert_append_only_passes_inserts():
+    t = pw.debug.table_from_markdown(
+        """
+        a | __time__
+        1 |    2
+        2 |    4
+        """
+    )
+    res = t.assert_append_only()
+    assert _rows(res) == [(1,), (2,)]
+    assert res.is_append_only
+
+
+def test_assert_append_only_raises_on_retraction():
+    from pathway_tpu.engine.engine import EngineError
+
+    t = pw.debug.table_from_markdown(
+        """
+        id | a | __time__ | __diff__
+         1 | 1 |    2     |    1
+         1 | 1 |    4     |   -1
+        """
+    )
+    res = t.assert_append_only()
+    with pytest.raises(EngineError):
+        run_tables(res)
+
+
+# -- renames / from_columns / id type -------------------------------------
+
+
+def test_with_prefix_suffix():
+    t = pw.debug.table_from_markdown(
+        """
+        age | owner
+        10  | Alice
+        """
+    )
+    assert t.with_prefix("u_").column_names() == ["u_age", "u_owner"]
+    assert t.with_suffix("_cur").column_names() == ["age_cur", "owner_cur"]
+    assert _rows(t.with_prefix("u_")) == [(10, "Alice")]
+
+
+def test_from_columns():
+    t1 = pw.debug.table_from_markdown(
+        """
+        age | pet
+        10  | dog
+        """
+    )
+    t3 = pw.Table.from_columns(t1.pet, qux=t1.age)
+    assert t3.column_names() == ["pet", "qux"]
+    assert _rows(t3) == [("dog", 10)]
+    with pytest.raises(ValueError):
+        pw.Table.from_columns()
+
+
+def test_from_columns_rejects_mismatched_universes():
+    t1 = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+    t2 = pw.debug.table_from_markdown(
+        """
+        b
+        2
+        """
+    )
+    with pytest.raises(ValueError):
+        pw.Table.from_columns(t1.a, t2.b)
+
+
+def test_update_id_type():
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+    res = t.update_id_type(pw.Pointer)
+    assert _rows(res) == [(1,)]
+    with pytest.raises(TypeError):
+        t.update_id_type(int)
+
+
+# -- temporal grafts on Table ---------------------------------------------
+
+
+def test_windowby_grafted_on_table():
+    t = pw.debug.table_from_markdown(
+        """
+        t  | v
+        1  | 1
+        4  | 2
+        11 | 5
+        """
+    )
+    res = t.windowby(t.t, window=pw.temporal.tumbling(duration=10)).reduce(
+        start=pw.this._pw_window_start, total=pw.reducers.sum(pw.this.v)
+    )
+    assert _rows(res) == [(0, 3), (10, 5)]
+
+
+def test_interval_join_grafted_on_table():
+    left = pw.debug.table_from_markdown(
+        """
+        t | a
+        1 | 1
+        5 | 2
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        t | b
+        2 | 10
+        9 | 20
+        """
+    )
+    res = left.interval_join(
+        right, left.t, right.t, pw.temporal.interval(-2, 2)
+    ).select(a=pw.left.a, b=pw.right.b)
+    assert _rows(res) == [(1, 10)]
+
+
+def test_asof_join_grafted_on_table():
+    left = pw.debug.table_from_markdown(
+        """
+        t | a
+        3 | 1
+        7 | 2
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        t | b
+        1 | 10
+        5 | 20
+        """
+    )
+    res = left.asof_join(right, left.t, right.t).select(
+        a=pw.left.a, b=pw.right.b
+    )
+    assert _rows(res) == [(1, 10), (2, 20)]
+
+
+def test_window_join_grafted_on_table():
+    left = pw.debug.table_from_markdown(
+        """
+        t | a
+        1 | 1
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        t | b
+        2 | 10
+        """
+    )
+    res = left.window_join(
+        right, left.t, right.t, pw.temporal.tumbling(duration=5)
+    ).select(a=pw.left.a, b=pw.right.b)
+    assert _rows(res) == [(1, 10)]
+
+
+def test_to_stream_round_trip_and_derivations():
+    """Review regressions: event streams stay multisets through filter/
+    copy, report append-only, and round-trip via stream_to_table."""
+    t = pw.debug.table_from_markdown(
+        """
+        id | age | __time__ | __diff__
+         1 | 10  |     2    |     1
+         1 | 10  |     4    |    -1
+         1 | 11  |     4    |     1
+         2 | 9   |     4    |     1
+        """
+    )
+    s = t.to_stream()
+    assert s.is_append_only
+    # filter/copy of an event stream materialize without unique-key errors
+    upserts = s.filter(pw.this.is_upsert)
+    assert sorted(v[0] for v in _rows(upserts)) == [9, 10, 11]
+    assert sorted(v[0] for v in _rows(s.copy())) == [9, 10, 11]
+    # round trip: replaying the stream restores the final table state
+    rebuilt = s.stream_to_table(pw.this.is_upsert).without(pw.this.is_upsert)
+    assert _rows(rebuilt) == [(9,), (11,)]
+
+
+# -- API parity sweep ------------------------------------------------------
+
+REFERENCE_TABLE_METHODS = [
+    # core
+    "select", "filter", "with_columns", "without", "rename", "rename_columns",
+    "rename_by_dict", "copy", "cast_to_types", "update_types",
+    "pointer_from", "with_id", "with_id_from", "groupby", "reduce",
+    "deduplicate", "join", "join_inner", "join_left", "join_right",
+    "join_outer", "intersect", "difference", "restrict", "having",
+    "update_rows", "update_cells", "with_universe_of", "concat",
+    "concat_reindex", "flatten", "sort", "ix", "ix_ref", "empty",
+    "from_columns", "split", "diff",
+    # stream shaping (round 4)
+    "forget", "ignore_late", "buffer", "to_stream", "stream_to_table",
+    "from_streams", "remove_errors", "await_futures", "with_prefix",
+    "with_suffix", "is_append_only", "assert_append_only", "update_id_type",
+    # temporal grafts (round 4)
+    "windowby", "interval_join", "interval_join_inner", "interval_join_left",
+    "interval_join_right", "interval_join_outer", "asof_join",
+    "asof_join_left", "asof_join_right", "asof_join_outer", "asof_now_join",
+    "asof_now_join_inner", "asof_now_join_left", "window_join",
+    "window_join_inner", "window_join_left", "window_join_right",
+    "window_join_outer", "interpolate", "inactivity_detection",
+    # universe promises
+    "promise_universes_are_disjoint", "promise_universe_is_subset_of",
+    "promise_universe_is_equal_to",
+]
+
+
+def test_table_api_parity():
+    missing = [
+        m for m in REFERENCE_TABLE_METHODS if not hasattr(pw.Table, m)
+    ]
+    assert missing == []
