@@ -1050,3 +1050,242 @@ class AssertAppendOnlyNode(Node):
                     "assert_append_only: table received a retraction"
                 )
         self.emit(time, deltas)
+
+
+class WindowFunctionNode(Node):
+    """SQL window functions: per-partition ranking / running aggregates
+    (reference surface: internals/sql/processing.py window handling via
+    sqlglot; engine analogue built the micro-batch way — affected
+    partitions recompute vectorized with numpy cumulatives, emitting
+    minimal diffs).
+
+    ``specs`` is a list of ``(fname, has_order)`` with per-row argument
+    values supplied by ``arg_progs``. Supported fname: row_number, rank,
+    dense_rank, sum, count, min, max, avg. With ORDER BY, aggregates use
+    the standard SQL frame (RANGE UNBOUNDED PRECEDING — ties included);
+    without it they span the whole partition. NULL arguments are skipped
+    (SQL semantics); ``directions`` gives one DESC flag per ORDER BY key
+    with NULLS LAST on ascending, NULLS FIRST on descending (postgres
+    defaults). A partition whose computation fails (e.g. unorderable or
+    non-numeric values) yields ERROR window values for its rows instead
+    of killing the run.
+    """
+
+    name = "window_fn"
+    snapshot_attrs = ("partitions", "cache")
+
+    def __init__(
+        self,
+        engine: Engine,
+        input_: Node,
+        part_prog: BatchFn,
+        order_prog: Optional[BatchFn],
+        specs: List[tuple],
+        arg_progs: List[Optional[BatchFn]],
+        *,
+        directions: Tuple[bool, ...] = (),
+    ):
+        # co-locate rows by partition key so each partition recomputes on
+        # one worker (same contract as ReduceNode)
+        input_ = exchange_by_value(
+            engine, input_, lambda keys, rows: part_prog(keys, rows)
+        )
+        super().__init__(engine, [input_])
+        self.part_prog = part_prog
+        self.order_prog = order_prog
+        self.specs = specs
+        self.arg_progs = arg_progs
+        self.directions = directions
+        # pkey -> {row_key: (values, order_val, (arg0, arg1, ...))}
+        self.partitions: Dict[Any, Dict[Pointer, tuple]] = {}
+        self.cache = _DiffCache()
+
+    def process(self, time: int) -> None:
+        deltas = self.take(0)
+        if not deltas:
+            return
+        keys = [d[0] for d in deltas]
+        rows = ([d[1] for d in deltas],)
+        pkeys = self.part_prog(keys, rows)
+        order_vals = (
+            self.order_prog(keys, rows) if self.order_prog is not None else None
+        )
+        arg_cols = [
+            p(keys, rows) if p is not None else None for p in self.arg_progs
+        ]
+        affected = set()
+        for i, (key, values, diff) in enumerate(deltas):
+            pk = pkeys[i]
+            if isinstance(pk, Error):
+                self.log_error("Error value in window PARTITION BY key")
+                continue
+            pk = _freeze(pk)
+            affected.add(pk)
+            part = self.partitions.setdefault(pk, {})
+            if diff > 0:
+                part[key] = (
+                    values,
+                    order_vals[i] if order_vals is not None else None,
+                    tuple(c[i] if c is not None else None for c in arg_cols),
+                )
+            else:
+                part.pop(key, None)
+                if not part:
+                    del self.partitions[pk]
+        out: List[Delta] = []
+        n_specs = len(self.specs)
+        for pk in affected:
+            part = self.partitions.get(pk)
+            new_rows: Dict[Pointer, tuple] = {}
+            if part:
+                try:
+                    new_rows = self._compute_partition(part)
+                except Exception as exc:  # noqa: BLE001
+                    self.log_error(
+                        f"window function: {type(exc).__name__}: {exc}"
+                    )
+                    new_rows = {
+                        key: (*values, *((ERROR,) * n_specs))
+                        for key, (values, _ov, _args) in part.items()
+                    }
+            self.cache.diff(pk, new_rows, out)
+        self.emit(time, out)
+
+    def _order_component(self, ov, j: int):
+        if len(self.directions) > 1:
+            return ov[j]
+        return ov
+
+    def _sorted_items(self, part: Dict[Pointer, tuple]) -> List[tuple]:
+        items = sorted(part.items(), key=lambda kv: kv[0])  # deterministic
+        if self.order_prog is None:
+            return items
+        # multi-pass stable sort, last ORDER BY key first, so each key gets
+        # its own direction; NULLS LAST on asc, FIRST on desc (postgres)
+        for j in reversed(range(len(self.directions))):
+            desc = self.directions[j]
+
+            def sort_key(kv, j=j):
+                v = self._order_component(kv[1][1], j)
+                return (v is None, 0 if v is None else v)
+
+            items.sort(key=sort_key, reverse=desc)
+        return items
+
+    def _compute_partition(
+        self, part: Dict[Pointer, tuple]
+    ) -> Dict[Pointer, tuple]:
+        import numpy as np
+
+        items = self._sorted_items(part)
+        n = len(items)
+        has_order = self.order_prog is not None
+        if has_order:
+            order_arr = [kv[1][1] for kv in items]
+            group_id = [0] * n
+            g = 0
+            for i in range(1, n):
+                if order_arr[i] != order_arr[i - 1]:
+                    g += 1
+                group_id[i] = g
+            group_first: Dict[int, int] = {}
+            group_last: Dict[int, int] = {}
+            for i in range(n):
+                group_last[group_id[i]] = i
+                group_first.setdefault(group_id[i], i)
+        win_cols: List[List[Any]] = []
+        for s_idx, (fname, _spec_has_order) in enumerate(self.specs):
+            args = [kv[1][2][s_idx] for kv in items]
+            if fname == "row_number":
+                col: List[Any] = list(range(1, n + 1))
+            elif fname == "rank":
+                col = [group_first[group_id[i]] + 1 for i in range(n)]
+            elif fname == "dense_rank":
+                col = [group_id[i] + 1 for i in range(n)]
+            elif fname in ("sum", "count", "min", "max", "avg"):
+                col = self._aggregate(
+                    fname,
+                    args,
+                    n,
+                    has_arg=self.arg_progs[s_idx] is not None,
+                    frame_end=(
+                        [group_last[group_id[i]] for i in range(n)]
+                        if has_order
+                        else None
+                    ),
+                )
+            else:
+                raise ValueError(f"unsupported window function {fname!r}")
+            win_cols.append(col)
+        return {
+            key: (*values, *(win_cols[s][i] for s in range(len(self.specs))))
+            for i, (key, (values, _ov, _args)) in enumerate(items)
+        }
+
+    @staticmethod
+    def _aggregate(
+        fname: str,
+        args: List[Any],
+        n: int,
+        *,
+        has_arg: bool,
+        frame_end: Optional[List[int]],
+    ) -> List[Any]:
+        """NULL-skipping SQL aggregate over the partition (frame_end=None)
+        or the running RANGE frame ending at each row's last peer."""
+        import numpy as np
+
+        if fname == "count" and not has_arg:
+            present = np.ones(n, dtype=bool)  # COUNT(*) counts all rows
+        else:
+            present = np.array([a is not None for a in args], dtype=bool)
+        vals = np.array(
+            [float(a) if a is not None else 0.0 for a in args]
+        )
+        int_result = fname in ("sum", "min", "max") and all(
+            isinstance(a, int) and not isinstance(a, bool)
+            for a in args
+            if a is not None
+        )
+
+        def finish(x: Any) -> Any:
+            if x is None:
+                return None
+            if fname == "count":
+                return int(x)
+            if int_result and float(x).is_integer():
+                return int(x)
+            return float(x)
+
+        if frame_end is None:
+            cnt = int(present.sum())
+            if fname == "count":
+                agg: Any = cnt
+            elif cnt == 0:
+                agg = None
+            elif fname == "sum":
+                agg = vals[present].sum()
+            elif fname == "min":
+                agg = vals[present].min()
+            elif fname == "max":
+                agg = vals[present].max()
+            else:
+                agg = vals[present].mean()
+            return [finish(agg)] * n
+        cum_cnt = np.cumsum(present.astype(np.int64))
+        if fname == "count":
+            run: Any = cum_cnt
+        elif fname == "sum":
+            run = np.cumsum(np.where(present, vals, 0.0))
+        elif fname == "min":
+            run = np.minimum.accumulate(np.where(present, vals, np.inf))
+        elif fname == "max":
+            run = np.maximum.accumulate(np.where(present, vals, -np.inf))
+        else:
+            run = np.cumsum(np.where(present, vals, 0.0)) / np.maximum(
+                cum_cnt, 1
+            )
+        return [
+            finish(None if fname != "count" and cum_cnt[j] == 0 else run[j])
+            for j in (frame_end[i] for i in range(n))
+        ]

@@ -567,10 +567,128 @@ count_distinct = Reducer(
     "count_distinct", _compute_count_distinct, lambda a: dt.INT,
     make_acc=_DistinctAcc,
 )
-count_distinct_approximate = Reducer(
-    "count_distinct_approximate", _compute_count_distinct, lambda a: dt.INT,
-    make_acc=_DistinctAcc,
-)
+# -- HyperLogLog approximate count-distinct (reference: reduce.rs:930
+# CountDistinctApproximateReducer + dataflow.rs:3275, which feeds
+# HyperLogLogPlus<Key, Xxh3>; python surface reducers.py:837) --------------
+
+
+def _hll_canonical_bytes(hv) -> bytes:
+    """Type-tagged canonical encoding of a hashable value form — the hash
+    must be stable across processes and restarts (Python's builtin hash is
+    per-process seeded), like the reference's Xxh3 over Key::for_values."""
+    if isinstance(hv, tuple):
+        return b"(" + b"|".join(_hll_canonical_bytes(x) for x in hv) + b")"
+    return (
+        type(hv).__name__.encode()
+        + b":"
+        + repr(hv).encode("utf-8", "backslashreplace")
+    )
+
+
+def _stable_hash64(args: tuple) -> int:
+    from hashlib import blake2b
+
+    from pathway_tpu.engine.stream import _hashable_one
+
+    enc = _hll_canonical_bytes(tuple(_hashable_one(a) for a in args))
+    return int.from_bytes(blake2b(enc, digest_size=8).digest(), "little")
+
+
+class _HllSketch:
+    """Plain 64-bit-hash HyperLogLog: 2^precision one-byte registers."""
+
+    __slots__ = ("p", "m", "registers")
+
+    def __init__(self, precision: int):
+        self.p = precision
+        self.m = 1 << precision
+        self.registers = bytearray(self.m)
+
+    def add_hash(self, h: int) -> None:
+        idx = h >> (64 - self.p)
+        rest = h & ((1 << (64 - self.p)) - 1)
+        # leading-zero count of the (64-p)-bit suffix, plus one
+        rho = (64 - self.p) - rest.bit_length() + 1
+        if rho > self.registers[idx]:
+            self.registers[idx] = rho
+
+    def estimate(self) -> int:
+        import math
+
+        import numpy as np
+
+        m = self.m
+        if m >= 128:
+            alpha = 0.7213 / (1 + 1.079 / m)
+        elif m == 64:
+            alpha = 0.709
+        elif m == 32:
+            alpha = 0.697
+        else:
+            alpha = 0.673
+        regs = np.frombuffer(bytes(self.registers), dtype=np.uint8)
+        est = alpha * m * m / float(np.sum(np.ldexp(1.0, -regs.astype(np.int64))))
+        zeros = int(np.count_nonzero(regs == 0))
+        if est <= 2.5 * m and zeros:
+            est = m * math.log(m / zeros)
+        return int(round(est))
+
+
+class _HllAcc(Accumulator):
+    """O(2^precision)-memory insert-only accumulator. A retraction raises,
+    which drops the accumulator and sends the group down the full-recompute
+    path (still HLL over the surviving rows, so estimates stay consistent)
+    — where the reference instead restricts the reducer to append-only
+    tables (reference: reducers.py:846, dataflow.rs:3316 asserts diff>0)."""
+
+    __slots__ = ("sketch", "err")
+
+    def __init__(self, precision: int):
+        self.sketch = _HllSketch(precision)
+        self.err = 0
+
+    def insert(self, row_key, args, t, s):
+        if any(isinstance(a, Error) for a in args):
+            self.err += 1
+            return
+        self.sketch.add_hash(_stable_hash64(args))
+
+    def retract(self, row_key, args, t, s):
+        raise RuntimeError("HyperLogLog cannot retract; recompute group")
+
+    def result(self):
+        if self.err:
+            return ERROR
+        return self.sketch.estimate()
+
+
+def _make_compute_hll(precision: int):
+    def compute(entries):
+        sk = _HllSketch(precision)
+        for _rk, args, _t, _s in entries:
+            if any(isinstance(a, Error) for a in args):
+                return ERROR
+            sk.add_hash(_stable_hash64(args))
+        return sk.estimate()
+
+    return compute
+
+
+def count_distinct_approximate(*args, precision: int = 12):
+    """HyperLogLog estimate of the number of distinct values (reference:
+    reducers.py count_distinct_approximate:837; 2^precision buckets,
+    precision in [4, 18])."""
+    if not 4 <= precision <= 18:
+        raise ValueError(
+            "count_distinct_approximate: precision must be between 4 and 18"
+        )
+    red = Reducer(
+        "count_distinct_approximate",
+        _make_compute_hll(precision),
+        lambda a: dt.INT,
+        make_acc=lambda: _HllAcc(precision),
+    )
+    return red(*args)
 
 
 def infer_reducer_dtype(expr: ReducerExpression, rec) -> dt.DType:

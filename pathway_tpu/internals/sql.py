@@ -4,7 +4,10 @@ recursive-descent translator here).
 
 Supported: SELECT projections/expressions with aliases, WHERE, GROUP BY +
 HAVING, aggregate functions (SUM/COUNT/MIN/MAX/AVG), INNER/LEFT JOIN ... ON,
-UNION ALL. Example::
+UNION ALL, WITH-chains (CTEs, reference: processing.py:172), subqueries in
+FROM and `WHERE col IN (SELECT ...)` (reference: processing.py:305), and
+window functions ROW_NUMBER/RANK/DENSE_RANK/SUM/COUNT/MIN/MAX/AVG with
+`OVER (PARTITION BY ... [ORDER BY ... [DESC]])`. Example::
 
     result = pw.sql("SELECT k, SUM(v) AS total FROM t GROUP BY k", t=t)
 """
@@ -34,7 +37,12 @@ _KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "as", "join",
     "inner", "left", "right", "outer", "on", "and", "or", "not", "union",
     "all", "order", "asc", "desc", "limit", "is", "null", "case", "when",
-    "then", "else", "end", "like", "in", "distinct",
+    "then", "else", "end", "like", "in", "distinct", "with", "over",
+    "partition",
+}
+
+_WINDOW_FUNCS = {
+    "row_number", "rank", "dense_rank", "sum", "count", "min", "max", "avg",
 }
 
 _AGGREGATES = {
@@ -95,9 +103,24 @@ class _Tokens:
 
 class _SqlTranslator:
     def __init__(self, tables: Dict[str, Table]):
-        self.tables = tables
+        self.tables = dict(tables)
+        self._subquery_count = 0
 
     def query(self, tk: _Tokens) -> Table:
+        if tk.accept("kw", "with"):
+            # WITH-chain: each CTE sees the ones before it (reference:
+            # processing.py:172 CTE handling)
+            while True:
+                name = tk.expect("ident")
+                tk.expect("kw", "as")
+                tk.expect("op", "(")
+                self.tables[name] = self.select_union(tk)
+                tk.expect("op", ")")
+                if not tk.accept("op", ","):
+                    break
+        return self.select_union(tk)
+
+    def select_union(self, tk: _Tokens) -> Table:
         result = self.select_statement(tk)
         while tk.accept("kw", "union"):
             tk.accept("kw", "all")
@@ -145,11 +168,7 @@ class _SqlTranslator:
         """Returns (combined_table, scope) where scope maps each table
         alias to {original column -> column name on the combined table},
         so qualified refs (t2.v) stay correct after joins merge columns."""
-        name = tk.expect("ident")
-        if name not in self.tables:
-            raise ValueError(f"unknown table {name!r}")
-        table = self.tables[name]
-        alias = self._table_alias(tk, name)
+        table, alias = self._from_item(tk)
         scope: Dict[str, Dict[str, str]] = {
             alias: {c: c for c in table.column_names()}
         }
@@ -171,9 +190,7 @@ class _SqlTranslator:
                 how = "right"
             else:
                 break
-            other_name = tk.expect("ident")
-            other = self.tables[other_name]
-            other_name = self._table_alias(tk, other_name)
+            other, other_name = self._from_item(tk)
             tk.expect("kw", "on")
             join_scope = dict(scope)
             join_scope[other_name] = {c: c for c in other.column_names()}
@@ -201,6 +218,20 @@ class _SqlTranslator:
             table = jr.select(**cols)
             scope[other_name] = other_mapping
         return table, scope
+
+    def _from_item(self, tk: _Tokens) -> Tuple[Table, str]:
+        """A named table or a parenthesized subquery (reference:
+        processing.py:305 Subquery), with an optional alias."""
+        if tk.accept("op", "("):
+            sub = self.select_union(tk)
+            tk.expect("op", ")")
+            self._subquery_count += 1
+            alias = self._table_alias(tk, f"_subquery_{self._subquery_count}")
+            return sub, alias
+        name = tk.expect("ident")
+        if name not in self.tables:
+            raise ValueError(f"unknown table {name!r}")
+        return self.tables[name], self._table_alias(tk, name)
 
     @staticmethod
     def _table_alias(tk: _Tokens, name: str) -> str:
@@ -244,7 +275,48 @@ class _SqlTranslator:
             negate = tk.accept("kw", "not")
             tk.expect("kw", "null")
             return ("isnull", left, negate)
+        negate = False
+        if (
+            tk.peek() == ("kw", "not")
+            and self._peek2(tk) == ("kw", "in")
+        ):
+            tk.next()
+            negate = True
+        if tk.accept("kw", "in"):
+            return self._in_clause(tk, left, negate)
         return left
+
+    @staticmethod
+    def _peek2(tk: _Tokens):
+        return (
+            tk.tokens[tk.pos + 1] if tk.pos + 1 < len(tk.tokens) else None
+        )
+
+    def _in_clause(self, tk: _Tokens, left, negate: bool):
+        """`x IN (SELECT ...)` -> semijoin marker; `x IN (a, b, ...)` ->
+        equality chain (reference: processing.py:305 Subquery in IN)."""
+        tk.expect("op", "(")
+        if tk.peek() == ("kw", "select") or tk.peek() == ("kw", "with"):
+            sub = self.query(tk)
+            tk.expect("op", ")")
+            if len(sub.column_names()) != 1:
+                raise ValueError(
+                    "IN (SELECT ...) subquery must produce exactly one column"
+                )
+            return ("in_sub", left, sub, negate)
+        items = []
+        while True:
+            items.append(self.expr(tk))
+            if not tk.accept("op", ","):
+                break
+        tk.expect("op", ")")
+        node = None
+        for item in items:
+            eq = ("binop", "==", left, item)
+            node = eq if node is None else ("binop", "|", node, eq)
+        if negate:
+            node = ("not", node)
+        return node
 
     def add_expr(self, tk):
         left = self.mul_expr(tk)
@@ -304,7 +376,10 @@ class _SqlTranslator:
                     else:
                         arg = self.expr(tk)
                     tk.expect("op", ")")
-                    return ("agg", name.lower(), arg)
+                    node = ("agg", name.lower(), arg)
+                    if tk.peek() == ("kw", "over"):
+                        return self._over_clause(tk, name.lower(), arg)
+                    return node
                 args = []
                 if not tk.accept("op", ")"):
                     while True:
@@ -312,12 +387,48 @@ class _SqlTranslator:
                         if not tk.accept("op", ","):
                             break
                     tk.expect("op", ")")
+                if tk.peek() == ("kw", "over"):
+                    if name.lower() not in _WINDOW_FUNCS:
+                        raise ValueError(
+                            f"unsupported window function {name!r}"
+                        )
+                    arg = args[0] if args else None
+                    return self._over_clause(tk, name.lower(), arg)
                 return ("func", name.lower(), args)
             if tk.accept("op", "."):
                 col = tk.expect("ident")
                 return ("qualified", name, col)
             return ("ident", name)
         raise ValueError(f"unexpected token {tok}")
+
+    def _over_clause(self, tk: _Tokens, fname: str, arg):
+        """`OVER (PARTITION BY ... [ORDER BY ... [DESC]])` -> window node."""
+        tk.expect("kw", "over")
+        tk.expect("op", "(")
+        partition: List[Any] = []
+        order: List[Any] = []  # (expr_ast, descending) per ORDER BY key
+        if tk.accept("kw", "partition"):
+            tk.expect("kw", "by")
+            while True:
+                partition.append(self.expr(tk))
+                if not tk.accept("op", ","):
+                    break
+        if tk.accept("kw", "order"):
+            tk.expect("kw", "by")
+            while True:
+                e = self.expr(tk)
+                desc = False
+                if tk.accept("kw", "desc"):
+                    desc = True
+                else:
+                    tk.accept("kw", "asc")
+                order.append((e, desc))
+                if not tk.accept("op", ","):
+                    break
+        tk.expect("op", ")")
+        if fname in ("row_number", "rank", "dense_rank") and not order:
+            raise ValueError(f"{fname}() requires ORDER BY in its OVER clause")
+        return ("window", fname, arg, tuple(partition), tuple(order))
 
     # -- AST -> ColumnExpression -----------------------------------------
     def _resolve_joined(self, ast, scope, table, other_name, other):
@@ -386,10 +497,146 @@ class _SqlTranslator:
 
         return rec(ast)
 
+    def _apply_in_sub(self, table, scope, node):
+        """`WHERE x IN (SELECT c FROM ...)` as a distinct-then-semijoin
+        (reference: processing.py:305 Subquery)."""
+        _tag, left_ast, sub, negate = node
+        subcol = sub.column_names()[0]
+        distinct = sub.groupby(sub[subcol]).reduce(
+            **{"_pw_in_val": sub[subcol]}
+        )
+        left_expr = self._resolve(left_ast, scope, table)
+        cond = BinaryOpExpression("==", left_expr, distinct["_pw_in_val"])
+        jr = table.join(distinct, cond, id=table.id)
+        matched = jr.select(**{c: table[c] for c in table.column_names()})
+        if negate:
+            return table.difference(matched)
+        return matched
+
+    def _apply_windows(self, table, scope, windows):
+        """Attach window-function columns; one WindowFunctionNode per
+        distinct (PARTITION BY, ORDER BY, direction) signature."""
+        sigs: Dict[tuple, list] = {}
+        for name, node in windows:
+            _tag, fname, arg, partition, order = node
+            sigs.setdefault((partition, order), []).append(
+                (name, fname, arg)
+            )
+        for (partition, order), specs in sigs.items():
+            table = self._window_wrap(table, scope, partition, order, specs)
+        return table
+
+    def _window_wrap(self, table, scope, partition, order, specs):
+        from pathway_tpu.engine.operators import WindowFunctionNode
+        from pathway_tpu.internals import dtype as dtt
+        from pathway_tpu.internals.schema import (
+            ColumnSchema,
+            schema_from_columns,
+        )
+        from pathway_tpu.internals.table import _compile_on
+
+        part_exprs = [self._resolve(a, scope, table) for a in partition]
+        order_exprs = [self._resolve(a, scope, table) for a, _d in order]
+        directions = tuple(d for _a, d in order)
+        arg_exprs = [
+            self._resolve(a, scope, table) if a is not None else None
+            for (_n, _f, a) in specs
+        ]
+        spec_list = [(f, bool(order)) for (_n, f, _a) in specs]
+
+        def build(ctx):
+            node = ctx.node(table)
+
+            def composite(progs):
+                if not progs:
+                    return None
+                if len(progs) == 1:
+                    return progs[0]
+
+                def fn(keys, rows):
+                    cols = [p(keys, rows) for p in progs]
+                    return [
+                        tuple(c[i] for c in cols) for i in range(len(keys))
+                    ]
+
+                return fn
+
+            part_prog = composite(
+                [_compile_on(ctx, [table], e) for e in part_exprs]
+            ) or (lambda keys, rows: [0] * len(keys))
+            order_prog = composite(
+                [_compile_on(ctx, [table], e) for e in order_exprs]
+            )
+            arg_progs = [
+                _compile_on(ctx, [table], e) if e is not None else None
+                for e in arg_exprs
+            ]
+            return WindowFunctionNode(
+                ctx.engine,
+                node,
+                part_prog,
+                order_prog,
+                spec_list,
+                arg_progs,
+                directions=directions,
+            )
+
+        schema_cols = {
+            nm: ColumnSchema(name=nm, dtype=table._schema[nm].dtype)
+            for nm in table.column_names()
+        }
+        for nm, f, _a in specs:
+            dtype = (
+                dtt.INT
+                if f in ("row_number", "rank", "dense_rank", "count")
+                else dtt.ANY
+            )
+            schema_cols[nm] = ColumnSchema(name=nm, dtype=dtype)
+        return Table(
+            schema=schema_from_columns(schema_cols),
+            universe=table._universe,
+            build=build,
+        )
+
     def build(self, table, scope, projections, where_ast, group_asts, having_ast):
         if where_ast is not None:
-            # filtering keeps column names, so the scope maps stay valid
-            table = table.filter(self._resolve(where_ast, scope, table))
+            # IN-subquery conjuncts become semijoins; the rest filter
+            plain: List[Any] = []
+            in_subs: List[Any] = []
+            for c in _conjuncts(where_ast):
+                if isinstance(c, tuple) and c[0] == "in_sub":
+                    in_subs.append(c)
+                elif _contains_in_sub(c):
+                    raise ValueError(
+                        "IN (SELECT ...) is only supported as a top-level "
+                        "AND conjunct of WHERE"
+                    )
+                else:
+                    plain.append(c)
+            if plain:
+                combined = plain[0]
+                for c in plain[1:]:
+                    combined = ("binop", "&", combined, c)
+                # filtering keeps column names, so the scope maps stay valid
+                table = table.filter(self._resolve(combined, scope, table))
+            for c in in_subs:
+                table = self._apply_in_sub(table, scope, c)
+        windows: List[tuple] = []
+        new_projections = []
+        for alias, ast in projections:
+            if ast == "*":
+                new_projections.append((alias, ast))
+                continue
+            new_projections.append(
+                (alias, _extract_windows(ast, windows))
+            )
+        projections = new_projections
+        if windows:
+            if group_asts:
+                raise ValueError(
+                    "window functions cannot be combined with GROUP BY"
+                )
+            table = self._apply_windows(table, scope, windows)
         if group_asts:
             group_exprs = [
                 self._resolve(a, scope, table) for a in group_asts
@@ -418,6 +665,36 @@ class _SqlTranslator:
             expr = self._resolve(ast, scope, table)
             cols[alias or _default_name(ast, i)] = expr
         return table.select(**cols)
+
+
+def _conjuncts(ast) -> List[Any]:
+    if isinstance(ast, tuple) and ast[0] == "binop" and ast[1] == "&":
+        return _conjuncts(ast[2]) + _conjuncts(ast[3])
+    return [ast]
+
+
+def _contains_in_sub(ast) -> bool:
+    if isinstance(ast, tuple):
+        if ast[0] == "in_sub":
+            return True
+        return any(_contains_in_sub(x) for x in ast)
+    if isinstance(ast, list):
+        return any(_contains_in_sub(x) for x in ast)
+    return False
+
+
+def _extract_windows(ast, found: List[tuple]):
+    """Pull ("window", ...) nodes out of an AST, rewriting each into a
+    reference to its computed column."""
+    if isinstance(ast, tuple):
+        if ast[0] == "window":
+            name = f"_pw_win_{len(found)}"
+            found.append((name, ast))
+            return ("ident", name)
+        return tuple(_extract_windows(x, found) for x in ast)
+    if isinstance(ast, list):
+        return [_extract_windows(x, found) for x in ast]
+    return ast
 
 
 def _default_name(ast, i: int) -> str:

@@ -523,3 +523,85 @@ def test_groupby_by_id_and_windowby_stream():
     )
     stream, final = _stream(win)
     assert final == [(0, 3), (10, 5)]
+
+
+def test_hll_sketch_error_bounds_and_memory():
+    """HLL estimate within theoretical bounds at scale, memory fixed at
+    2^precision registers (reference: reduce.rs:930 precision semantics)."""
+    from pathway_tpu.internals.reducers import _HllSketch, _stable_hash64
+
+    sk = _HllSketch(12)
+    n = 100_000
+    for i in range(n):
+        sk.add_hash(_stable_hash64((i,)))
+    est = sk.estimate()
+    # standard error for p=12 is 1.04/sqrt(4096) ~= 1.6%; allow 4 sigma
+    assert abs(est - n) / n < 0.065, est
+    assert len(sk.registers) == 1 << 12  # memory bounded by precision
+    # small-range correction keeps tiny cardinalities near-exact
+    sk2 = _HllSketch(12)
+    for i in range(10):
+        sk2.add_hash(_stable_hash64((i,)))
+    assert sk2.estimate() == 10
+
+
+def test_hll_stable_hash_is_process_independent():
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    code = (
+        "from pathway_tpu.internals.reducers import _stable_hash64;"
+        "print(_stable_hash64(('abc', 17, 2.5, None)))"
+    )
+    outs = {
+        subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            check=True,
+            env={"PYTHONPATH": str(repo), "PATH": "/usr/bin:/bin",
+                 "PYTHONHASHSEED": str(seed), "JAX_PLATFORMS": "cpu"},
+        ).stdout.strip()
+        for seed in (1, 2)
+    }
+    assert len(outs) == 1, outs
+
+
+def test_hll_precision_validation_and_retraction():
+    import pytest
+
+    with pytest.raises(ValueError):
+        pw.reducers.count_distinct_approximate(pw.this.v, precision=3)
+    with pytest.raises(ValueError):
+        pw.reducers.count_distinct_approximate(pw.this.v, precision=19)
+    # retraction drops the accumulator; the recompute path still yields a
+    # consistent HLL estimate over surviving rows
+    t = pw.debug.table_from_markdown(
+        """
+        id | g | v | __time__ | __diff__
+         1 | a | 1 |    2     |    1
+         2 | a | 2 |    2     |    1
+         3 | a | 3 |    2     |    1
+         2 | a | 2 |    4     |   -1
+        """
+    )
+    res = t.groupby(t.g).reduce(
+        g=t.g, ad=pw.reducers.count_distinct_approximate(t.v)
+    )
+    assert _rows(res) == [("a", 2)]
+
+
+def test_hll_engine_path_at_moderate_scale():
+    import pandas as pd
+
+    n = 3_000
+    df = pd.DataFrame({"g": ["x"] * n, "v": list(range(n))})
+    t = pw.debug.table_from_pandas(df)
+    res = t.groupby(t.g).reduce(
+        g=t.g, ad=pw.reducers.count_distinct_approximate(t.v, precision=10)
+    )
+    ((_g, est),) = _rows(res)
+    # p=10 -> se ~3.25%; allow 4 sigma
+    assert abs(est - n) / n < 0.13, est

@@ -1,0 +1,394 @@
+"""SQL depth: CTEs (WITH-chains), subqueries in FROM and WHERE ... IN,
+and window functions (reference: internals/sql/processing.py:172 CTE,
+:305 Subquery; window surface checked against engine results)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import run_tables
+
+
+def _rows(table):
+    (cap,) = run_tables(table)
+    return sorted(cap.state.rows.values())
+
+
+def _sales():
+    return pw.debug.table_from_markdown(
+        """
+        region | amount
+        east   | 10
+        east   | 20
+        west   | 5
+        west   | 30
+        north  | 7
+        """
+    )
+
+
+# -- CTEs ------------------------------------------------------------------
+
+
+def test_cte_basic():
+    t = _sales()
+    res = pw.sql(
+        "WITH big AS (SELECT region, amount FROM t WHERE amount > 8) "
+        "SELECT region, SUM(amount) AS total FROM big GROUP BY region",
+        t=t,
+    )
+    assert _rows(res) == [("east", 30), ("west", 30)]
+
+
+def test_cte_chain_sees_earlier_cte():
+    t = _sales()
+    res = pw.sql(
+        "WITH a AS (SELECT region, amount * 2 AS v FROM t), "
+        "     b AS (SELECT region, v FROM a WHERE v >= 40) "
+        "SELECT region, COUNT(*) AS c FROM b GROUP BY region",
+        t=t,
+    )
+    assert _rows(res) == [("east", 1), ("west", 1)]
+
+
+def test_cte_shadows_input_table():
+    t = _sales()
+    res = pw.sql(
+        "WITH t AS (SELECT region FROM t WHERE amount = 30) "
+        "SELECT region FROM t",
+        t=t,
+    )
+    assert _rows(res) == [("west",)]
+
+
+# -- subqueries in FROM ----------------------------------------------------
+
+
+def test_subquery_in_from():
+    t = _sales()
+    res = pw.sql(
+        "SELECT region, total FROM "
+        "(SELECT region, SUM(amount) AS total FROM t GROUP BY region) s "
+        "WHERE total > 12",
+        t=t,
+    )
+    assert _rows(res) == [("east", 30), ("west", 35)]
+
+
+def test_subquery_in_join():
+    t = _sales()
+    res = pw.sql(
+        "SELECT t.region, t.amount, s.total FROM t "
+        "JOIN (SELECT region, SUM(amount) AS total FROM t GROUP BY region) s "
+        "ON t.region = s.region WHERE t.amount = 30",
+        t=t,
+    )
+    assert _rows(res) == [("west", 30, 35)]
+
+
+def test_nested_subqueries():
+    t = _sales()
+    res = pw.sql(
+        "SELECT region FROM (SELECT region FROM "
+        "(SELECT region, amount FROM t WHERE amount > 8) inner_q "
+        "WHERE amount < 25) outer_q",
+        t=t,
+    )
+    assert _rows(res) == [("east",), ("east",)]
+
+
+# -- WHERE ... IN ----------------------------------------------------------
+
+
+def test_where_in_literal_list():
+    t = _sales()
+    res = pw.sql(
+        "SELECT region, amount FROM t WHERE region IN ('east', 'north')",
+        t=t,
+    )
+    assert _rows(res) == [("east", 10), ("east", 20), ("north", 7)]
+
+
+def test_where_not_in_literal_list():
+    t = _sales()
+    res = pw.sql(
+        "SELECT region, amount FROM t WHERE region NOT IN ('east', 'west')",
+        t=t,
+    )
+    assert _rows(res) == [("north", 7)]
+
+
+def test_where_in_subquery():
+    t = _sales()
+    picks = pw.debug.table_from_markdown(
+        """
+        r
+        east
+        north
+        """
+    )
+    res = pw.sql(
+        "SELECT region, amount FROM t WHERE region IN (SELECT r FROM picks)",
+        t=t,
+        picks=picks,
+    )
+    assert _rows(res) == [("east", 10), ("east", 20), ("north", 7)]
+
+
+def test_where_not_in_subquery_with_other_conjunct():
+    t = _sales()
+    picks = pw.debug.table_from_markdown(
+        """
+        r
+        east
+        """
+    )
+    res = pw.sql(
+        "SELECT region, amount FROM t "
+        "WHERE region NOT IN (SELECT r FROM picks) AND amount > 6",
+        t=t,
+        picks=picks,
+    )
+    assert _rows(res) == [("north", 7), ("west", 30)]
+
+
+def test_where_in_subquery_computed():
+    """IN over a computed aggregate subquery: regions whose total > 30."""
+    t = _sales()
+    res = pw.sql(
+        "SELECT region, amount FROM t WHERE region IN "
+        "(SELECT region FROM "
+        "(SELECT region, SUM(amount) AS s FROM t GROUP BY region) g "
+        "WHERE s > 30)",
+        t=t,
+    )
+    assert _rows(res) == [("west", 5), ("west", 30)]
+
+
+def test_in_subquery_under_or_rejected():
+    t = _sales()
+    with pytest.raises(ValueError):
+        pw.sql(
+            "SELECT region FROM t WHERE amount > 100 "
+            "OR region IN (SELECT region FROM t)",
+            t=t,
+        )
+
+
+# -- window functions ------------------------------------------------------
+
+
+def test_row_number_over_partition_order():
+    t = _sales()
+    res = pw.sql(
+        "SELECT region, amount, "
+        "ROW_NUMBER() OVER (PARTITION BY region ORDER BY amount) AS rn "
+        "FROM t",
+        t=t,
+    )
+    assert _rows(res) == [
+        ("east", 10, 1),
+        ("east", 20, 2),
+        ("north", 7, 1),
+        ("west", 5, 1),
+        ("west", 30, 2),
+    ]
+
+
+def test_row_number_descending():
+    t = _sales()
+    res = pw.sql(
+        "SELECT region, amount, "
+        "ROW_NUMBER() OVER (PARTITION BY region ORDER BY amount DESC) AS rn "
+        "FROM t WHERE region = 'east'",
+        t=t,
+    )
+    assert _rows(res) == [("east", 10, 2), ("east", 20, 1)]
+
+
+def test_sum_over_partition_running():
+    t = _sales()
+    res = pw.sql(
+        "SELECT region, amount, "
+        "SUM(amount) OVER (PARTITION BY region ORDER BY amount) AS rt "
+        "FROM t",
+        t=t,
+    )
+    assert _rows(res) == [
+        ("east", 10, 10),
+        ("east", 20, 30),
+        ("north", 7, 7),
+        ("west", 5, 5),
+        ("west", 30, 35),
+    ]
+
+
+def test_sum_over_partition_whole():
+    t = _sales()
+    res = pw.sql(
+        "SELECT region, amount, "
+        "SUM(amount) OVER (PARTITION BY region) AS total FROM t",
+        t=t,
+    )
+    assert _rows(res) == [
+        ("east", 10, 30),
+        ("east", 20, 30),
+        ("north", 7, 7),
+        ("west", 5, 35),
+        ("west", 30, 35),
+    ]
+
+
+def test_rank_and_dense_rank_with_ties():
+    t = pw.debug.table_from_markdown(
+        """
+        g | v
+        a | 1
+        a | 1
+        a | 2
+        """
+    )
+    res = pw.sql(
+        "SELECT v, RANK() OVER (PARTITION BY g ORDER BY v) AS r, "
+        "DENSE_RANK() OVER (PARTITION BY g ORDER BY v) AS d FROM t",
+        t=t,
+    )
+    assert _rows(res) == [(1, 1, 1), (1, 1, 1), (2, 3, 2)]
+
+
+def test_window_running_sum_ties_include_peers():
+    t = pw.debug.table_from_markdown(
+        """
+        g | v
+        a | 1
+        a | 1
+        a | 2
+        """
+    )
+    res = pw.sql(
+        "SELECT v, SUM(v) OVER (PARTITION BY g ORDER BY v) AS rt FROM t",
+        t=t,
+    )
+    # SQL default frame is RANGE: peers (both v=1 rows) share the frame
+    assert _rows(res) == [(1, 2), (1, 2), (2, 4)]
+
+
+def test_window_no_partition():
+    t = pw.debug.table_from_markdown(
+        """
+        v
+        3
+        1
+        2
+        """
+    )
+    res = pw.sql(
+        "SELECT v, ROW_NUMBER() OVER (ORDER BY v) AS rn FROM t", t=t
+    )
+    assert _rows(res) == [(1, 1), (2, 2), (3, 3)]
+
+
+def test_window_incremental_update_stream():
+    """Window results update as late rows arrive: a new minimum shifts
+    every row's rank in its partition."""
+    t = pw.debug.table_from_markdown(
+        """
+        g | v | __time__
+        a | 10 |    2
+        a | 20 |    2
+        a | 5  |    4
+        """
+    )
+    res = pw.sql(
+        "SELECT g, v, ROW_NUMBER() OVER (PARTITION BY g ORDER BY v) AS rn "
+        "FROM t",
+        t=t,
+    )
+    (cap,) = run_tables(res, record_stream=True)
+    assert sorted(cap.state.rows.values()) == [
+        ("a", 5, 1),
+        ("a", 10, 2),
+        ("a", 20, 3),
+    ]
+    # the time-4 batch retracted the old ranks for 10 and 20
+    retractions_at_4 = [
+        vals for tm, (_k, vals, d) in cap.stream if tm >= 4 and d < 0
+    ]
+    assert ("a", 10, 1) in retractions_at_4
+    assert ("a", 20, 2) in retractions_at_4
+
+
+def test_window_null_skipping_aggregates():
+    """Review regression: SQL NULL semantics — aggregates skip NULLs,
+    COUNT(col) counts non-null, COUNT(*) counts rows."""
+    t = pw.debug.table_from_markdown(
+        """
+        g | v
+        a | 5
+        a |
+        a | 8
+        """
+    )
+    res = pw.sql(
+        "SELECT MIN(v) OVER (PARTITION BY g) AS mn, "
+        "MAX(v) OVER (PARTITION BY g) AS mx, "
+        "AVG(v) OVER (PARTITION BY g) AS av, "
+        "COUNT(v) OVER (PARTITION BY g) AS cv, "
+        "COUNT(*) OVER (PARTITION BY g) AS cs FROM t",
+        t=t,
+    )
+    rows = _rows(res)
+    assert rows == [(5, 8, 6.5, 2, 3)] * 3
+
+
+def test_window_mixed_order_directions():
+    """Review regression: DESC applies only to its own ORDER BY key."""
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 1
+        1 | 2
+        2 | 1
+        """
+    )
+    res = pw.sql(
+        "SELECT a, b, ROW_NUMBER() OVER (ORDER BY a, b DESC) AS rn FROM t",
+        t=t,
+    )
+    # a ascending, b descending within equal a
+    assert _rows(res) == [(1, 1, 2), (1, 2, 1), (2, 1, 3)]
+
+
+def test_window_error_containment():
+    """Review regression: a NULL ORDER BY value poisons only its partition
+    (ERROR window values), not the whole run."""
+    t = pw.debug.table_from_markdown(
+        """
+        g | v
+        a | 1
+        a |
+        b | 3
+        """
+    )
+    res = pw.sql(
+        "SELECT g, MIN(v) OVER (PARTITION BY g ORDER BY v) AS m FROM t",
+        t=t,
+    )
+    from pathway_tpu.engine.engine import Engine
+
+    (cap,) = run_tables(res, engine=Engine())
+    rows = sorted(cap.state.rows.values(), key=str)
+    # partition b computes fine; partition a sorts NULLS LAST and skips
+    # the NULL in the aggregate
+    assert ("b", 3) in rows
+
+
+def test_window_rejects_group_by_mix():
+    t = _sales()
+    with pytest.raises(ValueError):
+        pw.sql(
+            "SELECT region, ROW_NUMBER() OVER (ORDER BY amount) AS rn "
+            "FROM t GROUP BY region",
+            t=t,
+        )
+    with pytest.raises(ValueError):
+        pw.sql("SELECT ROW_NUMBER() OVER () AS rn FROM t", t=t)
