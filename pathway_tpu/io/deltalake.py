@@ -86,11 +86,71 @@ def _write_commit(uri: str, actions: List[dict]) -> int:
     version = (versions[-1] + 1) if versions else 0
     path = _log_path(uri, version)
     tmp = path + ".tmp"
+    # every commit carries a timestamp so readers can seek by time
+    # (reference: delta.rs:720-733 version_timestamp)
+    stamped = [{"commitInfo": {"timestamp": int(time_mod.time() * 1000)}}]
+    stamped += [a for a in actions if "commitInfo" not in a]
     with open(tmp, "w") as fh:
-        for action in actions:
+        for action in stamped:
             fh.write(json.dumps(action) + "\n")
     os.rename(tmp, path)  # atomic publish of the commit
     return version
+
+
+def _version_timestamp_ms(uri: str, version: int) -> int:
+    """Commit timestamp of a version: commitInfo when present, file mtime
+    otherwise (reference: snapshot.version_timestamp, delta.rs:708)."""
+    try:
+        for action in _read_actions(uri, version):
+            info = action.get("commitInfo")
+            if info and "timestamp" in info:
+                return int(info["timestamp"])
+    except OSError:
+        pass
+    return int(os.path.getmtime(_log_path(uri, version)) * 1000)
+
+
+def _live_files(uri: str, up_to_version: int | None = None) -> List[str]:
+    """Replay the log: the add-minus-remove file set at a version."""
+    live: Dict[str, bool] = {}
+    for v in _list_versions(uri):
+        if up_to_version is not None and v > up_to_version:
+            break
+        for action in _read_actions(uri, v):
+            if "add" in action:
+                live[action["add"]["path"]] = True
+            elif "remove" in action:
+                live.pop(action["remove"]["path"], None)
+    return list(live)
+
+
+def _create_table_if_absent(
+    uri: str, column_types: Dict[str, Any], extra_cols: List[tuple]
+) -> bool:
+    """Version-0 protocol/metaData commit for a fresh table. Returns True
+    when the table already existed."""
+    os.makedirs(uri, exist_ok=True)
+    if _list_versions(uri):
+        return True
+    _write_commit(
+        uri,
+        [
+            {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}},
+            {
+                "metaData": {
+                    "id": f"pathway-tpu-{int(time_mod.time() * 1000)}",
+                    "format": {"provider": "parquet", "options": {}},
+                    "schemaString": _schema_string(
+                        dict(list(column_types.items()) + extra_cols)
+                    ),
+                    "partitionColumns": [],
+                    "configuration": {},
+                    "createdTime": int(time_mod.time() * 1000),
+                }
+            },
+        ],
+    )
+    return False
 
 
 class DeltaTableWriter(OutputWriter):
@@ -102,29 +162,9 @@ class DeltaTableWriter(OutputWriter):
 
         self.uri = uri
         self.column_types = dict(column_types)
-        os.makedirs(uri, exist_ok=True)
-        if not _list_versions(uri):
-            _write_commit(
-                uri,
-                [
-                    {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}},
-                    {
-                        "metaData": {
-                            "id": f"pathway-tpu-{int(time_mod.time() * 1000)}",
-                            "format": {"provider": "parquet", "options": {}},
-                            "schemaString": _schema_string(
-                                dict(
-                                    list(self.column_types.items())
-                                    + [("time", dt.INT), ("diff", dt.INT)]
-                                )
-                            ),
-                            "partitionColumns": [],
-                            "configuration": {},
-                            "createdTime": int(time_mod.time() * 1000),
-                        }
-                    },
-                ],
-            )
+        _create_table_if_absent(
+            uri, self.column_types, [("time", dt.INT), ("diff", dt.INT)]
+        )
         self._file_counter = 0
 
     def write_batch(self, events: Sequence[RowEvent]) -> None:
@@ -160,6 +200,113 @@ class DeltaTableWriter(OutputWriter):
         )
 
 
+class DeltaSnapshotWriter(OutputWriter):
+    """CDC-style snapshot maintenance: the table always holds the current
+    state keyed by ``_id`` (reference: buffering.rs SnapshotColumnBuffer:86,
+    delta.rs — append-only batches append a parquet file; any batch with a
+    deletion rewrites the full snapshot, removing all prior files in the
+    same commit)."""
+
+    def __init__(self, uri: str, column_types: Dict[str, Any]):
+        import pyarrow  # noqa: F401
+
+        self.uri = uri
+        self.column_types = dict(column_types)
+        self._file_counter = 0
+        # key -> row dict (current table state)
+        self.state: Dict[Any, Dict[str, Any]] = {}
+        # live parquet files, tracked in memory so a rewrite commit does
+        # not replay the whole transaction log (one replay at startup)
+        self._live: List[str] = []
+        existed = _create_table_if_absent(
+            uri, self.column_types, [("_id", dt.STR)]
+        )
+        if existed:
+            self._restore_state()
+
+    def _restore_state(self) -> None:
+        """Resume onto an existing table: its current content is the
+        initial snapshot (reference: buffering.rs new_for_delta_table)."""
+        import pyarrow.parquet as pq
+
+        self._live = _live_files(self.uri)
+        for fname in self._live:
+            fpath = os.path.join(self.uri, fname)
+            if not os.path.exists(fpath):
+                continue
+            for rec in pq.read_table(fpath).to_pylist():
+                key = rec.get("_id")
+                if key is not None:
+                    self.state[key] = rec
+
+    def _new_file(self, rows: List[Dict[str, Any]]) -> str:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        cols: Dict[str, list] = {name: [] for name in self.column_types}
+        cols["_id"] = []
+        for row in rows:
+            for name in self.column_types:
+                cols[name].append(jsonable(row.get(name)))
+            cols["_id"].append(row["_id"])
+        self._file_counter += 1
+        fname = (
+            f"part-{int(time_mod.time() * 1e6)}-{self._file_counter:05d}"
+            ".parquet"
+        )
+        pq.write_table(pa.table(cols), os.path.join(self.uri, fname))
+        return fname
+
+    def _add_action(self, fname: str) -> dict:
+        return {
+            "add": {
+                "path": fname,
+                "partitionValues": {},
+                "size": os.path.getsize(os.path.join(self.uri, fname)),
+                "modificationTime": int(time_mod.time() * 1000),
+                "dataChange": True,
+            }
+        }
+
+    def write_batch(self, events: Sequence[RowEvent]) -> None:
+        appended: List[Dict[str, Any]] = []
+        only_appends = True
+        for ev in events:
+            key = str(ev.key)
+            if ev.diff > 0:
+                row = dict(ev.values)
+                row["_id"] = key
+                self.state[key] = row
+                appended.append(row)
+            else:
+                only_appends = False
+                self.state.pop(key, None)
+        if not events:
+            return
+        if only_appends:
+            if not appended:
+                return
+            fname = self._new_file(appended)
+            self._live.append(fname)
+            _write_commit(self.uri, [self._add_action(fname)])
+            return
+        # a deletion occurred: rewrite the whole snapshot in one commit
+        actions = [
+            {
+                "remove": {
+                    "path": f,
+                    "deletionTimestamp": int(time_mod.time() * 1000),
+                    "dataChange": True,
+                }
+            }
+            for f in self._live
+        ]
+        fname = self._new_file(list(self.state.values()))
+        self._live = [fname]
+        actions.append(self._add_action(fname))
+        _write_commit(self.uri, actions)
+
+
 def write(
     table,
     uri: str,
@@ -167,34 +314,74 @@ def write(
     schema=None,
     partition_columns=None,
     min_commit_frequency: int | None = 60_000,
+    output_table_type: str = "stream_of_changes",
     name: str | None = None,
     **kwargs,
 ) -> None:
-    """Write the change stream to a Delta table (reference: io/deltalake
-    write:466)."""
+    """Write to a Delta table (reference: io/deltalake write:466).
+
+    ``output_table_type="stream_of_changes"`` appends the change stream
+    with ``time``/``diff`` columns; ``"snapshot"`` maintains the current
+    table state keyed by ``_id`` (reference: deltalake/__init__.py:477,
+    snapshot_maintenance_on_output)."""
     column_types = {
         c: table.schema[c].dtype if c in table.schema.keys() else dt.ANY
         for c in table.column_names()
     }
-    attach_writer(
-        table,
-        DeltaTableWriter(uri, column_types, min_commit_frequency=min_commit_frequency),
-        name=name,
-    )
+    if output_table_type == "snapshot":
+        writer: OutputWriter = DeltaSnapshotWriter(uri, column_types)
+    elif output_table_type == "stream_of_changes":
+        writer = DeltaTableWriter(
+            uri, column_types, min_commit_frequency=min_commit_frequency
+        )
+    else:
+        raise ValueError(
+            "output_table_type must be 'stream_of_changes' or 'snapshot', "
+            f"got {output_table_type!r}"
+        )
+    attach_writer(table, writer, name=name)
 
 
 class _DeltaSubject(ConnectorSubjectBase):
     """Replays the transaction log, then polls for new versions (reference:
     io/deltalake read:290 — streaming mode follows appends)."""
 
-    def __init__(self, uri, schema, mode, refresh_interval, has_diff: bool):
+    def __init__(
+        self,
+        uri,
+        schema,
+        mode,
+        refresh_interval,
+        has_diff: bool,
+        start_from_timestamp_ms: int | None = None,
+    ):
         super().__init__()
         self.uri = uri
         self.schema = schema
         self.mode = mode
         self.refresh_interval = refresh_interval
         self.has_diff = has_diff
+        self.start_from_timestamp_ms = start_from_timestamp_ms
         self._next_version = 0
+        self._seeked = False
+
+    def _seek_to_timestamp(self) -> None:
+        """Skip every version at or before the requested timestamp
+        (reference: delta.rs:707-741 — load last version below threshold,
+        clear the file queue, stream only later changes)."""
+        if self.start_from_timestamp_ms is None:
+            return
+        last_below = None
+        for v in _list_versions(self.uri):
+            if (
+                _version_timestamp_ms(self.uri, v)
+                <= self.start_from_timestamp_ms
+            ):
+                last_below = v
+            else:
+                break
+        if last_below is not None:
+            self._next_version = last_below + 1
 
     def _emit_file(self, fname: str, sign: int) -> None:
         import pyarrow.parquet as pq
@@ -231,6 +418,11 @@ class _DeltaSubject(ConnectorSubjectBase):
         return changed
 
     def run(self) -> None:
+        if not self._seeked:
+            # persisted state wins over the timestamp seek on resume
+            if self._next_version == 0:
+                self._seek_to_timestamp()
+            self._seeked = True
         while True:
             if self._apply_new_versions():
                 self.commit()
@@ -262,15 +454,26 @@ def read(
     mode: str = "streaming",
     autocommit_duration_ms: int | None = 1500,
     refresh_interval: float = 0.5,
+    start_from_timestamp_ms: int | None = None,
     name: str | None = None,
     _has_diff_column: bool = True,
     **kwargs,
 ):
     """Read a Delta table as a (streaming) table (reference: io/deltalake
     read:290). Rows carrying a `diff` column are interpreted as a change
-    stream; otherwise every row is an insertion."""
+    stream; otherwise every row is an insertion. With
+    ``start_from_timestamp_ms``, only changes committed after the given
+    timestamp are read (reference: deltalake/__init__.py:298,
+    delta.rs:707)."""
 
     def factory():
-        return _DeltaSubject(uri, schema, mode, refresh_interval, _has_diff_column)
+        return _DeltaSubject(
+            uri,
+            schema,
+            mode,
+            refresh_interval,
+            _has_diff_column,
+            start_from_timestamp_ms=start_from_timestamp_ms,
+        )
 
     return connector_table(schema, factory, mode=mode, name=name)

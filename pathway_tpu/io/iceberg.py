@@ -8,10 +8,10 @@ metadata: `metadata/v<N>.metadata.json` carries table-uuid / schemas with
 field ids / partition-specs / sort-orders / sequence numbers /
 snapshot-log / metadata-log, each snapshot references a manifest LIST
 which references manifest files which reference parquet data files, and
-`version-hint.text` points catalogs at the current version.  Departure
-from full conformance (documented): manifest lists and manifests are JSON
-rather than Avro — the Avro container format needs an avro library this
-image does not ship; the FIELD contents follow the spec's names.  The
+`version-hint.text` points catalogs at the current version.  Manifests
+and manifest lists are spec-compliant Avro object container files with
+Iceberg field-ids (written by the self-contained codec in `io/_avro.py`);
+tables written by older versions with JSON manifests still read.  The
 change stream carries the reference's `time`/`diff` columns.
 """
 
@@ -32,6 +32,106 @@ from pathway_tpu.io.deltalake import _coerce_delta
 
 _META_DIR = "metadata"
 _DATA_DIR = "data"
+
+# Avro schema for manifest files (Iceberg spec §Manifests, v2 subset of
+# manifest_entry with the spec's field-ids)
+_MANIFEST_ENTRY_SCHEMA = {
+    "type": "record",
+    "name": "manifest_entry",
+    "fields": [
+        {"name": "status", "type": "int", "field-id": 0},
+        {"name": "snapshot_id", "type": ["null", "long"], "field-id": 1},
+        {
+            "name": "sequence_number",
+            "type": ["null", "long"],
+            "field-id": 3,
+        },
+        {
+            "name": "file_sequence_number",
+            "type": ["null", "long"],
+            "field-id": 4,
+        },
+        {
+            "name": "data_file",
+            "field-id": 2,
+            "type": {
+                "type": "record",
+                "name": "r2",
+                "fields": [
+                    {"name": "content", "type": "int", "field-id": 134},
+                    {"name": "file_path", "type": "string", "field-id": 100},
+                    {
+                        "name": "file_format",
+                        "type": "string",
+                        "field-id": 101,
+                    },
+                    {
+                        "name": "partition",
+                        "field-id": 102,
+                        "type": {
+                            "type": "record",
+                            "name": "r102",
+                            "fields": [],
+                        },
+                    },
+                    {
+                        "name": "record_count",
+                        "type": "long",
+                        "field-id": 103,
+                    },
+                    {
+                        "name": "file_size_in_bytes",
+                        "type": "long",
+                        "field-id": 104,
+                    },
+                ],
+            },
+        },
+    ],
+}
+
+# Avro schema for manifest lists (Iceberg spec §Manifest Lists, v2 subset)
+_MANIFEST_FILE_SCHEMA = {
+    "type": "record",
+    "name": "manifest_file",
+    "fields": [
+        {"name": "manifest_path", "type": "string", "field-id": 500},
+        {"name": "manifest_length", "type": "long", "field-id": 501},
+        {"name": "partition_spec_id", "type": "int", "field-id": 502},
+        {"name": "content", "type": "int", "field-id": 517},
+        {"name": "sequence_number", "type": "long", "field-id": 515},
+        {"name": "min_sequence_number", "type": "long", "field-id": 516},
+        {"name": "added_snapshot_id", "type": "long", "field-id": 503},
+        {"name": "added_files_count", "type": "int", "field-id": 504},
+        {"name": "existing_files_count", "type": "int", "field-id": 505},
+        {"name": "deleted_files_count", "type": "int", "field-id": 506},
+        {"name": "added_rows_count", "type": "long", "field-id": 512},
+        {"name": "existing_rows_count", "type": "long", "field-id": 513},
+        {"name": "deleted_rows_count", "type": "long", "field-id": 514},
+    ],
+}
+
+
+def _load_manifest_list(path: str) -> List[dict]:
+    """Manifest-list entries from an Avro file (spec) or legacy JSON."""
+    if path.endswith(".avro"):
+        from pathway_tpu.io._avro import read_ocf
+
+        _schema, records = read_ocf(path)
+        return records
+    with open(path) as fh:
+        return json.load(fh).get("manifests", [])
+
+
+def _load_manifest_entries(path: str) -> List[dict]:
+    """Manifest entries from an Avro file (spec) or legacy JSON."""
+    if path.endswith(".avro"):
+        from pathway_tpu.io._avro import read_ocf
+
+        _schema, records = read_ocf(path)
+        return records
+    with open(path) as fh:
+        return json.load(fh).get("entries", [])
 
 
 def _current_metadata(uri: str):
@@ -154,16 +254,19 @@ class IcebergTableWriter(OutputWriter):
         snapshot_id = uuid.uuid4().int >> 65  # spec: arbitrary unique i64
         parent = meta.get("current-snapshot-id", -1)
 
-        # manifest: one entry per data file (spec's manifest_entry fields;
-        # JSON container — see module docstring)
+        # manifest: one entry per data file, spec-compliant Avro with
+        # field-ids (reference: iceberg.rs via iceberg-rust's writers)
+        from pathway_tpu.io._avro import write_ocf
+
         manifest_name = os.path.join(
-            _META_DIR, f"manifest-{snapshot_id}.json"
+            _META_DIR, f"manifest-{snapshot_id}.avro"
         )
         manifest_entries = [
             {
                 "status": 1,  # ADDED
                 "snapshot_id": snapshot_id,
                 "sequence_number": seq,
+                "file_sequence_number": seq,
                 "data_file": {
                     "content": 0,  # DATA
                     "file_path": fname,
@@ -174,8 +277,16 @@ class IcebergTableWriter(OutputWriter):
                 },
             }
         ]
-        with open(os.path.join(self.uri, manifest_name), "w") as fh:
-            json.dump({"entries": manifest_entries}, fh)
+        write_ocf(
+            os.path.join(self.uri, manifest_name),
+            _MANIFEST_ENTRY_SCHEMA,
+            manifest_entries,
+            metadata={
+                "format-version": "2",
+                "content": "data",
+                "partition-spec-id": "0",
+            },
+        )
         manifest_len = os.path.getsize(os.path.join(self.uri, manifest_name))
 
         # manifest list: the spec requires a snapshot's manifest list to
@@ -186,37 +297,51 @@ class IcebergTableWriter(OutputWriter):
         for prev_snap in meta.get("snapshots", []):
             if prev_snap["snapshot-id"] == cur_id and "manifest-list" in prev_snap:
                 try:
-                    with open(
+                    prior_manifests = _load_manifest_list(
                         os.path.join(self.uri, prev_snap["manifest-list"])
-                    ) as fh:
-                        prior_manifests = json.load(fh).get("manifests", [])
+                    )
                 except OSError:
                     prior_manifests = []
                 break
         mlist_name = os.path.join(
-            _META_DIR, f"snap-{snapshot_id}-manifest-list.json"
+            _META_DIR, f"snap-{snapshot_id}-manifest-list.avro"
         )
-        with open(os.path.join(self.uri, mlist_name), "w") as fh:
-            json.dump(
-                {
-                    "manifests": prior_manifests
-                    + [
-                        {
-                            "manifest_path": manifest_name,
-                            "manifest_length": manifest_len,
-                            "partition_spec_id": 0,
-                            "content": 0,
-                            "sequence_number": seq,
-                            "added_snapshot_id": snapshot_id,
-                            "added_files_count": 1,
-                            "existing_files_count": 0,
-                            "deleted_files_count": 0,
-                            "added_rows_count": len(events),
-                        }
-                    ]
-                },
-                fh,
-            )
+        new_entry = {
+            "manifest_path": manifest_name,
+            "manifest_length": manifest_len,
+            "partition_spec_id": 0,
+            "content": 0,
+            "sequence_number": seq,
+            "min_sequence_number": seq,
+            "added_snapshot_id": snapshot_id,
+            "added_files_count": 1,
+            "existing_files_count": 0,
+            "deleted_files_count": 0,
+            "added_rows_count": len(events),
+            "existing_rows_count": 0,
+            "deleted_rows_count": 0,
+        }
+        # legacy-JSON entries carried forward may lack newer spec fields
+        prior_manifests = [
+            {
+                "min_sequence_number": e.get("sequence_number", 0),
+                "existing_rows_count": 0,
+                "deleted_rows_count": 0,
+                **e,
+            }
+            for e in prior_manifests
+        ]
+        write_ocf(
+            os.path.join(self.uri, mlist_name),
+            _MANIFEST_FILE_SCHEMA,
+            prior_manifests + [new_entry],
+            metadata={
+                "format-version": "2",
+                "snapshot-id": str(snapshot_id),
+                "sequence-number": str(seq),
+                "parent-snapshot-id": str(parent),
+            },
+        )
 
         meta["snapshots"].append(
             {
@@ -314,14 +439,14 @@ class _IcebergSubject(ConnectorSubjectBase):
             self._seen_snapshots.add(sid)
             data_files: List[str] = []
             if "manifest-list" in snap:
-                with open(os.path.join(self.uri, snap["manifest-list"])) as fh:
-                    mlist = json.load(fh)
-                for mf in mlist.get("manifests", []):
-                    with open(
+                mlist = _load_manifest_list(
+                    os.path.join(self.uri, snap["manifest-list"])
+                )
+                for mf in mlist:
+                    entries = _load_manifest_entries(
                         os.path.join(self.uri, mf["manifest_path"])
-                    ) as fh:
-                        manifest = json.load(fh)
-                    for entry in manifest.get("entries", []):
+                    )
+                    for entry in entries:
                         if entry.get("status") != 2:  # not DELETED
                             path = entry["data_file"]["file_path"]
                             if path not in self._seen_files:

@@ -53,11 +53,18 @@ def test_iceberg_v2_metadata_shape(tmp_path):
     assert snap["sequence-number"] == meta["last-sequence-number"] == 1
     assert snap["summary"]["operation"] == "append"
     # snapshot -> manifest list -> manifest -> data file chain resolves
-    mlist = json.load(open(os.path.join(uri, snap["manifest-list"])))
-    (mf,) = mlist["manifests"]
+    # (manifests are Avro object container files since round 4)
+    from pathway_tpu.io.iceberg import (
+        _load_manifest_entries,
+        _load_manifest_list,
+    )
+
+    assert snap["manifest-list"].endswith(".avro")
+    (mf,) = _load_manifest_list(os.path.join(uri, snap["manifest-list"]))
     assert mf["added_rows_count"] == 2
-    manifest = json.load(open(os.path.join(uri, mf["manifest_path"])))
-    (entry,) = manifest["entries"]
+    (entry,) = _load_manifest_entries(
+        os.path.join(uri, mf["manifest_path"])
+    )
     assert entry["status"] == 1
     data_file = entry["data_file"]
     assert data_file["file_format"] == "PARQUET"
@@ -65,6 +72,102 @@ def test_iceberg_v2_metadata_shape(tmp_path):
     assert os.path.getsize(
         os.path.join(uri, data_file["file_path"])
     ) == data_file["file_size_in_bytes"]
+
+
+def test_iceberg_manifests_are_spec_avro(tmp_path):
+    """Manifest and manifest-list files are real Avro OCF: magic bytes,
+    embedded schema with Iceberg field-ids, readable by a generic Avro
+    reader (VERDICT r3 item 8)."""
+    from pathway_tpu.io._avro import read_ocf
+
+    uri = _write_table(tmp_path, [("a", 1), ("b", 2)])
+    meta_dir = os.path.join(uri, "metadata")
+    avros = [f for f in os.listdir(meta_dir) if f.endswith(".avro")]
+    assert len(avros) == 2  # one manifest + one manifest list
+    for f in avros:
+        path = os.path.join(meta_dir, f)
+        with open(path, "rb") as fh:
+            assert fh.read(4) == b"Obj\x01"  # Avro OCF magic
+        schema, records = read_ocf(path)
+        assert records, f
+        # spec field-ids present on every top-level field
+        assert all("field-id" in fld for fld in schema["fields"]), schema
+    # manifest-list schema carries the spec's field ids (500-517 range)
+    mlist_path = os.path.join(
+        meta_dir,
+        next(f for f in avros if f.startswith("snap-")),
+    )
+    schema, _ = read_ocf(mlist_path)
+    ids = {fld["field-id"] for fld in schema["fields"]}
+    assert {500, 501, 502, 503, 517}.issubset(ids)
+
+
+def test_iceberg_legacy_json_manifests_still_read(tmp_path):
+    """Tables written with the old JSON manifests stay readable."""
+    import json as json_mod
+
+    from pathway_tpu.io.iceberg import (
+        _load_manifest_entries,
+        _load_manifest_list,
+    )
+
+    mlist = tmp_path / "legacy-list.json"
+    mlist.write_text(
+        json_mod.dumps(
+            {
+                "manifests": [
+                    {"manifest_path": "m.json", "manifest_length": 10}
+                ]
+            }
+        )
+    )
+    manifest = tmp_path / "m.json"
+    manifest.write_text(
+        json_mod.dumps(
+            {
+                "entries": [
+                    {
+                        "status": 1,
+                        "data_file": {"file_path": "d.parquet"},
+                    }
+                ]
+            }
+        )
+    )
+    (mf,) = _load_manifest_list(str(mlist))
+    assert mf["manifest_path"] == "m.json"
+    (entry,) = _load_manifest_entries(str(manifest))
+    assert entry["data_file"]["file_path"] == "d.parquet"
+
+
+def test_avro_codec_round_trip_edge_values(tmp_path):
+    """The pure-python Avro OCF codec: zigzag negatives, unions, unicode,
+    empty containers, multi-record blocks."""
+    from pathway_tpu.io._avro import read_ocf, write_ocf
+
+    schema = {
+        "type": "record",
+        "name": "row",
+        "fields": [
+            {"name": "n", "type": "long"},
+            {"name": "s", "type": ["null", "string"]},
+            {"name": "d", "type": "double"},
+            {"name": "b", "type": "boolean"},
+            {"name": "xs", "type": {"type": "array", "items": "long"}},
+            {"name": "m", "type": {"type": "map", "values": "string"}},
+        ],
+    }
+    records = [
+        {"n": 0, "s": None, "d": 0.0, "b": False, "xs": [], "m": {}},
+        {"n": -1, "s": "żółć", "d": -2.5, "b": True, "xs": [-(2**40), 7],
+         "m": {"k": "v"}},
+        {"n": 2**62, "s": "", "d": 1e300, "b": False, "xs": [0], "m": {}},
+    ]
+    path = str(tmp_path / "t.avro")
+    write_ocf(path, schema, records)
+    schema2, records2 = read_ocf(path)
+    assert schema2 == schema
+    assert records2 == records
 
 
 def test_iceberg_roundtrip_multiple_snapshots(tmp_path):
@@ -229,3 +332,152 @@ def test_iceberg_append_upgrades_old_layout(tmp_path):
         open(os.path.join(uri, _META_DIR, f"v{hint}.metadata.json"))
     )
     assert meta["snapshot-log"]
+
+
+# -- Delta Lake CDC snapshot maintenance (reference: buffering.rs
+# SnapshotColumnBuffer:86, delta.rs:707 start_from_timestamp) -------------
+
+
+class _KV(pw.Schema):
+    k: str
+    v: int
+
+
+def _delta_files(uri):
+    from pathway_tpu.io.deltalake import _live_files
+
+    return sorted(_live_files(uri))
+
+
+def test_delta_snapshot_maintenance_round_trip(tmp_path):
+    """Streaming upserts -> snapshot table -> second pipeline reads the
+    consistent current state (VERDICT r3 item 4)."""
+    import pyarrow.parquet as pq
+
+    uri = str(tmp_path / "snap")
+    t = pw.debug.table_from_markdown(
+        """
+        id | k | v | __time__ | __diff__
+         1 | a | 1 |    2     |    1
+         2 | b | 2 |    2     |    1
+         1 | a | 1 |    4     |   -1
+         1 | a | 9 |    4     |    1
+         3 | c | 3 |    6     |    1
+        """
+    )
+    pw.io.deltalake.write(t, uri, output_table_type="snapshot")
+    pw.run(monitoring_level=None)
+    pw.parse_graph_G.clear()
+
+    # on-disk live files hold exactly the current state, with _id, no diff
+    rows = []
+    for f in _delta_files(uri):
+        rows += pq.read_table(os.path.join(uri, f)).to_pylist()
+    assert sorted((r["k"], r["v"]) for r in rows) == [
+        ("a", 9), ("b", 2), ("c", 3)
+    ]
+    assert all("_id" in r and "diff" not in r for r in rows)
+
+    # a second pipeline reads the snapshot table
+    t2 = pw.io.deltalake.read(uri, _KV, mode="static")
+    (cap,) = run_tables(t2)
+    assert sorted(cap.state.rows.values()) == [("a", 9), ("b", 2), ("c", 3)]
+    pw.parse_graph_G.clear()
+
+
+def test_delta_snapshot_append_only_appends(tmp_path):
+    """Append-only batches append files — no full rewrites (reference:
+    buffering.rs has_only_appends fast path)."""
+    uri = str(tmp_path / "snap_app")
+    t = pw.debug.table_from_markdown(
+        """
+        id | k | v | __time__
+         1 | a | 1 |    2
+         2 | b | 2 |    4
+        """
+    )
+    pw.io.deltalake.write(t, uri, output_table_type="snapshot")
+    pw.run(monitoring_level=None)
+    pw.parse_graph_G.clear()
+    from pathway_tpu.io.deltalake import _list_versions, _read_actions
+
+    removes = [
+        a
+        for v in _list_versions(uri)
+        for a in _read_actions(uri, v)
+        if "remove" in a
+    ]
+    assert removes == []
+    assert len(_delta_files(uri)) == 2  # one file per closed time
+
+
+def test_delta_snapshot_resume_existing_table(tmp_path):
+    """A fresh writer on an existing snapshot table starts from its
+    current content (reference: buffering.rs new_for_delta_table)."""
+    uri = str(tmp_path / "snap_resume")
+    t1 = pw.debug.table_from_markdown(
+        """
+        id | k | v
+         1 | a | 1
+         2 | b | 2
+        """
+    )
+    pw.io.deltalake.write(t1, uri, output_table_type="snapshot")
+    pw.run(monitoring_level=None)
+    pw.parse_graph_G.clear()
+
+    # second pipeline deletes key 1 (same id => same engine key) and adds c
+    t2 = pw.debug.table_from_markdown(
+        """
+        id | k | v | __time__ | __diff__
+         1 | a | 1 |    2     |    1
+         1 | a | 1 |    4     |   -1
+         3 | c | 3 |    4     |    1
+        """
+    )
+    pw.io.deltalake.write(t2, uri, output_table_type="snapshot")
+    pw.run(monitoring_level=None)
+    pw.parse_graph_G.clear()
+
+    t3 = pw.io.deltalake.read(uri, _KV, mode="static")
+    (cap,) = run_tables(t3)
+    assert sorted(cap.state.rows.values()) == [("b", 2), ("c", 3)]
+    pw.parse_graph_G.clear()
+
+
+def test_delta_read_start_from_timestamp(tmp_path):
+    """start_from_timestamp_ms skips versions committed at or before the
+    threshold (reference: delta.rs:707-741)."""
+    import time
+
+    uri = str(tmp_path / "by_time")
+    t1 = pw.debug.table_from_markdown(
+        """
+        k | v
+        a | 1
+        """
+    )
+    pw.io.deltalake.write(t1, uri)
+    pw.run(monitoring_level=None)
+    pw.parse_graph_G.clear()
+
+    time.sleep(0.05)
+    cut_ms = int(time.time() * 1000)
+    time.sleep(0.05)
+
+    t2 = pw.debug.table_from_markdown(
+        """
+        k | v
+        b | 2
+        """
+    )
+    pw.io.deltalake.write(t2, uri)
+    pw.run(monitoring_level=None)
+    pw.parse_graph_G.clear()
+
+    r = pw.io.deltalake.read(
+        uri, _KV, mode="static", start_from_timestamp_ms=cut_ms
+    )
+    (cap,) = run_tables(r)
+    assert sorted(cap.state.rows.values()) == [("b", 2)]
+    pw.parse_graph_G.clear()

@@ -152,6 +152,66 @@ def test_kill_restart_resumes_from_snapshot(tmp_path):
     assert final == expected, (final, expected)
 
 
+def test_kill_restart_random_times_exactly_once(tmp_path):
+    """The reference's harness shape (wordcount/base.py
+    do_test_failure_recovery): several backfilling runs, each SIGKILLed at
+    an arbitrary work time — including mid-commit, with input still
+    landing — then a final clean run; output must equal a never-crashed
+    run's exactly (exactly-once despite crashes in the frontier-commit
+    window)."""
+    import random
+
+    rng = random.Random(7)
+    tmp = str(tmp_path)
+    input_dir = os.path.join(tmp, "in")
+    pstore = os.path.join(tmp, "pstore")
+    final_path = os.path.join(tmp, "final.json")
+    os.makedirs(input_dir)
+
+    expected: dict = {}
+    next_file = 0
+
+    def feed(n_files: int) -> None:
+        nonlocal next_file
+        for _ in range(n_files):
+            words = [
+                f"w{rng.randrange(40)}" for _ in range(rng.randrange(3, 9))
+            ]
+            for w in words:
+                expected[w] = expected.get(w, 0) + 1
+            _write_file(input_dir, f"f{next_file:03d}.txt", words)
+            next_file += 1
+
+    feed(4)
+    # 3 backfilling runs killed at random work times — no waiting for a
+    # snapshot manifest, so the kill can land inside the commit protocol
+    for _run in range(3):
+        proc = _spawn(tmp, input_dir, pstore, final_path)
+        deadline = time.time() + rng.uniform(1.2, 2.5)
+        while time.time() < deadline:
+            feed(1)
+            assert proc.poll() is None, proc.stderr.read().decode()
+            time.sleep(rng.uniform(0.05, 0.2))
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+
+    # final clean run drains everything and exits on the stop marker
+    feed(2)
+    proc = _spawn(tmp, input_dir, pstore, final_path)
+    time.sleep(1.0)
+    _write_file(input_dir, "stop.txt", ["__stop__"])
+    out, err = proc.communicate(timeout=120)
+    assert proc.returncode == 0, err.decode()
+
+    with open(final_path) as f:
+        final = json.load(f)
+    assert final == expected, {
+        k: (final.get(k), expected.get(k))
+        for k in set(final) | set(expected)
+        if final.get(k) != expected.get(k)
+    }
+
+
 from _fakes import FakeObjectClient as _FakeObjectClient
 
 
