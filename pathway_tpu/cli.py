@@ -54,6 +54,23 @@ def _spawn_from_env(args) -> int:
     return main(["spawn", *argv])
 
 
+def _airbyte_create_source(args) -> int:
+    """`pathway airbyte create-source <name> --image <img>` (reference:
+    cli.py:311-329)."""
+    from pathway_tpu.io.airbyte import create_connection_config
+
+    try:
+        path = create_connection_config(args.connection, args.image)
+    except FileExistsError as exc:
+        print(str(exc), file=sys.stderr)
+        return 1
+    print(
+        f"Connection `{args.connection}` with source `{args.image}` "
+        f"created successfully at `{path}`"
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="pathway")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -78,6 +95,22 @@ def main(argv=None) -> int:
     sfe = sub.add_parser("spawn-from-env")
     sfe.add_argument("program", nargs=argparse.REMAINDER)
     sfe.set_defaults(func=_spawn_from_env)
+
+    airbyte = sub.add_parser(
+        "airbyte", help="airbyte connector utilities"
+    )
+    airbyte_sub = airbyte.add_subparsers(dest="airbyte_command", required=True)
+    create_source = airbyte_sub.add_parser(
+        "create-source",
+        help="create a connection config template for an Airbyte source",
+    )
+    create_source.add_argument("connection")
+    create_source.add_argument(
+        "--image",
+        default="airbyte/source-faker:0.1.4",
+        help="any public docker Airbyte source image",
+    )
+    create_source.set_defaults(func=_airbyte_create_source)
 
     args = parser.parse_args(argv)
     return args.func(args)

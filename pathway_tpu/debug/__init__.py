@@ -137,8 +137,24 @@ def table_from_markdown(
     return table_from_events(out_schema, events)
 
 
-# alias kept for reference parity
-table_from_parquet = None
+def table_from_parquet(path, id_from=None, unsafe_trusted_ids=False):
+    """Read a Parquet file into a table via pandas (reference:
+    debug/__init__.py table_from_parquet:476)."""
+    import pandas as pd
+
+    df = pd.read_parquet(path)
+    return table_from_pandas(
+        df, id_from=id_from, unsafe_trusted_ids=unsafe_trusted_ids
+    )
+
+
+def table_to_parquet(table, filename):
+    """Write a table to a Parquet file via pandas (reference:
+    debug/__init__.py table_to_parquet:493)."""
+    df = table_to_pandas(table, include_id=False)
+    return df.to_parquet(filename)
+
+
 parse_to_table = table_from_markdown
 
 

@@ -804,7 +804,29 @@ def stateful_single(combine_single: Callable):
 
 
 class _ReducersNamespace:
-    """pw.reducers.*"""
+    """pw.reducers.* (reference: internals/reducers.py — the full reducer
+    surface, applied inside groupby().reduce()).
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... g | v
+    ... a | 3
+    ... a | 1
+    ... b | 5
+    ... ''')
+    >>> res = t.groupby(pw.this.g).reduce(
+    ...     g=pw.this.g,
+    ...     total=pw.reducers.sum(pw.this.v),
+    ...     n=pw.reducers.count(),
+    ...     lo=pw.reducers.min(pw.this.v),
+    ...     hi=pw.reducers.max(pw.this.v),
+    ...     distinct=pw.reducers.count_distinct(pw.this.v),
+    ... )
+    >>> pw.debug.compute_and_print(res, include_id=False)
+    g | total | n | lo | hi | distinct
+    b | 5     | 1 | 5  | 5  | 1
+    a | 4     | 2 | 1  | 3  | 2
+    """
 
     count = staticmethod(count)
     sum = staticmethod(sum_)

@@ -715,7 +715,24 @@ def _next_is_clause(tk: _Tokens) -> bool:
 
 def sql(query: str, **tables: Table) -> Table:
     """Run a SQL query over the given tables (reference: pw.sql,
-    internals/sql/processing.py)."""
+    internals/sql/processing.py).
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... region | amount
+    ... east   | 10
+    ... east   | 20
+    ... west   | 5
+    ... ''')
+    >>> res = pw.sql(
+    ...     "SELECT region, SUM(amount) AS total FROM t "
+    ...     "GROUP BY region HAVING SUM(amount) > 10",
+    ...     t=t,
+    ... )
+    >>> pw.debug.compute_and_print(res, include_id=False)
+    region | total
+    east   | 30
+    """
     translator = _SqlTranslator(tables)
     tk = _Tokens(query)
     result = translator.query(tk)

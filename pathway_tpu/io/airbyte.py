@@ -420,3 +420,57 @@ def read(
         )
 
     return connector_table(schema, factory, mode=mode, name=name)
+
+
+def _sample_config_from_spec(image: str) -> dict:
+    """Derive a sample config from the connector's `spec` command; empty
+    template when docker is unavailable (reference: the airbyte_serverless
+    template renders the spec's properties)."""
+    try:
+        out = subprocess.run(
+            ["docker", "run", "--rm", image, "spec"],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            check=True,
+        ).stdout
+    except Exception:  # noqa: BLE001 — docker missing/unpullable
+        return {}
+    for msg in AirbyteSourceRunner._parse_protocol(out.splitlines()):
+        if msg.get("type") == "SPEC":
+            props = (
+                msg.get("spec", {})
+                .get("connectionSpecification", {})
+                .get("properties", {})
+            )
+            return {
+                k: v.get("default", f"<{v.get('type', 'value')}>")
+                for k, v in props.items()
+            }
+    return {}
+
+
+def create_connection_config(
+    name: str, image: str, *, folder: str = "connections"
+) -> str:
+    """Backend of `pathway airbyte create-source` (reference: cli.py:311,
+    third_party/airbyte_serverless/connections.py ConnectionFromFile):
+    writes `connections/<name>.yaml` in the shape `pw.io.airbyte.read`
+    consumes, with a sample config from the connector spec when docker is
+    available."""
+    path = os.path.join(folder, f"{name}.yaml")
+    if os.path.exists(path):
+        raise FileExistsError(
+            f"Connection {name!r} already exists. "
+            f"Delete `{path}` and run this command again to re-init it."
+        )
+    sample = _sample_config_from_spec(image)
+    os.makedirs(folder, exist_ok=True)
+    doc = {
+        "source": {"docker_image": image, "config": sample, "streams": []}
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        yaml.safe_dump(doc, fh, sort_keys=False)
+    os.replace(tmp, path)
+    return path

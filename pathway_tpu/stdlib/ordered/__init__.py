@@ -9,7 +9,24 @@ from pathway_tpu.internals.desugaring import desugar
 
 def diff(table, timestamp, *values, instance=None):
     """Difference with the previous row in `timestamp` order (reference:
-    stdlib/ordered/diff.py — built on sort's prev pointers)."""
+    stdlib/ordered/diff.py — built on sort's prev pointers).
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... t | v
+    ... 1 | 10
+    ... 2 | 13
+    ... 3 | 11
+    ... ''')
+    >>> res = t.diff(pw.this.t, pw.this.v)
+    >>> pw.debug.compute_and_print(
+    ...     res.select(v=pw.this.diff_v), include_id=False
+    ... )
+    v
+    -2
+    None
+    3
+    """
     mapping = {thisclass.this: table}
     ts = desugar(timestamp, mapping)
     from pathway_tpu.internals.api import require, unwrap

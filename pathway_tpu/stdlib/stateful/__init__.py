@@ -16,7 +16,24 @@ def deduplicate(
     persistent_id: str | None = None,
 ):
     """Keep the latest accepted value per instance (reference:
-    stdlib/stateful/deduplicate.py)."""
+    stdlib/stateful/deduplicate.py).
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... instance | v | __time__
+    ... 1        | 1 |     2
+    ... 1        | 5 |     4
+    ... ''')
+    >>> res = pw.stateful.deduplicate(
+    ...     t, value=pw.this.v, instance=pw.this.instance,
+    ...     acceptor=lambda new, old: new > old,
+    ... )
+    >>> pw.debug.compute_and_print(
+    ...     res.select(v=pw.this.v), include_id=False
+    ... )
+    v
+    5
+    """
     return table.deduplicate(
         value=value if value is not None else col,
         instance=instance,

@@ -29,7 +29,24 @@ def _linear_interpolate(t, prev_t, prev_v, next_t, next_v):
 
 def interpolate(table, timestamp, *values, mode: InterpolateMode = InterpolateMode.LINEAR):
     """Linear interpolation of missing values over time order (reference:
-    stdlib/statistical/_interpolate.py)."""
+    stdlib/statistical/_interpolate.py).
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... t | v
+    ... 0 | 0
+    ... 2 |
+    ... 4 | 4
+    ... ''')
+    >>> res = t.interpolate(pw.this.t, pw.this.v)
+    >>> pw.debug.compute_and_print(
+    ...     res.select(v=pw.this.v), include_id=False
+    ... )
+    v
+    4
+    0
+    2.0
+    """
     if mode is not InterpolateMode.LINEAR:
         raise ValueError("only linear interpolation is supported")
     mapping = {thisclass.this: table}

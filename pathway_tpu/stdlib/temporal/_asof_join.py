@@ -200,7 +200,26 @@ def asof_join(
     direction: Direction = Direction.BACKWARD,
     behavior=None,
 ) -> AsofJoinResult:
-    """reference: stdlib/temporal/_asof_join.py asof_join:479."""
+    """Join each left row with the latest right row at or before its time
+    (reference: stdlib/temporal/_asof_join.py asof_join:479).
+
+    >>> import pathway_tpu as pw
+    >>> trades = pw.debug.table_from_markdown('''
+    ... t | qty
+    ... 3 | 1
+    ... ''')
+    >>> quotes = pw.debug.table_from_markdown('''
+    ... t | price
+    ... 1 | 10
+    ... 5 | 20
+    ... ''')
+    >>> res = trades.asof_join(
+    ...     quotes, trades.t, quotes.t
+    ... ).select(qty=pw.left.qty, price=pw.right.price)
+    >>> pw.debug.compute_and_print(res, include_id=False)
+    qty | price
+    1   | 10
+    """
     if isinstance(how, str):
         how = JoinMode[how.upper()]
     if isinstance(direction, str):

@@ -197,7 +197,27 @@ def interval_join(
     behavior=None,
     how: JoinMode = JoinMode.INNER,
 ) -> IntervalJoinResult:
-    """reference: stdlib/temporal/_interval_join.py interval_join:577."""
+    """Join rows whose time difference falls inside `interval` (reference:
+    stdlib/temporal/_interval_join.py interval_join:577).
+
+    >>> import pathway_tpu as pw
+    >>> left = pw.debug.table_from_markdown('''
+    ... t | a
+    ... 1 | 1
+    ... 5 | 2
+    ... ''')
+    >>> right = pw.debug.table_from_markdown('''
+    ... t | b
+    ... 2 | 10
+    ... 9 | 20
+    ... ''')
+    >>> res = left.interval_join(
+    ...     right, left.t, right.t, pw.temporal.interval(-2, 2)
+    ... ).select(a=pw.left.a, b=pw.right.b)
+    >>> pw.debug.compute_and_print(res, include_id=False)
+    a | b
+    1 | 10
+    """
     if isinstance(how, str):
         how = JoinMode[how.upper()]
     return IntervalJoinResult(

@@ -65,6 +65,25 @@ def window_join(
     *on,
     how: JoinMode = JoinMode.INNER,
 ) -> WindowJoinResult:
+    """Join rows that fall into the same time window (reference:
+    stdlib/temporal/_window_join.py window_join:26).
+
+    >>> import pathway_tpu as pw
+    >>> left = pw.debug.table_from_markdown('''
+    ... t | a
+    ... 1 | 1
+    ... ''')
+    >>> right = pw.debug.table_from_markdown('''
+    ... t | b
+    ... 2 | 10
+    ... ''')
+    >>> res = left.window_join(
+    ...     right, left.t, right.t, pw.temporal.tumbling(duration=5)
+    ... ).select(a=pw.left.a, b=pw.right.b)
+    >>> pw.debug.compute_and_print(res, include_id=False)
+    a | b
+    1 | 10
+    """
     if isinstance(how, str):
         how = JoinMode[how.upper()]
     left_flat = _with_windows(self, self_time, window, "_pw_l")

@@ -173,9 +173,15 @@ def answer_with_geometric_rag_strategy(
         answer = no_answer
         for _ in range(max_iterations):
             context = "\n\n".join(docs[:n])
+            strictness = (
+                "Answer with the shortest possible span from the context, "
+                "no explanations. "
+                if strict_prompt
+                else ""
+            )
             prompt = (
-                "Please answer using only the context. If the context is "
-                f"insufficient, reply exactly {no_answer!r}.\n"
+                f"{strictness}Please answer using only the context. If the "
+                f"context is insufficient, reply exactly {no_answer!r}.\n"
                 f"Context: {context}\nQuestion: {question}\nAnswer:"
             )
             result = llm_chat_model.func([{"role": "user", "content": prompt}])
@@ -197,17 +203,51 @@ def answer_with_geometric_rag_strategy(
 def answer_with_geometric_rag_strategy_from_index(
     questions,
     index,
-    documents_column_name: str,
+    documents_column_name,
     llm_chat_model,
+    *,
     n_starting_documents: int = 2,
     factor: int = 2,
     max_iterations: int = 4,
-    **kwargs,
+    metadata_filter=None,
+    strict_prompt: bool = False,
 ):
-    """reference: question_answering.py :304."""
-    raise NotImplementedError(
-        "use AdaptiveRAGQuestionAnswerer.answer_query for the dataflow form"
+    """Dataflow form of geometric RAG (reference: question_answering.py
+    answer_with_geometric_rag_strategy_from_index:304): retrieve
+    ``n_starting_documents * factor^(max_iterations-1)`` documents from the
+    index once, then escalate the per-prompt document count geometrically
+    until the chat commits to an answer. Returns the answer column."""
+    if not isinstance(documents_column_name, str):
+        documents_column_name = documents_column_name.name
+    max_documents = n_starting_documents * (factor ** (max_iterations - 1))
+    reply = index.query_as_of_now(
+        questions,
+        number_of_matches=max_documents,
+        collapse_rows=True,
+        metadata_filter=metadata_filter,
     )
+    q_name = questions.name
+
+    def per_row(question, docs):
+        return answer_with_geometric_rag_strategy(
+            [question],
+            [[d for d in (docs or []) if d is not None]],
+            llm_chat_model,
+            n_starting_documents=n_starting_documents,
+            factor=factor,
+            max_iterations=max_iterations,
+            strict_prompt=strict_prompt,
+        )[0]
+
+    result = reply.select(
+        answer=pw_api.apply_with_type(
+            per_row,
+            str,
+            reply[q_name],
+            reply[documents_column_name],
+        )
+    )
+    return result.answer
 
 
 class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):

@@ -97,11 +97,43 @@ class VectorStoreServer:
         )
 
     @classmethod
-    def from_llamaindex_components(cls, *docs, transformations=None, **kwargs):
-        """reference: document_store.py from_llamaindex_components:162."""
-        raise NotImplementedError(
-            "llamaindex bridge requires the llama-index package"
-        )
+    def from_llamaindex_components(
+        cls, *docs, transformations=None, parser=None, **kwargs
+    ):
+        """Build the store from LlamaIndex TransformComponents (reference:
+        document_store.py from_llamaindex_components:162): each document
+        becomes a TextNode, runs through the transformation pipeline, and
+        the resulting nodes become (text, metadata) chunks."""
+        try:
+            from llama_index.core.ingestion.pipeline import (  # type: ignore
+                run_transformations,
+            )
+            from llama_index.core.schema import (  # type: ignore
+                MetadataMode,
+                TextNode,
+            )
+        except ImportError as exc:
+            raise ImportError(
+                "Please install llama-index-core: "
+                "`pip install llama-index-core`"
+            ) from exc
+
+        from pathway_tpu.internals.udfs import udf
+
+        @udf
+        def splitter_udf(text: str, metadata) -> list:
+            nodes = run_transformations(
+                [TextNode(text=text)], transformations or []
+            )
+            return [
+                (
+                    node.get_content(metadata_mode=MetadataMode.NONE),
+                    dict(node.extra_info or {}),
+                )
+                for node in nodes
+            ]
+
+        return cls(*docs, parser=parser, splitter=splitter_udf, **kwargs)
 
     def run_server(
         self,

@@ -1,33 +1,68 @@
-"""Doctests on public entry points (the reference runs doctests in CI:
-.github/workflows/package_test.yml `--doctest-modules --pyargs pathway`;
-conftest python/pathway/conftest.py). Collected explicitly so import-heavy
-modules stay out of doctest discovery."""
+"""Package-wide doctests (the reference runs doctests in CI over the whole
+package: .github/workflows/package_test.yml:53-119 `--doctest-modules
+--pyargs pathway`). Every importable module under pathway_tpu is swept;
+the skip-list is only for modules whose import or examples need genuinely
+absent third-party services/packages."""
 
 import doctest
+import importlib
+import pkgutil
+
+import pytest
 
 import pathway_tpu  # noqa: F401 — ensures grafts applied before examples
+import pathway_tpu as pw
+
+# modules whose IMPORT requires an optional third-party package or whose
+# examples talk to external services — everything else must doctest clean
+SKIP = {
+    # docstring examples reference external services (kafka brokers, cloud
+    # credentials, LLM endpoints) by design; their code paths are covered
+    # by tests/test_io_connectors.py and tests/test_llm_xpack.py fakes
+}
 
 
-MODULES = [
-    "pathway_tpu.debug",
-    "pathway_tpu.stdlib.temporal._window",
-]
+def _walk_modules():
+    names = ["pathway_tpu"]
+    for info in pkgutil.walk_packages(
+        pathway_tpu.__path__, prefix="pathway_tpu."
+    ):
+        names.append(info.name)
+    return sorted(names)
 
 
-def test_doctests():
-    import importlib
+ALL_MODULES = _walk_modules()
 
-    import pathway_tpu as pw
 
-    total = 0
-    for name in MODULES:
+@pytest.mark.parametrize("name", ALL_MODULES)
+def test_doctests(name):
+    if name in SKIP:
+        pytest.skip(f"{name}: {SKIP[name]}")
+    try:
         mod = importlib.import_module(name)
-        pw.G.clear()
+    except ImportError as exc:
+        # only a genuinely missing third-party package may skip; a broken
+        # internal import must fail the sweep
+        missing = getattr(exc, "name", "") or ""
+        if missing.startswith("pathway_tpu") or "pathway_tpu" in str(exc):
+            raise
+        pytest.skip(f"optional dependency missing: {exc}")
+    pw.G.clear()
+    try:
         results = doctest.testmod(
             mod,
             verbose=False,
-            optionflags=doctest.NORMALIZE_WHITESPACE,
+            optionflags=doctest.NORMALIZE_WHITESPACE
+            | doctest.ELLIPSIS
+            | doctest.IGNORE_EXCEPTION_DETAIL,
         )
-        assert results.failed == 0, f"doctest failures in {name}"
-        total += results.attempted
-    assert total >= 3  # the examples actually ran
+    finally:
+        pw.G.clear()
+    assert results.failed == 0, f"doctest failures in {name}"
+
+
+def test_doctest_sweep_is_package_wide():
+    """The sweep covers the whole package, not a hand-picked subset."""
+    assert len(ALL_MODULES) > 100, len(ALL_MODULES)
+    assert "pathway_tpu.internals.table" in ALL_MODULES
+    assert "pathway_tpu.xpacks.llm.prompts" in ALL_MODULES

@@ -415,3 +415,48 @@ def test_connector_stats_surface():
     assert "monitored_src" in stats, stats
     assert stats["monitored_src"]["rows_read"] >= 5
     assert getattr(eng, "last_batch_latency_ms", None) is not None
+
+
+def test_debug_parquet_round_trip(tmp_path):
+    """table_to_parquet / table_from_parquet (VERDICT r3 item 9;
+    reference: debug/__init__.py:476,493)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.runner import run_tables
+
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | x
+        2 | y
+        """
+    )
+    path = str(tmp_path / "t.parquet")
+    pw.debug.table_to_parquet(t, path)
+    pw.G.clear()
+    t2 = pw.debug.table_from_parquet(path)
+    assert set(t2.column_names()) >= {"a", "b"}
+    (cap,) = run_tables(t2.select(a=t2.a, b=t2.b))
+    assert sorted(cap.state.rows.values()) == [(1, "x"), (2, "y")]
+    pw.G.clear()
+
+
+def test_airbyte_create_source_cli(tmp_path, monkeypatch):
+    """`pathway airbyte create-source` writes a connection template the
+    airbyte reader consumes (reference: cli.py:311-329)."""
+    import yaml
+
+    from pathway_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    rc = main(
+        ["airbyte", "create-source", "demo", "--image", "airbyte/source-faker:0.1.4"]
+    )
+    assert rc == 0
+    path = tmp_path / "connections" / "demo.yaml"
+    assert path.exists()
+    config = yaml.safe_load(path.read_text())
+    assert config["source"]["docker_image"] == "airbyte/source-faker:0.1.4"
+    assert "config" in config["source"]
+    # re-init refuses to clobber an existing connection (clean CLI error)
+    rc2 = main(["airbyte", "create-source", "demo"])
+    assert rc2 == 1
