@@ -387,26 +387,40 @@ class Engine:
 
 
 class StaticSource(Node):
-    """All rows present at time 0 (reference: static_table, engine.pyi)."""
+    """All rows present at time 0 (reference: static_table, engine.pyi).
+
+    Accepts either a key->values dict or a prebuilt consolidated delta
+    list (bulk connectors hand the latter straight from their ingest log,
+    skipping a million-row dict round trip)."""
 
     name = "static"
     snapshot_attrs = ('_emitted',)
 
-    def __init__(self, engine: Engine, rows: Dict[Pointer, tuple]):
+    def __init__(
+        self,
+        engine: Engine,
+        rows: Dict[Pointer, tuple],
+        *,
+        deltas: Optional[List[Delta]] = None,
+    ):
         super().__init__(engine, [])
         self.rows = rows
+        self.deltas = deltas
         self._emitted = False
 
     def process(self, time: int) -> None:
         if not self._emitted and time >= 0:
             self._emitted = True
-            if self.engine.coord.worker_count == 1:
-                self.emit(time, [(k, v, 1) for k, v in self.rows.items()])
-                return
-            owns = self.engine.owns_key
-            self.emit(
-                time, [(k, v, 1) for k, v in self.rows.items() if owns(k)]
-            )
+            # keys are unique by construction: the consolidation pass
+            # (a full key-set build) would be pure overhead here
+            if self.deltas is not None:
+                deltas = self.deltas
+            else:
+                deltas = [(k, v, 1) for k, v in self.rows.items()]
+            if self.engine.coord.worker_count > 1:
+                owns = self.engine.owns_key
+                deltas = [d for d in deltas if owns(d[0])]
+            self.emit_consolidated(time, deltas)
 
 
 class TimedSource(Node):

@@ -11,8 +11,9 @@ lockstep: every `process_time` call is preceded by a global agreement on the
 time (`Coordinator.agree`), which is what differential frontiers give the
 reference.
 
-Wire protocol: length-prefixed pickles on simplex sockets (worker i listens
-on first_port+i; every peer opens one outgoing connection to every other).
+Wire protocol: length-prefixed typed binary frames (engine/wire.py; C++
+codec in native/wire_ext.cpp) on simplex sockets (worker i listens on
+first_port+i; every peer opens one outgoing connection to every other).
 Messages:
   ("hello", from_worker, run_id)
   ("data",  channel, time, deltas)   — deltas routed to this worker
@@ -25,7 +26,6 @@ failure detection, not silent hangs.
 from __future__ import annotations
 
 import os
-import pickle
 import socket
 import struct
 import threading
@@ -94,6 +94,7 @@ class TcpCoordinator(Coordinator):
         self._coord: Dict[int, Dict[int, Any]] = {}
         self._round = 0
         self._dead: set[int] = set()
+        self._dead_reasons: Dict[int, str] = {}
         self._closed = False
         self._out: Dict[int, socket.socket] = {}
         self._out_locks: Dict[int, threading.Lock] = {}
@@ -150,7 +151,9 @@ class TcpCoordinator(Coordinator):
     # -- wire -------------------------------------------------------------
     @staticmethod
     def _send_on(sock: socket.socket, msg: Any) -> None:
-        blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        from pathway_tpu.engine.wire import encode_message
+
+        blob = encode_message(msg)
         sock.sendall(_LEN.pack(len(blob)) + blob)
 
     @staticmethod
@@ -164,6 +167,8 @@ class TcpCoordinator(Coordinator):
         return buf
 
     def _recv_loop(self, conn: socket.socket) -> None:
+        from pathway_tpu.engine.wire import WireError, decode_message
+
         peer = None
         try:
             while True:
@@ -174,7 +179,22 @@ class TcpCoordinator(Coordinator):
                 blob = self._recv_exact(conn, length)
                 if blob is None:
                     break
-                msg = pickle.loads(blob)
+                try:
+                    msg = decode_message(blob)
+                except WireError as exc:
+                    # a malformed frame is a protocol violation, not data:
+                    # fail the run loudly instead of corrupting state
+                    # (frames from connections that never identified
+                    # themselves just drop the connection, like any stray
+                    # connect would)
+                    if peer is not None:
+                        with self._cv:
+                            self._dead_reasons[peer] = (
+                                f"malformed frame: {exc}"
+                            )
+                    raise ExchangeError(
+                        f"malformed frame from peer: {exc}"
+                    ) from None
                 kind = msg[0]
                 if kind == "hello":
                     peer = msg[1]
@@ -184,6 +204,11 @@ class TcpCoordinator(Coordinator):
                             f"expected {self.run_id!r}"
                         )
                     continue
+                if peer is None:
+                    # no data/punct/coord before a valid hello: frames from
+                    # unidentified connections are dropped, closing the
+                    # injection path through a bare socket connect
+                    raise ExchangeError("message before hello; dropping")
                 with self._cv:
                     if kind == "data":
                         _, channel, time, deltas = msg
@@ -225,8 +250,12 @@ class TcpCoordinator(Coordinator):
 
     def _check_dead(self) -> None:
         if self._dead and not self._closed:
+            reasons = "; ".join(
+                f"peer {p}: {r}" for p, r in sorted(self._dead_reasons.items())
+            )
             raise ExchangeError(
                 f"worker {self.worker_id}: peer(s) {sorted(self._dead)} died"
+                + (f" ({reasons})" if reasons else "")
             )
 
     # -- Coordinator API --------------------------------------------------
@@ -540,7 +569,7 @@ def _make_exchange_node():
                         parts[sh % w_count].append(d)
             for w in range(w_count):
                 if w != me and parts[w]:
-                    # chunked sends bound peak pickle/socket buffers on
+                    # chunked sends bound peak frame/socket buffers on
                     # bulk-ingest batches (a single million-row message
                     # costs hundreds of MB on both ends)
                     part = parts[w]

@@ -21,9 +21,17 @@ Delta = Tuple[Pointer, tuple, int]
 def consolidate(deltas: Iterable[Delta]) -> List[Delta]:
     """Sum diffs of identical (key, values); drop zero net changes. Keeps
     retractions before insertions per key so single-valued state transitions
-    are well-ordered."""
+    are well-ordered. Prefers the native C++ pass (native/wire_ext.cpp
+    consolidate) and falls back to the normalizing python walk for batches
+    holding unhashable values (ndarrays/lists/dicts)."""
     if not isinstance(deltas, list):
         deltas = list(deltas)
+    native = _native_consolidate()
+    if native is not None:
+        try:
+            return native(deltas)
+        except TypeError:
+            return _consolidate_unhashable(deltas)
     # fast path: pure insert batches with distinct keys (the bulk-ingest
     # shape) need no value hashing at all — only key uniqueness matters.
     # Both checks are single C-speed passes.
@@ -45,26 +53,7 @@ def consolidate(deltas: Iterable[Delta]) -> List[Delta]:
             prev = get(g)
             acc[g] = diff if prev is None else prev + diff
     except TypeError:
-        # some values hold ndarrays/lists/dicts — redo with the
-        # normalizing walk (rare path; correctness over speed)
-        acc = {}
-        originals: dict = {}
-        for key, values, diff in deltas:
-            try:
-                g = (key, _hashable(values))
-            except TypeError:
-                g = (key, id(values))
-            prev = acc.get(g)
-            acc[g] = diff if prev is None else prev + diff
-            if prev is None:
-                originals[g] = values
-        neg = []
-        pos = []
-        for g, diff in acc.items():
-            if diff == 0:
-                continue
-            (neg if diff < 0 else pos).append((g[0], originals[g], diff))
-        return neg + pos
+        return _consolidate_unhashable(deltas)
     # retractions first, insertions second; stable within each class
     neg = []
     pos = []
@@ -73,6 +62,48 @@ def consolidate(deltas: Iterable[Delta]) -> List[Delta]:
             continue
         (neg if diff < 0 else pos).append((key, values, diff))
     return neg + pos
+
+
+def _consolidate_unhashable(deltas: List[Delta]) -> List[Delta]:
+    """Consolidation for batches holding ndarrays/lists/dicts — the
+    normalizing walk (rare path; correctness over speed)."""
+    acc: dict = {}
+    originals: dict = {}
+    for key, values, diff in deltas:
+        try:
+            g = (key, _hashable(values))
+        except TypeError:
+            g = (key, id(values))
+        prev = acc.get(g)
+        acc[g] = diff if prev is None else prev + diff
+        if prev is None:
+            originals[g] = values
+    neg = []
+    pos = []
+    for g, diff in acc.items():
+        if diff == 0:
+            continue
+        (neg if diff < 0 else pos).append((g[0], originals[g], diff))
+    return neg + pos
+
+
+_native_consolidate_fn = None
+_native_consolidate_checked = False
+
+
+def _native_consolidate():
+    global _native_consolidate_fn, _native_consolidate_checked
+    if not _native_consolidate_checked:
+        _native_consolidate_checked = True
+        try:
+            from pathway_tpu import native
+
+            ext = native.load_wire_ext()
+            if ext is not None:
+                _native_consolidate_fn = ext.consolidate
+        except Exception:  # noqa: BLE001 — python path is always correct
+            _native_consolidate_fn = None
+    return _native_consolidate_fn
 
 
 def _hashable(values: tuple):

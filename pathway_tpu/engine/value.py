@@ -204,7 +204,9 @@ def seq_key(seed: int, counter: int) -> Pointer:
 
 def seq_keys_batch(seed: int, start_counter: int, n: int) -> list:
     """`[seq_key(seed, start_counter + 1 + i) for i in range(n)]`, with the
-    64-bit mixing done in one numpy pass."""
+    64-bit mixing done in one numpy pass and the Pointer objects built in
+    bulk by the native layer when available (tp_alloc + direct slot
+    stores — the per-row key cost dominates bulk ingest otherwise)."""
     hi = (seed >> 64) << 64
     with np.errstate(over="ignore"):
         x = np.arange(
@@ -214,7 +216,46 @@ def seq_keys_batch(seed: int, start_counter: int, n: int) -> list:
         x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
         x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
         x = x ^ (x >> np.uint64(31))
+    fast = _fast_pointer_builder()
+    if fast is not None:
+        return fast(seed >> 64, x.astype("<u8", copy=False).tobytes())
     return [Pointer(hi | v) for v in x.tolist()]
+
+
+_fast_pointers = None
+_fast_pointers_checked = False
+
+
+def _fast_pointer_builder():
+    """Native bulk Pointer constructor, verified once against the python
+    construction path before use (slot layout + hash + equality)."""
+    global _fast_pointers, _fast_pointers_checked
+    if _fast_pointers_checked:
+        return _fast_pointers
+    _fast_pointers_checked = True
+    try:
+        from pathway_tpu import native
+
+        ext = native.load_wire_ext()
+        if ext is None:
+            return None
+        probe_hi = 0xDEAD
+        probe_lo = 0xBEEF00112233
+        (made,) = ext.make_seq_pointers(
+            probe_hi, probe_lo.to_bytes(8, "little")
+        )
+        ref = Pointer((probe_hi << 64) | probe_lo)
+        if (
+            type(made) is Pointer
+            and made == ref
+            and hash(made) == hash(ref)
+            and made.value == ref.value
+            and made._origin is None
+        ):
+            _fast_pointers = ext.make_seq_pointers
+    except Exception:  # noqa: BLE001 — python construction always works
+        _fast_pointers = None
+    return _fast_pointers
 
 
 def seq_key_seed(*name_parts: Any) -> int:

@@ -104,7 +104,6 @@ class _FsSubject(ConnectorSubjectBase):
         elif self.format in ("json", "jsonlines"):
             names = set(self.schema.keys())
             loads = json.loads
-            coerce = _coerce_json_value
             schema = self.schema
             # STR/INT/BOOL json values need no per-value coercion; FLOAT
             # (int -> float promotion) and ANY/Json (dict/list wrapping)
@@ -119,64 +118,31 @@ class _FsSubject(ConnectorSubjectBase):
                     lines = list(islice(fh, 65536))
                     if not lines:
                         break
-                    block = [ln for ln in lines if ln.strip()]
-                    if not block:
-                        continue
                     try:
                         # one C-level parse for the whole chunk beats
-                        # per-line loads() by the per-call scanner setup
-                        objs = loads("[%s]" % ",".join(block))
+                        # per-line loads() by the per-call scanner setup;
+                        # blank lines break the join and fall back below
+                        text = ",".join(lines)
+                        objs = loads("[%s]" % text)
                     except ValueError:
-                        objs = [loads(ln) for ln in block]
-                    if plain:
-                        # drop fields outside the schema (incl. _pw_key,
-                        # which the sink would honor as a raw engine key);
-                        # schema-violating nested values (dict/list under a
-                        # scalar dtype) still go through coercion so they
-                        # reach the engine as hashable Json, as on the
-                        # non-plain path
-                        rows = []
-                        rows_append = rows.append
-                        for obj in objs:
-                            if any(
-                                type(v) is dict or type(v) is list
-                                for v in obj.values()
-                            ):
-                                rows_append(
-                                    {
-                                        k: coerce(v, schema[k].dtype)
-                                        for k, v in obj.items()
-                                        if k in names
-                                    }
-                                )
-                            elif obj.keys() == names:
-                                rows_append(obj)
-                            else:
-                                rows_append(
-                                    {
-                                        k: v
-                                        for k, v in obj.items()
-                                        if k in names
-                                    }
-                                )
-                        if meta:
-                            for row in rows:
-                                row.update(meta)
-                        self.next_batch(rows)
-                    else:
-                        self.next_batch(
-                            [
-                                {
-                                    **{
-                                        k: coerce(v, schema[k].dtype)
-                                        for k, v in obj.items()
-                                        if k in names
-                                    },
-                                    **meta,
-                                }
-                                for obj in objs
-                            ]
-                        )
+                        block = [ln for ln in lines if ln.strip()]
+                        if not block:
+                            continue
+                        text = ",".join(block)
+                        try:
+                            objs = loads("[%s]" % text)
+                        except ValueError:
+                            objs = [loads(ln) for ln in block]
+                            text = None
+                    # chunk-level nested-value scan: values contain a
+                    # dict/list iff the chunk text holds more '{' than
+                    # one per row, or any '[' — two C string passes
+                    flat_chunk = text is not None and (
+                        text.count("{") == len(objs) and "[" not in text
+                    )
+                    self._emit_json_objs(
+                        objs, names, meta, plain, flat_chunk
+                    )
         elif self.format == "csv":
             names = set(self.schema.keys())
             with open(f, "r", newline="", errors="replace") as fh:
@@ -197,6 +163,78 @@ class _FsSubject(ConnectorSubjectBase):
                     self.next_batch(chunk)
         else:
             raise ValueError(f"unknown format {self.format!r}")
+
+
+    _TUPLE_COLS = 3  # specialize the no-dict path up to this width
+
+    def _plain_tuples(self, objs, ordered):
+        """Schema-ordered tuples straight from parsed flat objects —
+        C-speed zip over itemgetter columns; None when any row misses a
+        schema field (the row-dict path fills None and filters extras)."""
+        from operator import itemgetter
+
+        try:
+            cols = [list(map(itemgetter(k), objs)) for k in ordered]
+        except KeyError:
+            return None
+        return list(zip(*cols))
+
+    def _emit_json_objs(self, objs, names, meta, plain, flat_chunk=False):
+        schema = self.schema
+        coerce = _coerce_json_value
+        if plain and not meta and flat_chunk:
+            # fastest path: schema-ordered tuples, no row dicts at all
+            # (flat_chunk proves no value anywhere in the chunk is nested)
+            ordered = [k for k in schema.keys() if k in names]
+            if len(ordered) <= self._TUPLE_COLS:
+                vals = self._plain_tuples(objs, ordered)
+                if vals is not None:
+                    self.next_batch_tuples(vals, ordered)
+                    return
+        if plain:
+            # drop fields outside the schema (incl. _pw_key, which the
+            # sink would honor as a raw engine key); schema-violating
+            # nested values (dict/list under a scalar dtype) still go
+            # through coercion so they reach the engine as hashable Json,
+            # as on the non-plain path
+            rows = []
+            rows_append = rows.append
+            for obj in objs:
+                if any(
+                    type(v) is dict or type(v) is list
+                    for v in obj.values()
+                ):
+                    rows_append(
+                        {
+                            k: coerce(v, schema[k].dtype)
+                            for k, v in obj.items()
+                            if k in names
+                        }
+                    )
+                elif obj.keys() == names:
+                    rows_append(obj)
+                else:
+                    rows_append(
+                        {k: v for k, v in obj.items() if k in names}
+                    )
+            if meta:
+                for row in rows:
+                    row.update(meta)
+            self.next_batch(rows)
+        else:
+            self.next_batch(
+                [
+                    {
+                        **{
+                            k: coerce(v, schema[k].dtype)
+                            for k, v in obj.items()
+                            if k in names
+                        },
+                        **meta,
+                    }
+                    for obj in objs
+                ]
+            )
 
     def run(self) -> None:
         while True:

@@ -120,3 +120,86 @@ def count_tokens_native(text: str) -> Optional[int]:
         return None
     data = text.encode("utf-8", errors="replace")
     return lib.count_tokens(data, len(data))
+
+
+# -- CPython extension modules ----------------------------------------------
+
+_wire_ext = None
+_wire_ext_failed = False
+
+
+def load_wire_ext():
+    """Build (if needed) and import the native wire codec extension
+    (native/wire_ext.cpp); None when the toolchain is unavailable. The
+    extension is registered with the engine's value classes so it can
+    construct Pointers/Json and delegate rare types back to the python
+    codec."""
+    global _wire_ext, _wire_ext_failed
+    if _wire_ext is not None:
+        return _wire_ext
+    if _wire_ext_failed or os.environ.get("PATHWAY_DISABLE_NATIVE"):
+        return None
+    try:
+        import importlib.machinery
+        import importlib.util
+        import sysconfig
+
+        source = _source_path("wire_ext.cpp")
+        with open(source, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        so_path = os.path.join(_cache_dir(), f"pw_wire_ext_{digest}.so")
+        if not os.path.exists(so_path):
+            tmp = so_path + f".tmp{os.getpid()}"
+            subprocess.run(
+                [
+                    "g++",
+                    "-O2",
+                    "-shared",
+                    "-fPIC",
+                    "-std=c++17",
+                    f"-I{sysconfig.get_path('include')}",
+                    source,
+                    "-o",
+                    tmp,
+                ],
+                check=True,
+                capture_output=True,
+                timeout=180,
+            )
+            os.replace(tmp, so_path)
+        loader = importlib.machinery.ExtensionFileLoader(
+            "pw_wire_ext", so_path
+        )
+        spec = importlib.util.spec_from_loader("pw_wire_ext", loader)
+        mod = importlib.util.module_from_spec(spec)
+        loader.exec_module(mod)
+
+        from pathway_tpu.engine import value as _value
+        from pathway_tpu.engine import wire as _wire
+
+        def encode_rare(v) -> bytes:
+            out = bytearray()
+            _wire.encode_value(out, v)
+            return bytes(out)
+
+        def decode_rare(tag: int, frame: bytes, offset: int):
+            # zero-copy: read straight out of the whole frame at offset
+            r = _wire._Reader(frame, offset)
+            v = _wire.decode_value(r, _tag=tag)
+            return v, r.pos - offset
+
+        mod.register_types(
+            _value.Pointer,
+            _value.Json,
+            _value.ERROR,
+            _value.Error,
+            _value.Pending,
+            encode_rare,
+            decode_rare,
+            _wire.WireError,
+        )
+        _wire_ext = mod
+        return mod
+    except Exception:  # noqa: BLE001 — fall back to the python codec
+        _wire_ext_failed = True
+        return None

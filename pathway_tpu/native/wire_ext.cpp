@@ -1,0 +1,927 @@
+// Native twin of pathway_tpu/engine/wire.py — the typed binary wire codec
+// for the exchange protocol, plus a C-speed delta consolidation pass.
+//
+// Implements the identical frame format (see wire.py's module docstring,
+// which is the spec); rare value types (datetimes, ndarrays, opaque
+// objects) are delegated to the registered Python helpers so the two
+// codecs cannot drift on the long tail. Built as a CPython extension
+// module by native/__init__.py via the system toolchain (the reference
+// keeps this layer in Rust: src/engine/dataflow/config.rs bincode
+// transport; here C++ per the build environment).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <structmember.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// value tags — must match engine/wire.py
+enum Tag : uint8_t {
+  TAG_NONE = 0,
+  TAG_TRUE = 1,
+  TAG_FALSE = 2,
+  TAG_INT = 3,
+  TAG_BIGINT = 4,
+  TAG_FLOAT = 5,
+  TAG_STR = 6,
+  TAG_BYTES = 7,
+  TAG_POINTER = 8,
+  TAG_TUPLE = 9,
+  TAG_LIST = 10,
+  TAG_DICT = 11,
+  TAG_JSON = 12,
+  TAG_NDARRAY = 13,
+  TAG_ERROR = 14,
+  TAG_PENDING = 15,
+};
+
+enum MsgType : uint8_t {
+  MSG_HELLO = 0x01,
+  MSG_DATA = 0x02,
+  MSG_PUNCT = 0x03,
+  MSG_COORD = 0x04,
+};
+
+// registered Python objects (set once via register_types)
+PyObject *g_pointer_cls = nullptr;   // engine.value.Pointer
+PyObject *g_json_cls = nullptr;      // engine.value.Json
+PyObject *g_error_obj = nullptr;     // engine.value.ERROR
+PyObject *g_error_cls = nullptr;     // engine.value.Error
+PyObject *g_pending_obj = nullptr;   // engine.value.Pending
+PyObject *g_encode_rare = nullptr;   // wire._native_encode_rare(value)->bytes
+PyObject *g_decode_rare = nullptr;   // wire._native_decode_rare(tag, bytes)
+PyObject *g_wire_error = nullptr;    // wire.WireError
+
+struct Buf {
+  std::vector<uint8_t> d;
+  void put(uint8_t b) { d.push_back(b); }
+  void put_raw(const void *p, size_t n) {
+    const uint8_t *c = static_cast<const uint8_t *>(p);
+    d.insert(d.end(), c, c + n);
+  }
+  void uvarint(uint64_t n) {
+    while (true) {
+      uint8_t b = n & 0x7f;
+      n >>= 7;
+      if (n) {
+        put(b | 0x80);
+      } else {
+        put(b);
+        return;
+      }
+    }
+  }
+  void zigzag(int64_t n) {
+    uvarint((static_cast<uint64_t>(n) << 1) ^
+            static_cast<uint64_t>(n >> 63));
+  }
+  void u32(uint32_t v) { put_raw(&v, 4); }
+  void u64(uint64_t v) { put_raw(&v, 8); }
+};
+
+struct Reader {
+  const uint8_t *p;
+  const uint8_t *end;
+  PyObject *frame = nullptr;  // borrowed: the whole frame bytes object
+  const uint8_t *base = nullptr;
+  bool fail = false;
+
+  bool need(size_t n) {
+    if (static_cast<size_t>(end - p) < n) {
+      fail = true;
+      return false;
+    }
+    return true;
+  }
+  uint8_t byte() {
+    if (!need(1)) return 0;
+    return *p++;
+  }
+  const uint8_t *take(size_t n) {
+    if (!need(n)) return nullptr;
+    const uint8_t *r = p;
+    p += n;
+    return r;
+  }
+  uint64_t uvarint() {
+    uint64_t acc = 0;
+    int shift = 0;
+    while (true) {
+      uint8_t b = byte();
+      if (fail) return 0;
+      acc |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return acc;
+      shift += 7;
+      if (shift > 63) {
+        fail = true;
+        return 0;
+      }
+    }
+  }
+  int64_t zigzag() {
+    uint64_t z = uvarint();
+    return static_cast<int64_t>((z >> 1) ^ (~(z & 1) + 1));
+  }
+};
+
+void wire_err(const char *msg) {
+  PyErr_SetString(g_wire_error ? g_wire_error : PyExc_ValueError, msg);
+}
+
+// 128-bit key <-> 16 bytes via Python int attr "value"
+bool encode_key(Buf &out, PyObject *key) {
+  PyObject *val = PyObject_GetAttrString(key, "value");
+  if (!val) return false;
+  uint8_t raw[16];
+  if (_PyLong_AsByteArray(reinterpret_cast<PyLongObject *>(val), raw, 16, 1,
+                          0) < 0) {
+    Py_DECREF(val);
+    return false;
+  }
+  Py_DECREF(val);
+  out.put_raw(raw, 16);
+  return true;
+}
+
+PyObject *decode_key(Reader &r) {
+  const uint8_t *raw = r.take(16);
+  if (!raw) {
+    wire_err("truncated frame (key)");
+    return nullptr;
+  }
+  PyObject *val = _PyLong_FromByteArray(raw, 16, 1, 0);
+  if (!val) return nullptr;
+  PyObject *ptr = PyObject_CallFunctionObjArgs(g_pointer_cls, val, nullptr);
+  Py_DECREF(val);
+  return ptr;
+}
+
+bool encode_value(Buf &out, PyObject *v);
+
+bool encode_rare(Buf &out, PyObject *v) {
+  // python helper returns the already-tagged bytes for rare values
+  PyObject *blob = PyObject_CallFunctionObjArgs(g_encode_rare, v, nullptr);
+  if (!blob) return false;
+  char *raw;
+  Py_ssize_t n;
+  if (PyBytes_AsStringAndSize(blob, &raw, &n) < 0) {
+    Py_DECREF(blob);
+    return false;
+  }
+  out.put_raw(raw, static_cast<size_t>(n));
+  Py_DECREF(blob);
+  return true;
+}
+
+bool encode_value(Buf &out, PyObject *v) {
+  if (v == Py_None) {
+    out.put(TAG_NONE);
+  } else if (v == Py_True) {
+    out.put(TAG_TRUE);
+  } else if (v == Py_False) {
+    out.put(TAG_FALSE);
+  } else if (PyLong_CheckExact(v)) {
+    int overflow = 0;
+    int64_t n = PyLong_AsLongLongAndOverflow(v, &overflow);
+    if (!overflow) {
+      out.put(TAG_INT);
+      out.zigzag(n);
+    } else {
+      // arbitrary-precision escape
+      size_t nbits = _PyLong_NumBits(v);
+      size_t nbytes = nbits / 8 + 1;
+      std::vector<uint8_t> raw(nbytes);
+      if (_PyLong_AsByteArray(reinterpret_cast<PyLongObject *>(v), raw.data(),
+                              nbytes, 1, 1) < 0)
+        return false;
+      out.put(TAG_BIGINT);
+      out.uvarint(nbytes);
+      out.put_raw(raw.data(), nbytes);
+    }
+  } else if (PyFloat_CheckExact(v)) {
+    double d = PyFloat_AS_DOUBLE(v);
+    out.put(TAG_FLOAT);
+    out.put_raw(&d, 8);
+  } else if (PyUnicode_CheckExact(v)) {
+    Py_ssize_t n;
+    const char *raw = PyUnicode_AsUTF8AndSize(v, &n);
+    if (!raw) return false;
+    out.put(TAG_STR);
+    out.uvarint(static_cast<uint64_t>(n));
+    out.put_raw(raw, static_cast<size_t>(n));
+  } else if (PyBytes_CheckExact(v)) {
+    char *raw;
+    Py_ssize_t n;
+    PyBytes_AsStringAndSize(v, &raw, &n);
+    out.put(TAG_BYTES);
+    out.uvarint(static_cast<uint64_t>(n));
+    out.put_raw(raw, static_cast<size_t>(n));
+  } else if (Py_TYPE(v) == reinterpret_cast<PyTypeObject *>(g_pointer_cls)) {
+    out.put(TAG_POINTER);
+    if (!encode_key(out, v)) return false;
+  } else if (PyTuple_CheckExact(v)) {
+    Py_ssize_t n = PyTuple_GET_SIZE(v);
+    out.put(TAG_TUPLE);
+    out.uvarint(static_cast<uint64_t>(n));
+    for (Py_ssize_t i = 0; i < n; i++)
+      if (!encode_value(out, PyTuple_GET_ITEM(v, i))) return false;
+  } else if (PyList_CheckExact(v)) {
+    Py_ssize_t n = PyList_GET_SIZE(v);
+    out.put(TAG_LIST);
+    out.uvarint(static_cast<uint64_t>(n));
+    for (Py_ssize_t i = 0; i < n; i++)
+      if (!encode_value(out, PyList_GET_ITEM(v, i))) return false;
+  } else if (PyDict_CheckExact(v)) {
+    out.put(TAG_DICT);
+    out.uvarint(static_cast<uint64_t>(PyDict_GET_SIZE(v)));
+    PyObject *key, *value;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(v, &pos, &key, &value)) {
+      if (!encode_value(out, key)) return false;
+      if (!encode_value(out, value)) return false;
+    }
+  } else if (Py_TYPE(v) == reinterpret_cast<PyTypeObject *>(g_json_cls)) {
+    PyObject *inner = PyObject_GetAttrString(v, "value");
+    if (!inner) return false;
+    out.put(TAG_JSON);
+    bool ok = encode_value(out, inner);
+    Py_DECREF(inner);
+    if (!ok) return false;
+  } else if (Py_TYPE(v) == reinterpret_cast<PyTypeObject *>(g_error_cls)) {
+    out.put(TAG_ERROR);
+  } else if (v == g_pending_obj) {
+    out.put(TAG_PENDING);
+  } else {
+    // datetimes, ndarrays, np scalars, opaque objects: python helper
+    if (!encode_rare(out, v)) return false;
+  }
+  return true;
+}
+
+PyObject *decode_value(Reader &r);
+
+PyObject *decode_rare(Reader &r, uint8_t tag) {
+  // hand (tag, whole frame, offset) to python — zero-copy; it returns
+  // (value, bytes_consumed_after_tag)
+  if (!r.frame) {
+    wire_err("rare value outside a frame context");
+    return nullptr;
+  }
+  PyObject *res = PyObject_CallFunction(
+      g_decode_rare, "iOn", (int)tag, r.frame,
+      static_cast<Py_ssize_t>(r.p - r.base));
+  if (!res) return nullptr;
+  PyObject *value = PyTuple_GetItem(res, 0);
+  PyObject *consumed = PyTuple_GetItem(res, 1);
+  if (!value || !consumed) {
+    Py_DECREF(res);
+    return nullptr;
+  }
+  long n = PyLong_AsLong(consumed);
+  if (n < 0 || n > (r.end - r.p)) {
+    Py_DECREF(res);
+    wire_err("rare decoder consumed out of range");
+    return nullptr;
+  }
+  r.p += n;
+  Py_INCREF(value);
+  Py_DECREF(res);
+  return value;
+}
+
+PyObject *decode_value(Reader &r) {
+  uint8_t tag = r.byte();
+  if (r.fail) {
+    wire_err("truncated frame (tag)");
+    return nullptr;
+  }
+  switch (tag) {
+    case TAG_NONE:
+      Py_RETURN_NONE;
+    case TAG_TRUE:
+      Py_RETURN_TRUE;
+    case TAG_FALSE:
+      Py_RETURN_FALSE;
+    case TAG_INT: {
+      int64_t n = r.zigzag();
+      if (r.fail) {
+        wire_err("truncated frame (int)");
+        return nullptr;
+      }
+      return PyLong_FromLongLong(n);
+    }
+    case TAG_BIGINT: {
+      uint64_t n = r.uvarint();
+      const uint8_t *raw = r.take(n);
+      if (!raw) {
+        wire_err("truncated frame (bigint)");
+        return nullptr;
+      }
+      return _PyLong_FromByteArray(raw, n, 1, 1);
+    }
+    case TAG_FLOAT: {
+      const uint8_t *raw = r.take(8);
+      if (!raw) {
+        wire_err("truncated frame (float)");
+        return nullptr;
+      }
+      double d;
+      std::memcpy(&d, raw, 8);
+      return PyFloat_FromDouble(d);
+    }
+    case TAG_STR: {
+      uint64_t n = r.uvarint();
+      const uint8_t *raw = r.take(n);
+      if (!raw) {
+        wire_err("truncated frame (str)");
+        return nullptr;
+      }
+      return PyUnicode_DecodeUTF8(reinterpret_cast<const char *>(raw), n,
+                                  nullptr);
+    }
+    case TAG_BYTES: {
+      uint64_t n = r.uvarint();
+      const uint8_t *raw = r.take(n);
+      if (!raw) {
+        wire_err("truncated frame (bytes)");
+        return nullptr;
+      }
+      return PyBytes_FromStringAndSize(reinterpret_cast<const char *>(raw),
+                                       n);
+    }
+    case TAG_POINTER:
+      return decode_key(r);
+    case TAG_TUPLE: {
+      uint64_t n = r.uvarint();
+      // each element is >= 1 byte
+      if (r.fail || n > static_cast<uint64_t>(r.end - r.p)) {
+        wire_err("truncated frame (tuple)");
+        return nullptr;
+      }
+      PyObject *t = PyTuple_New(n);
+      if (!t) return nullptr;
+      for (uint64_t i = 0; i < n; i++) {
+        PyObject *x = decode_value(r);
+        if (!x) {
+          Py_DECREF(t);
+          return nullptr;
+        }
+        PyTuple_SET_ITEM(t, i, x);
+      }
+      return t;
+    }
+    case TAG_LIST: {
+      uint64_t n = r.uvarint();
+      if (r.fail || n > static_cast<uint64_t>(r.end - r.p)) {
+        wire_err("truncated frame (list)");
+        return nullptr;
+      }
+      PyObject *t = PyList_New(n);
+      if (!t) return nullptr;
+      for (uint64_t i = 0; i < n; i++) {
+        PyObject *x = decode_value(r);
+        if (!x) {
+          Py_DECREF(t);
+          return nullptr;
+        }
+        PyList_SET_ITEM(t, i, x);
+      }
+      return t;
+    }
+    case TAG_DICT: {
+      uint64_t n = r.uvarint();
+      PyObject *d = PyDict_New();
+      if (!d) return nullptr;
+      for (uint64_t i = 0; i < n; i++) {
+        PyObject *k = decode_value(r);
+        if (!k) {
+          Py_DECREF(d);
+          return nullptr;
+        }
+        PyObject *v = decode_value(r);
+        if (!v) {
+          Py_DECREF(k);
+          Py_DECREF(d);
+          return nullptr;
+        }
+        if (PyDict_SetItem(d, k, v) < 0) {
+          Py_DECREF(k);
+          Py_DECREF(v);
+          Py_DECREF(d);
+          return nullptr;
+        }
+        Py_DECREF(k);
+        Py_DECREF(v);
+      }
+      return d;
+    }
+    case TAG_JSON: {
+      PyObject *inner = decode_value(r);
+      if (!inner) return nullptr;
+      PyObject *j =
+          PyObject_CallFunctionObjArgs(g_json_cls, inner, nullptr);
+      Py_DECREF(inner);
+      return j;
+    }
+    case TAG_ERROR:
+      Py_INCREF(g_error_obj);
+      return g_error_obj;
+    case TAG_PENDING:
+      Py_INCREF(g_pending_obj);
+      return g_pending_obj;
+    default:
+      return decode_rare(r, tag);
+  }
+}
+
+// -- deltas -----------------------------------------------------------------
+
+bool encode_deltas(Buf &out, PyObject *deltas) {
+  if (!PyList_CheckExact(deltas)) {
+    wire_err("deltas must be a list");
+    return false;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(deltas);
+  out.uvarint(static_cast<uint64_t>(n));
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *d = PyList_GET_ITEM(deltas, i);
+    if (!PyTuple_CheckExact(d) || PyTuple_GET_SIZE(d) != 3) {
+      wire_err("delta must be a (key, values, diff) tuple");
+      return false;
+    }
+    if (!encode_key(out, PyTuple_GET_ITEM(d, 0))) return false;
+    PyObject *diff = PyTuple_GET_ITEM(d, 2);
+    int64_t diff_n = PyLong_AsLongLong(diff);
+    if (diff_n == -1 && PyErr_Occurred()) return false;
+    out.zigzag(diff_n);
+    PyObject *values = PyTuple_GET_ITEM(d, 1);
+    if (!PyTuple_CheckExact(values)) {
+      wire_err("delta values must be a tuple");
+      return false;
+    }
+    Py_ssize_t ncols = PyTuple_GET_SIZE(values);
+    out.uvarint(static_cast<uint64_t>(ncols));
+    for (Py_ssize_t c = 0; c < ncols; c++)
+      if (!encode_value(out, PyTuple_GET_ITEM(values, c))) return false;
+  }
+  return true;
+}
+
+PyObject *decode_deltas(Reader &r) {
+  uint64_t n = r.uvarint();
+  // each delta is at least key(16)+diff(1)+ncols(1) = 18 bytes
+  if (r.fail || n > static_cast<uint64_t>(r.end - r.p) / 18) {
+    wire_err("truncated frame (deltas)");
+    return nullptr;
+  }
+  PyObject *out = PyList_New(n);
+  if (!out) return nullptr;
+  for (uint64_t i = 0; i < n; i++) {
+    PyObject *key = decode_key(r);
+    if (!key) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    int64_t diff = r.zigzag();
+    uint64_t ncols = r.uvarint();
+    if (r.fail) {
+      wire_err("truncated frame (delta header)");
+      Py_DECREF(key);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyObject *values = PyTuple_New(ncols);
+    if (!values) {
+      Py_DECREF(key);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    for (uint64_t c = 0; c < ncols; c++) {
+      PyObject *v = decode_value(r);
+      if (!v) {
+        Py_DECREF(values);
+        Py_DECREF(key);
+        Py_DECREF(out);
+        return nullptr;
+      }
+      PyTuple_SET_ITEM(values, c, v);
+    }
+    PyObject *delta = PyTuple_New(3);
+    if (!delta) {
+      Py_DECREF(values);
+      Py_DECREF(key);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyTuple_SET_ITEM(delta, 0, key);
+    PyTuple_SET_ITEM(delta, 1, values);
+    PyTuple_SET_ITEM(delta, 2, PyLong_FromLongLong(diff));
+    PyList_SET_ITEM(out, i, delta);
+  }
+  return out;
+}
+
+// -- module functions -------------------------------------------------------
+
+PyObject *py_register_types(PyObject *, PyObject *args) {
+  PyObject *pointer_cls, *json_cls, *error_obj, *error_cls, *pending_obj,
+      *encode_rare_fn, *decode_rare_fn, *wire_error;
+  if (!PyArg_ParseTuple(args, "OOOOOOOO", &pointer_cls, &json_cls, &error_obj,
+                        &error_cls, &pending_obj, &encode_rare_fn,
+                        &decode_rare_fn, &wire_error))
+    return nullptr;
+#define SET(g, v) \
+  Py_XDECREF(g);  \
+  Py_INCREF(v);   \
+  g = v;
+  SET(g_pointer_cls, pointer_cls)
+  SET(g_json_cls, json_cls)
+  SET(g_error_obj, error_obj)
+  SET(g_error_cls, error_cls)
+  SET(g_pending_obj, pending_obj)
+  SET(g_encode_rare, encode_rare_fn)
+  SET(g_decode_rare, decode_rare_fn)
+  SET(g_wire_error, wire_error)
+#undef SET
+  Py_RETURN_NONE;
+}
+
+PyObject *py_encode_message(PyObject *, PyObject *arg) {
+  if (!PyTuple_Check(arg) || PyTuple_GET_SIZE(arg) < 1) {
+    wire_err("message must be a tuple");
+    return nullptr;
+  }
+  PyObject *kind = PyTuple_GET_ITEM(arg, 0);
+  const char *k = PyUnicode_AsUTF8(kind);
+  if (!k) return nullptr;
+  Buf out;
+  if (std::strcmp(k, "data") == 0 && PyTuple_GET_SIZE(arg) == 4) {
+    out.put(MSG_DATA);
+    long channel = PyLong_AsLong(PyTuple_GET_ITEM(arg, 1));
+    if (channel == -1 && PyErr_Occurred()) return nullptr;
+    out.u32(static_cast<uint32_t>(channel));
+    int64_t time = PyLong_AsLongLong(PyTuple_GET_ITEM(arg, 2));
+    if (time == -1 && PyErr_Occurred()) return nullptr;
+    out.zigzag(time);
+    if (!encode_deltas(out, PyTuple_GET_ITEM(arg, 3))) return nullptr;
+  } else if (std::strcmp(k, "punct") == 0 && PyTuple_GET_SIZE(arg) == 3) {
+    out.put(MSG_PUNCT);
+    long channel = PyLong_AsLong(PyTuple_GET_ITEM(arg, 1));
+    if (channel == -1 && PyErr_Occurred()) return nullptr;
+    out.u32(static_cast<uint32_t>(channel));
+    int64_t time = PyLong_AsLongLong(PyTuple_GET_ITEM(arg, 2));
+    if (time == -1 && PyErr_Occurred()) return nullptr;
+    out.zigzag(time);
+  } else if (std::strcmp(k, "coord") == 0 && PyTuple_GET_SIZE(arg) == 3) {
+    out.put(MSG_COORD);
+    uint64_t round_no =
+        PyLong_AsUnsignedLongLong(PyTuple_GET_ITEM(arg, 1));
+    if (PyErr_Occurred()) return nullptr;
+    out.u64(round_no);
+    if (!encode_value(out, PyTuple_GET_ITEM(arg, 2))) return nullptr;
+  } else if (std::strcmp(k, "hello") == 0 && PyTuple_GET_SIZE(arg) == 3) {
+    out.put(MSG_HELLO);
+    long worker = PyLong_AsLong(PyTuple_GET_ITEM(arg, 1));
+    if (worker == -1 && PyErr_Occurred()) return nullptr;
+    out.u32(static_cast<uint32_t>(worker));
+    Py_ssize_t n;
+    const char *run_id =
+        PyUnicode_AsUTF8AndSize(PyTuple_GET_ITEM(arg, 2), &n);
+    if (!run_id) return nullptr;
+    out.uvarint(static_cast<uint64_t>(n));
+    out.put_raw(run_id, static_cast<size_t>(n));
+  } else {
+    wire_err("unknown message kind");
+    return nullptr;
+  }
+  return PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(out.d.data()),
+      static_cast<Py_ssize_t>(out.d.size()));
+}
+
+PyObject *py_decode_message(PyObject *, PyObject *arg) {
+  char *raw;
+  Py_ssize_t n;
+  if (PyBytes_AsStringAndSize(arg, &raw, &n) < 0) return nullptr;
+  Reader r;
+  r.p = reinterpret_cast<const uint8_t *>(raw);
+  r.end = r.p + n;
+  r.frame = arg;
+  r.base = r.p;
+  uint8_t kind = r.byte();
+  if (r.fail) {
+    wire_err("empty frame");
+    return nullptr;
+  }
+  PyObject *msg = nullptr;
+  if (kind == MSG_DATA) {
+    const uint8_t *ch = r.take(4);
+    if (!ch) {
+      wire_err("truncated frame (channel)");
+      return nullptr;
+    }
+    uint32_t channel;
+    std::memcpy(&channel, ch, 4);
+    int64_t time = r.zigzag();
+    if (r.fail) {
+      wire_err("truncated frame (time)");
+      return nullptr;
+    }
+    PyObject *deltas = decode_deltas(r);
+    if (!deltas) return nullptr;
+    msg = Py_BuildValue("(sILN)", "data", (unsigned int)channel,
+                        (long long)time, deltas);
+  } else if (kind == MSG_PUNCT) {
+    const uint8_t *ch = r.take(4);
+    if (!ch) {
+      wire_err("truncated frame (channel)");
+      return nullptr;
+    }
+    uint32_t channel;
+    std::memcpy(&channel, ch, 4);
+    int64_t time = r.zigzag();
+    if (r.fail) {
+      wire_err("truncated frame (time)");
+      return nullptr;
+    }
+    msg = Py_BuildValue("(sIL)", "punct", (unsigned int)channel,
+                        (long long)time);
+  } else if (kind == MSG_COORD) {
+    const uint8_t *rd = r.take(8);
+    if (!rd) {
+      wire_err("truncated frame (round)");
+      return nullptr;
+    }
+    uint64_t round_no;
+    std::memcpy(&round_no, rd, 8);
+    PyObject *payload = decode_value(r);
+    if (!payload) return nullptr;
+    msg = Py_BuildValue("(sKN)", "coord", (unsigned long long)round_no,
+                        payload);
+  } else if (kind == MSG_HELLO) {
+    const uint8_t *w = r.take(4);
+    if (!w) {
+      wire_err("truncated frame (worker)");
+      return nullptr;
+    }
+    uint32_t worker;
+    std::memcpy(&worker, w, 4);
+    uint64_t len = r.uvarint();
+    const uint8_t *rid = r.take(len);
+    if (!rid) {
+      wire_err("truncated frame (run id)");
+      return nullptr;
+    }
+    msg = Py_BuildValue("(sIs#)", "hello", (unsigned int)worker,
+                        (const char *)rid, (Py_ssize_t)len);
+  } else {
+    wire_err("unknown message type");
+    return nullptr;
+  }
+  if (!msg) return nullptr;
+  if (r.p != r.end) {
+    Py_DECREF(msg);
+    wire_err("trailing bytes in frame");
+    return nullptr;
+  }
+  return msg;
+}
+
+// C-speed consolidation: sum diffs of identical (key, values), drop zero
+// nets, retractions before insertions (mirrors stream.consolidate's
+// hashable fast path; raises TypeError for the caller's fallback on
+// unhashable values).
+PyObject *py_consolidate(PyObject *, PyObject *arg) {
+  if (!PyList_CheckExact(arg)) {
+    PyErr_SetString(PyExc_TypeError, "consolidate expects a list");
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(arg);
+  // fast path: all-insert batches with distinct keys pass through
+  bool all_insert = true;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *d = PyList_GET_ITEM(arg, i);
+    PyObject *diff = PyTuple_GET_ITEM(d, 2);
+    if (PyLong_AsLongLong(diff) < 0) {
+      all_insert = false;
+      break;
+    }
+  }
+  if (all_insert) {
+    PyObject *seen = PySet_New(nullptr);
+    if (!seen) return nullptr;
+    bool distinct = true;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *key = PyTuple_GET_ITEM(PyList_GET_ITEM(arg, i), 0);
+      int r = PySet_Contains(seen, key);
+      if (r < 0) {
+        Py_DECREF(seen);
+        return nullptr;
+      }
+      if (r) {
+        distinct = false;
+        break;
+      }
+      if (PySet_Add(seen, key) < 0) {
+        Py_DECREF(seen);
+        return nullptr;
+      }
+    }
+    Py_DECREF(seen);
+    if (distinct) {
+      Py_INCREF(arg);
+      return arg;
+    }
+  }
+  PyObject *acc = PyDict_New();  // (key, values) -> summed diff
+  if (!acc) return nullptr;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *d = PyList_GET_ITEM(arg, i);
+    PyObject *g = PyTuple_New(2);
+    if (!g) {
+      Py_DECREF(acc);
+      return nullptr;
+    }
+    PyObject *key = PyTuple_GET_ITEM(d, 0);
+    PyObject *values = PyTuple_GET_ITEM(d, 1);
+    Py_INCREF(key);
+    Py_INCREF(values);
+    PyTuple_SET_ITEM(g, 0, key);
+    PyTuple_SET_ITEM(g, 1, values);
+    PyObject *prev = PyDict_GetItemWithError(acc, g);
+    if (!prev && PyErr_Occurred()) {  // unhashable -> caller's fallback
+      Py_DECREF(g);
+      Py_DECREF(acc);
+      return nullptr;
+    }
+    long long sum = PyLong_AsLongLong(PyTuple_GET_ITEM(d, 2));
+    if (prev) sum += PyLong_AsLongLong(prev);
+    PyObject *sum_obj = PyLong_FromLongLong(sum);
+    if (!sum_obj || PyDict_SetItem(acc, g, sum_obj) < 0) {
+      Py_XDECREF(sum_obj);
+      Py_DECREF(g);
+      Py_DECREF(acc);
+      return nullptr;
+    }
+    Py_DECREF(sum_obj);
+    Py_DECREF(g);
+  }
+  PyObject *neg = PyList_New(0);
+  PyObject *pos = PyList_New(0);
+  if (!neg || !pos) {
+    Py_XDECREF(neg);
+    Py_XDECREF(pos);
+    Py_DECREF(acc);
+    return nullptr;
+  }
+  PyObject *g, *diff;
+  Py_ssize_t pos_i = 0;
+  while (PyDict_Next(acc, &pos_i, &g, &diff)) {
+    long long dv = PyLong_AsLongLong(diff);
+    if (dv == 0) continue;
+    PyObject *delta = PyTuple_New(3);
+    if (!delta) {
+      Py_DECREF(neg);
+      Py_DECREF(pos);
+      Py_DECREF(acc);
+      return nullptr;
+    }
+    PyObject *key = PyTuple_GET_ITEM(g, 0);
+    PyObject *values = PyTuple_GET_ITEM(g, 1);
+    Py_INCREF(key);
+    Py_INCREF(values);
+    Py_INCREF(diff);
+    PyTuple_SET_ITEM(delta, 0, key);
+    PyTuple_SET_ITEM(delta, 1, values);
+    PyTuple_SET_ITEM(delta, 2, diff);
+    if (PyList_Append(dv < 0 ? neg : pos, delta) < 0) {
+      Py_DECREF(delta);
+      Py_DECREF(neg);
+      Py_DECREF(pos);
+      Py_DECREF(acc);
+      return nullptr;
+    }
+    Py_DECREF(delta);
+  }
+  Py_DECREF(acc);
+  PyObject *result = PySequence_InPlaceConcat(neg, pos);
+  Py_DECREF(pos);
+  if (!result) {
+    Py_DECREF(neg);
+    return nullptr;
+  }
+  return result;  // == neg (in-place concat returns it)
+}
+
+// -- bulk Pointer construction ----------------------------------------------
+//
+// Pointer is a __slots__ class; CPython lays its slots out at fixed
+// offsets reachable through the member descriptors in tp_dict. Building
+// the objects with tp_alloc + direct slot stores skips the __init__
+// bytecode — the per-row key-creation cost that dominates bulk ingest.
+// The python side verifies one object built this way against a normally
+// constructed Pointer before enabling the path.
+
+Py_ssize_t slot_offset(PyTypeObject *tp, const char *name) {
+  PyObject *descr = PyDict_GetItemString(tp->tp_dict, name);
+  if (!descr || Py_TYPE(descr) != &PyMemberDescr_Type) return -1;
+  PyMemberDef *m = reinterpret_cast<PyMemberDescrObject *>(descr)->d_member;
+  if (!m || m->type != T_OBJECT_EX) return -1;
+  return m->offset;
+}
+
+// make_seq_pointers(hi64: int, lows: bytes of little-endian u64) -> list
+PyObject *py_make_seq_pointers(PyObject *, PyObject *args) {
+  unsigned long long hi64;
+  Py_buffer lows;
+  if (!PyArg_ParseTuple(args, "Ky*", &hi64, &lows)) return nullptr;
+  if (lows.len % 8 != 0) {
+    PyBuffer_Release(&lows);
+    PyErr_SetString(PyExc_ValueError, "lows must be u64-aligned bytes");
+    return nullptr;
+  }
+  PyTypeObject *tp = reinterpret_cast<PyTypeObject *>(g_pointer_cls);
+  Py_ssize_t off_value = slot_offset(tp, "value");
+  Py_ssize_t off_origin = slot_offset(tp, "_origin");
+  Py_ssize_t off_h = slot_offset(tp, "_h");
+  if (off_value < 0 || off_origin < 0 || off_h < 0) {
+    PyBuffer_Release(&lows);
+    PyErr_SetString(PyExc_TypeError, "Pointer slot layout not recognized");
+    return nullptr;
+  }
+  Py_ssize_t n = lows.len / 8;
+  const uint8_t *src = static_cast<const uint8_t *>(lows.buf);
+  PyObject *out = PyList_New(n);
+  if (!out) {
+    PyBuffer_Release(&lows);
+    return nullptr;
+  }
+  uint8_t raw[16];
+  std::memcpy(raw + 8, &hi64, 8);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    std::memcpy(raw, src + i * 8, 8);
+    PyObject *val =
+        hi64 ? _PyLong_FromByteArray(raw, 16, 1, 0)
+             : PyLong_FromUnsignedLongLong(
+                   *reinterpret_cast<const uint64_t *>(src + i * 8));
+    if (!val) goto fail;
+    {
+      Py_hash_t h = PyObject_Hash(val);
+      if (h == -1 && PyErr_Occurred()) {
+        Py_DECREF(val);
+        goto fail;
+      }
+      PyObject *h_obj = PyLong_FromSsize_t(h);
+      if (!h_obj) {
+        Py_DECREF(val);
+        goto fail;
+      }
+      PyObject *obj = tp->tp_alloc(tp, 0);
+      if (!obj) {
+        Py_DECREF(val);
+        Py_DECREF(h_obj);
+        goto fail;
+      }
+      *reinterpret_cast<PyObject **>(reinterpret_cast<char *>(obj) +
+                                     off_value) = val;  // steals
+      Py_INCREF(Py_None);
+      *reinterpret_cast<PyObject **>(reinterpret_cast<char *>(obj) +
+                                     off_origin) = Py_None;
+      *reinterpret_cast<PyObject **>(reinterpret_cast<char *>(obj) + off_h) =
+          h_obj;  // steals
+      PyList_SET_ITEM(out, i, obj);
+    }
+  }
+  PyBuffer_Release(&lows);
+  return out;
+fail:
+  PyBuffer_Release(&lows);
+  Py_DECREF(out);
+  return nullptr;
+}
+
+PyMethodDef methods[] = {
+    {"make_seq_pointers", py_make_seq_pointers, METH_VARARGS,
+     "bulk-construct Pointer objects from (hi64, u64-LE bytes)"},
+    {"register_types", py_register_types, METH_VARARGS,
+     "register engine value classes and rare-type helpers"},
+    {"encode_message", py_encode_message, METH_O,
+     "encode an exchange message tuple to bytes"},
+    {"decode_message", py_decode_message, METH_O,
+     "decode bytes to an exchange message tuple"},
+    {"consolidate", py_consolidate, METH_O,
+     "sum diffs of identical (key, values); raises TypeError on "
+     "unhashable values"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef module = {PyModuleDef_HEAD_INIT, "pw_wire_ext",
+                      "native wire codec + consolidation", -1, methods};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_pw_wire_ext(void) { return PyModule_Create(&module); }

@@ -219,6 +219,12 @@ def answer_with_geometric_rag_strategy_from_index(
     until the chat commits to an answer. Returns the answer column."""
     if not isinstance(documents_column_name, str):
         documents_column_name = documents_column_name.name
+    if questions.name == documents_column_name:
+        # collapse_rows gives query columns precedence over same-named
+        # reply columns — requery under a reserved name so the documents
+        # column survives
+        qt = questions._table.select(**{"_pw_rag_query": questions})
+        questions = qt["_pw_rag_query"]
     max_documents = n_starting_documents * (factor ** (max_iterations - 1))
     reply = index.query_as_of_now(
         questions,

@@ -446,8 +446,13 @@ def test_airbyte_create_source_cli(tmp_path, monkeypatch):
     import yaml
 
     from pathway_tpu.cli import main
+    from pathway_tpu.io import airbyte as airbyte_mod
 
     monkeypatch.chdir(tmp_path)
+    # never run a real `docker run` (pulls images over the network)
+    monkeypatch.setattr(
+        airbyte_mod, "_sample_config_from_spec", lambda image: {}
+    )
     rc = main(
         ["airbyte", "create-source", "demo", "--image", "airbyte/source-faker:0.1.4"]
     )

@@ -1,0 +1,258 @@
+"""Typed binary wire codec: python/native parity, round-trips over the
+full value model, malformed-frame containment (VERDICT r3 item 3;
+reference transport: src/engine/dataflow/config.rs bincode over Value)."""
+
+import datetime as dt
+import random
+
+import numpy as np
+import pytest
+
+from pathway_tpu import native
+from pathway_tpu.engine import wire
+from pathway_tpu.engine.value import ERROR, Json, Pending, Pointer
+
+
+def _sample_deltas():
+    return [
+        (
+            Pointer(123456789012345678901234567890),
+            ("hello", 42, -7, 3.14, None, True, False, b"\x00\xff"),
+            1,
+        ),
+        (
+            Pointer(2**127 + 5),
+            (Pointer(9), (1, (2, "x")), [1, 2.5, None], {"a": 1, "b": [True]}),
+            -3,
+        ),
+        (
+            Pointer(0),
+            (Json({"k": [1, "s", None]}), ERROR, Pending, 2**80, -(2**90)),
+            2,
+        ),
+        (
+            Pointer(7),
+            (
+                dt.datetime(2024, 5, 1, 12, 30, 45, 123456),
+                dt.datetime(2024, 5, 1, tzinfo=dt.timezone.utc),
+                dt.timedelta(days=-2, seconds=5, microseconds=17),
+                dt.date(1999, 12, 31),
+                np.float32(2.5),
+                np.arange(6, dtype=np.int64).reshape(2, 3),
+            ),
+            1,
+        ),
+    ]
+
+
+def _messages():
+    return [
+        ("hello", 3, "runxyz"),
+        ("data", 7, 12345, _sample_deltas()),
+        ("punct", 2, -1),
+        ("coord", 99, ("votes", [1, 2], {"w": 0})),
+    ]
+
+
+def _deep_equal(a, b):
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return (
+            isinstance(a, np.ndarray)
+            and isinstance(b, np.ndarray)
+            and a.dtype == b.dtype
+            and a.shape == b.shape
+            and bool((a == b).all())
+        )
+    if isinstance(a, (tuple, list)):
+        return (
+            type(a) is type(b)
+            and len(a) == len(b)
+            and all(_deep_equal(x, y) for x, y in zip(a, b))
+        )
+    if isinstance(a, dict):
+        return (
+            isinstance(b, dict)
+            and set(a) == set(b)
+            and all(_deep_equal(a[k], b[k]) for k in a)
+        )
+    return a == b and type(a) is type(b)
+
+
+def test_python_codec_round_trip():
+    for msg in _messages():
+        blob = wire.py_encode_message(msg)
+        assert isinstance(blob, bytes)
+        out = wire.py_decode_message(blob)
+        assert _deep_equal(out, msg), (msg[0], out)
+
+
+def test_native_codec_matches_python_bytes():
+    ext = native.load_wire_ext()
+    if ext is None:
+        pytest.skip("native toolchain unavailable")
+    for msg in _messages():
+        py_blob = wire.py_encode_message(msg)
+        nat_blob = ext.encode_message(msg)
+        assert py_blob == nat_blob, msg[0]
+        assert _deep_equal(ext.decode_message(py_blob), msg), msg[0]
+        assert _deep_equal(wire.py_decode_message(nat_blob), msg), msg[0]
+
+
+def test_malformed_frames_raise_wire_error():
+    ext = native.load_wire_ext()
+    rng = random.Random(11)
+    blob = wire.py_encode_message(("data", 7, 12345, _sample_deltas()))
+    decoders = [wire.py_decode_message]
+    if ext is not None:
+        decoders.append(ext.decode_message)
+    for _ in range(200):
+        bad = bytearray(blob)
+        mode = rng.randrange(3)
+        if mode == 0:  # flip bytes
+            for _ in range(rng.randrange(1, 4)):
+                bad[rng.randrange(len(bad))] = rng.randrange(256)
+        elif mode == 1:  # truncate
+            bad = bad[: rng.randrange(len(bad))]
+        else:  # append garbage
+            bad += bytes(rng.randrange(256) for _ in range(rng.randrange(1, 8)))
+        for dec in decoders:
+            try:
+                dec(bytes(bad))
+            except (wire.WireError, ValueError):
+                pass  # clean, typed failure — never arbitrary execution
+
+
+def test_malformed_frame_fails_run_cleanly():
+    """A peer sending garbage turns into an EngineError, not corruption
+    (exchange surfaces WireError as a dead-peer failure)."""
+    import socket
+    import struct
+    import threading
+    import time as time_mod
+
+    from pathway_tpu.engine.exchange import ExchangeError, TcpCoordinator
+
+    from _fakes import free_port_base
+
+    port = free_port_base(2)
+    # we are worker 0 of 2 and play the part of worker 1 manually:
+    # listen on worker 1's port first so worker 0's outgoing connect
+    # succeeds, then send a hello followed by a garbage frame
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", port + 1))
+    srv.listen(4)
+    listener_coord = None
+
+    def start_worker0():
+        nonlocal listener_coord
+        try:
+            listener_coord = TcpCoordinator(
+                0, 2, port, run_id="wiretest", connect_timeout=10
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    th = threading.Thread(target=start_worker0, daemon=True)
+    th.start()
+    deadline0 = time_mod.monotonic() + 10
+    while True:
+        try:
+            out = socket.create_connection(("127.0.0.1", port), timeout=10)
+            break
+        except OSError:
+            if time_mod.monotonic() > deadline0:
+                raise
+            time_mod.sleep(0.05)
+    hello = wire.py_encode_message(("hello", 1, "wiretest"))
+    out.sendall(struct.pack("!I", len(hello)) + hello)
+    # now a malformed data frame
+    bad = b"\x02\xff\xff\xff\xff\xff\xff\xff\xff\xff"
+    out.sendall(struct.pack("!I", len(bad)) + bad)
+    th.join(timeout=15)
+    assert listener_coord is not None
+    deadline = time_mod.monotonic() + 10
+    while time_mod.monotonic() < deadline:
+        try:
+            listener_coord._check_dead()
+        except ExchangeError as exc:
+            assert "malformed frame" in str(exc), exc
+            break
+        time_mod.sleep(0.05)
+    else:
+        raise AssertionError("malformed frame did not mark the peer dead")
+    listener_coord.close()
+    out.close()
+    srv.close()
+
+
+def test_pickle_escape_is_allowlisted():
+    """Review regression: the opaque escape must not execute arbitrary
+    reduce payloads from the network."""
+    import pickle
+
+    class Evil:
+        def __reduce__(self):
+            import os
+
+            return (os.system, ("true",))
+
+    out = bytearray([wire.T_PICKLE])
+    raw = pickle.dumps(Evil())
+    wire._uvarint(out, len(raw))
+    out += raw
+    with pytest.raises(wire.WireError, match="allowlist"):
+        wire.decode_value(wire._Reader(bytes(out)))
+    # allowlisted types still round-trip through the escape
+    import datetime as dtm
+    import zoneinfo
+
+    v = dtm.datetime(2024, 1, 1, tzinfo=zoneinfo.ZoneInfo("Europe/Paris"))
+    buf = bytearray()
+    wire.encode_value(buf, v)
+    assert wire.decode_value(wire._Reader(bytes(buf))) == v
+
+
+def test_object_dtype_ndarray_round_trips():
+    """Review regression: object arrays have no buffer form; they ship
+    through the opaque escape instead of emitting raw pointers."""
+    arr = np.array([(1, "a"), None, (2.5,)], dtype=object)
+    buf = bytearray()
+    wire.encode_value(buf, arr)
+    out = wire.decode_value(wire._Reader(bytes(buf)))
+    assert isinstance(out, np.ndarray) and out.dtype == object
+    assert list(out) == list(arr)
+    ext = native.load_wire_ext()
+    if ext is not None:
+        msg = ("data", 0, 2, [(Pointer(1), (arr,), 1)])
+        out2 = ext.decode_message(ext.encode_message(msg))
+        assert list(out2[3][0][1][0]) == list(arr)
+
+
+def test_native_consolidate_matches_python():
+    ext = native.load_wire_ext()
+    if ext is None:
+        pytest.skip("native toolchain unavailable")
+    from pathway_tpu.engine.stream import _consolidate_unhashable
+
+    k1, k2 = Pointer(1), Pointer(2)
+    deltas = [
+        (k1, ("a", 1), 1),
+        (k2, ("b", 2), 1),
+        (k1, ("a", 1), -1),
+        (k1, ("a2", 3), 1),
+        (k2, ("b", 2), 2),
+    ]
+    out = ext.consolidate(list(deltas))
+    # zero-net (k1, a) dropped; retractions (none net-negative) first
+    assert (k1, ("a", 1), 1) not in out
+    assert (k1, ("a2", 3), 1) in out
+    assert (k2, ("b", 2), 3) in out
+    # all-insert distinct-key batches pass through unchanged
+    bulk = [(Pointer(i), ("w", i), 1) for i in range(10)]
+    assert ext.consolidate(list(bulk)) == bulk
+    # unhashable values raise TypeError for the caller's fallback
+    arr_deltas = [(k1, (np.zeros(2),), 1), (k1, (np.zeros(2),), 1)]
+    with pytest.raises(TypeError):
+        ext.consolidate(arr_deltas)
+    assert len(_consolidate_unhashable(arr_deltas)) == 1
