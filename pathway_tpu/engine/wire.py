@@ -183,7 +183,12 @@ def encode_value(out: bytearray, v: Any) -> None:
         out.append(T_JSON)
         encode_value(out, v.value)
     elif isinstance(v, Error):
+        # trace payload survives the wire (0-length = the plain singleton)
         out.append(T_ERROR)
+        trace = getattr(v, "trace", None)
+        raw = trace.encode("utf-8") if isinstance(trace, str) else b""
+        _uvarint(out, len(raw))
+        out += raw
     elif v is Pending:
         out.append(T_PENDING)
     elif t is _dt.datetime:
@@ -286,7 +291,12 @@ def decode_value(r: _Reader, _tag: int | None = None) -> Any:
     if tag == T_LIST:
         return [decode_value(r) for _ in range(r.uvarint())]
     if tag == T_DICT:
-        return {decode_value(r): decode_value(r) for _ in range(r.uvarint())}
+        try:
+            return {
+                decode_value(r): decode_value(r) for _ in range(r.uvarint())
+            }
+        except TypeError as exc:  # unhashable decoded key
+            raise WireError(f"bad dict key in frame: {exc}") from None
     if tag == T_JSON:
         return Json(decode_value(r))
     if tag == T_NDARRAY:
@@ -300,7 +310,13 @@ def decode_value(r: _Reader, _tag: int | None = None) -> Any:
         except (TypeError, ValueError) as exc:
             raise WireError(f"bad ndarray: {exc}") from None
     if tag == T_ERROR:
-        return ERROR
+        n = r.uvarint()
+        if n == 0:
+            return ERROR
+        try:
+            return Error(r.take(n).decode("utf-8"))
+        except UnicodeDecodeError as exc:
+            raise WireError(f"bad error trace: {exc}") from None
     if tag == T_PENDING:
         return Pending
     if tag in (T_DATETIME_NAIVE, T_DATETIME_UTC):

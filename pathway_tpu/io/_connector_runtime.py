@@ -78,6 +78,10 @@ class LiveSource:
         self.sync_column = None
         self.exclusive = exclusive
         self.exclusive_worker = exclusive_worker
+        # barrier-commit sources: rows flush only up to the last commit, so
+        # batch shapes are exactly the subject's commit units regardless of
+        # timer alignment or reader/engine relative speed
+        self.gated_commits = False
 
 
 def connector_table(
@@ -89,6 +93,7 @@ def connector_table(
     exclusive: bool = False,
     exclusive_worker: int = 0,
     partitioned: bool = False,
+    gated_commits: bool = False,
 ) -> Table:
     """Create a table fed by a connector subject (reference:
     Graph::connector_table, dataflow.rs:3880).
@@ -110,6 +115,7 @@ def connector_table(
         exclusive=exclusive,
         exclusive_worker=exclusive_worker,
     )
+    live.gated_commits = gated_commits
 
     if mode == "static":
 
@@ -322,13 +328,20 @@ class ConnectorSubjectBase:
     def _remove(self, row: dict) -> None:
         self._sink.push_row(row, diff=-1)
 
-    def commit(self) -> None:
+    def commit(self, barrier: bool = False) -> None:
         """Mark a consistent point in the stream. With persistence, a
         commit seals the batch + cursor that recovery replays. Without
         persistence it is a flush hint only: under load the driver may
         coalesce rows from after a commit into the same engine minibatch
-        (server-side micro-batching)."""
-        self._sink.commit()
+        (server-side micro-batching). ``barrier=True`` additionally makes
+        the commit a batch BOUNDARY (single-worker): rows after it never
+        coalesce into the same engine tick — deterministic batch shapes
+        that pipeline host parsing of batch N+1 against the device work of
+        batch N (bulk-ingest host/device overlap)."""
+        try:
+            self._sink.commit(barrier=barrier)
+        except TypeError:  # sinks predating the barrier flag
+            self._sink.commit()
 
     def close(self) -> None:
         if not self._closed:
@@ -442,11 +455,12 @@ class _QueueSink:
         deltas = [(k, v, 1) for k, v in zip(keys, values_list)]
         self.queue.put(("data_batch", self.live, deltas, self._counter))
 
-    def commit(self) -> None:
+    def commit(self, barrier: bool = False) -> None:
         state = None
         if self.persistence_enabled and self.subject is not None:
             state = {"subject": self.subject._persisted_state()}
-        self.queue.put(("commit", self.live, state, self._counter))
+        kind = "commit_b" if barrier else "commit"
+        self.queue.put((kind, self.live, state, self._counter))
 
     def close(self) -> None:
         if self.live.sync_group is not None:
@@ -644,11 +658,15 @@ class StreamingDriver:
         done = False
         # per-live commit bookkeeping: how much of `pending` the subject
         # has committed (flushable), and whether it ever commits at all.
-        # The committed-prefix gating only matters when a persisted cursor
-        # must stay consistent with the logged batch.
+        # The committed-prefix gating matters when a persisted cursor must
+        # stay consistent with the logged batch, and for barrier-commit
+        # sources whose batch shapes must equal their commit units.
         gate_commits = self.persistence_config is not None
         committed_upto: Dict[LiveSource, int] = {}
         ever_committed: set = set()
+
+        def gated(live) -> bool:
+            return gate_commits or live.gated_commits
 
         def flush():
             """One coordinated flush tick. Multi-worker: every worker makes
@@ -660,7 +678,7 @@ class StreamingDriver:
             nonlocal dirty_since_snapshot
             self.engine.flush_ticks = getattr(self.engine, "flush_ticks", 0) + 1
             has_data = any(
-                (committed_upto.get(live, 0) > 0 or not gate_commits
+                (committed_upto.get(live, 0) > 0 or not gated(live)
                  or live not in ever_committed)
                 and bool(d)
                 for live, d in pending.items()
@@ -709,7 +727,7 @@ class StreamingDriver:
                     # flush everything with the counter cursor, as before.
                     # Without persistence there is no cursor to keep
                     # consistent, so nothing is ever withheld.
-                    if gate_commits and live in ever_committed:
+                    if gated(live) and live in ever_committed:
                         cut = committed_upto.get(live, 0)
                         batch, tail = deltas[:cut], deltas[cut:]
                         pending[live] = tail
@@ -800,8 +818,15 @@ class StreamingDriver:
             # deadline / multi-worker barrier.
             while len(events) < 4096:
                 try:
-                    events.append(self.queue.get_nowait())
+                    ev = self.queue.get_nowait()
                 except queue_mod.Empty:
+                    break
+                events.append(ev)
+                if ev[0] == "commit_b" and not multiworker:
+                    # barrier commit: later rows must not coalesce into
+                    # this tick — deterministic batch boundaries for the
+                    # bulk-ingest pipeline (multi-worker keeps timer ticks
+                    # so the agreement cadence stays identical everywhere)
                     break
             needs_flush = False
             for kind, live, payload, counter in events:
@@ -810,7 +835,7 @@ class StreamingDriver:
                     pending.setdefault(live, []).append(payload)
                 elif kind == "data_batch":
                     pending.setdefault(live, []).extend(payload)
-                elif kind == "commit":
+                elif kind in ("commit", "commit_b"):
                     if payload is not None:
                         states[live] = payload
                     committed_upto[live] = len(pending.get(live, []))

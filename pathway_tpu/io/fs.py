@@ -53,6 +53,7 @@ class _FsSubject(ConnectorSubjectBase):
         with_metadata: bool,
         refresh_interval: float = 1.0,
         object_pattern: str = "*",
+        batch_per_file: bool = False,
     ):
         super().__init__()
         self.path = path
@@ -62,6 +63,7 @@ class _FsSubject(ConnectorSubjectBase):
         self.with_metadata = with_metadata
         self.refresh_interval = refresh_interval
         self.object_pattern = object_pattern
+        self.batch_per_file = batch_per_file
         self._seen: Dict[str, float] = {}
 
     def _list_files(self) -> List[str]:
@@ -250,8 +252,9 @@ class _FsSubject(ConnectorSubjectBase):
                 self._emit_file(f)
                 # commit per file: downstream batches pipeline host-side
                 # parsing of file N+1 against the (async-dispatched) device
-                # work of file N
-                self.commit()
+                # work of file N; as a barrier, the batch boundary is
+                # deterministic regardless of reader/engine relative speed
+                self.commit(barrier=self.batch_per_file)
                 emitted_any = True
             if not emitted_any:
                 self.commit()
@@ -287,10 +290,16 @@ def read(
     autocommit_duration_ms: int | None = 1500,
     name: str | None = None,
     refresh_interval: float = 1.0,
+    batch_per_file: bool = False,
     **kwargs,
 ):
     """Read files as a table (reference: io/fs read; StorageType PosixLike /
-    CsvFilesystem, data_storage.rs:359)."""
+    CsvFilesystem, data_storage.rs:359).
+
+    ``batch_per_file=True`` (streaming mode) makes every file its own
+    engine batch — a barrier commit per file, so downstream host work on
+    file N+1 pipelines against the async device work of file N with
+    deterministic batch shapes."""
     if schema is None:
         if format in ("plaintext", "plaintext_by_file"):
             schema = _plaintext_schema()
@@ -309,9 +318,16 @@ def read(
             with_metadata,
             refresh_interval=refresh_interval,
             object_pattern=object_pattern,
+            batch_per_file=batch_per_file,
         )
 
-    return connector_table(out_schema, factory, mode=mode, name=name)
+    return connector_table(
+        out_schema,
+        factory,
+        mode=mode,
+        name=name,
+        gated_commits=batch_per_file,
+    )
 
 
 def worker_output_path(filename: str, engine) -> str:

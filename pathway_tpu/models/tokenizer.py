@@ -167,10 +167,19 @@ class WordPieceTokenizer:
 
 
 def bucket_length(n: int, minimum: int = 16, maximum: int = 512) -> int:
+    """Stable padded shapes with bounded compile count: powers of two up
+    to 32, then multiples of 8. The finer high-end granularity matters on
+    the MXU — bulk corpora sit just past a power of two (e.g. 51 tokens),
+    and padding 51 -> 64 instead of 51 -> 56 burns 14% of the FLOPs on
+    pad tokens."""
+    if n <= minimum:
+        return minimum
     b = minimum
-    while b < n and b < maximum:
+    while b < n and b < 32:
         b *= 2
-    return min(b, maximum)
+    if b >= n:
+        return min(b, maximum)
+    return min(-(-n // 8) * 8, maximum)
 
 
 def encode_batch(
@@ -220,13 +229,21 @@ def encode_batch(
 
 
 def _wire_dtype(tokenizer):
-    """int16 halves the host->device transfer of every token batch — the
-    dominant upload on a tunneled chip; XLA gathers cast indices anyway.
-    Falls back to int32 for vocabularies beyond int16 range."""
+    """THE wire-narrowing policy for token uploads (single source — the
+    models upcast on device): int16/uint16 halves the host->device
+    transfer of every token batch, the dominant upload on a tunneled
+    chip; XLA gathers cast indices anyway. Falls back to int32 for
+    vocabularies beyond 16-bit range. Masks share the ids dtype (narrow
+    on the wire, and safe for in-jit integer sums at any seq length,
+    which int8 would not be)."""
     nvocab = getattr(tokenizer, "vocab_size", None)
     if nvocab is None:
         nvocab = len(getattr(tokenizer, "vocab", ())) or (1 << 31)
-    return np.int16 if nvocab < (1 << 15) else np.int32
+    if nvocab < (1 << 15):
+        return np.int16
+    if nvocab < (1 << 16):
+        return np.uint16
+    return np.int32
 
 
 def _try_native(tokenizer, texts, max_len, batch_bucket):

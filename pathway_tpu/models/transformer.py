@@ -291,11 +291,27 @@ class TransformerLM:
         if params is None:
             params = init_params(jax.random.PRNGKey(seed), config)
         self.params = params
-        self._encode_jit = jax.jit(
-            functools.partial(forward, config=self.config)
-        )
+
+        def _fwd(params, ids, mask):
+            # narrow wire dtypes (tokenizer._wire_dtype policy) upcast on
+            # device: behind a tunneled chip the token upload is
+            # bandwidth-bound and 16-bit ids/mask halve it vs int32
+            import jax.numpy as jnp
+
+            return forward(
+                params,
+                config=self.config,
+                ids=ids.astype(jnp.int32),
+                mask=mask.astype(jnp.int32),
+            )
+
+        self._encode_jit = jax.jit(_fwd)
 
     def __call__(self, ids, mask):
+        # ids/mask arrive already wire-narrowed by encode_batch (tokenizer
+        # _wire_dtype is the single policy); no host casts here — a cast
+        # would pull mesh-sharded inputs back to host and destroy their
+        # NamedSharding placement
         return self._encode_jit(self.params, ids=ids, mask=mask)
 
     # -- greedy generation (decoder) --------------------------------------

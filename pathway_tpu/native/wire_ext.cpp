@@ -252,8 +252,12 @@ bool encode_value(Buf &out, PyObject *v) {
     bool ok = encode_value(out, inner);
     Py_DECREF(inner);
     if (!ok) return false;
-  } else if (Py_TYPE(v) == reinterpret_cast<PyTypeObject *>(g_error_cls)) {
+  } else if (v == g_error_obj) {
     out.put(TAG_ERROR);
+    out.uvarint(0);  // plain singleton, no trace
+  } else if (Py_TYPE(v) == reinterpret_cast<PyTypeObject *>(g_error_cls)) {
+    // Error carrying a trace: python encoder writes the payload
+    if (!encode_rare(out, v)) return false;
   } else if (v == g_pending_obj) {
     out.put(TAG_PENDING);
   } else {
@@ -413,6 +417,11 @@ PyObject *decode_value(Reader &r) {
           Py_DECREF(k);
           Py_DECREF(v);
           Py_DECREF(d);
+          if (PyErr_ExceptionMatches(PyExc_TypeError)) {
+            // unhashable decoded key: a malformed frame, not a crash
+            PyErr_Clear();
+            wire_err("bad dict key in frame (unhashable)");
+          }
           return nullptr;
         }
         Py_DECREF(k);
@@ -428,9 +437,25 @@ PyObject *decode_value(Reader &r) {
       Py_DECREF(inner);
       return j;
     }
-    case TAG_ERROR:
-      Py_INCREF(g_error_obj);
-      return g_error_obj;
+    case TAG_ERROR: {
+      uint64_t n = r.uvarint();
+      if (r.fail) {
+        wire_err("truncated frame (error)");
+        return nullptr;
+      }
+      if (n == 0) {
+        Py_INCREF(g_error_obj);
+        return g_error_obj;
+      }
+      const uint8_t *raw = r.take(n);
+      if (!raw) {
+        wire_err("truncated frame (error trace)");
+        return nullptr;
+      }
+      return PyObject_CallFunction(g_error_cls, "s#",
+                                   reinterpret_cast<const char *>(raw),
+                                   static_cast<Py_ssize_t>(n));
+    }
     case TAG_PENDING:
       Py_INCREF(g_pending_obj);
       return g_pending_obj;

@@ -381,9 +381,11 @@ def _compiled_fused_search(config, metric: str, k: int, mesh=None, n_rows: int =
     from pathway_tpu.models.transformer import forward
 
     def fused(params, ids_mask, buffer, valid):
-        # single packed input ([2,B,L]) and single packed output
-        # ([Q, 2k]) — exactly one upload and one fetch per query
-        # batch, which matters when the chip is a network hop away
+        # single packed input ([2,B,L], narrow wire dtype upcast here) and
+        # single packed output ([Q, 2k]) — exactly one upload and one
+        # fetch per query batch, which matters when the chip is a network
+        # hop away
+        ids_mask = ids_mask.astype(jnp.int32)
         ids, mask = ids_mask[0], ids_mask[1]
         emb = forward(params, config, ids, mask)
         if mesh is not None:
@@ -447,6 +449,8 @@ class FusedEmbedSearch:
         )
         self.index._flush()
         k_eff = min(k, self.index.capacity)
+        # ids/mask are wire-narrowed by encode_batch (one shared dtype);
+        # the fused jit upcasts on device
         packed = self._fn(k_eff)(
             self.encoder.lm.params,
             np.stack([ids, mask]),

@@ -93,17 +93,27 @@ class DocumentStore:
                 ),
             )
 
-        chunked = parsed.select(
-            chunks=self.splitter(parsed.text, parsed.metadata),
-        ).flatten(thisclass.this.chunks)
-        self.chunked_docs = chunked.select(
-            text=chunked.chunks.get(0),
-            metadata=pw_api.apply_with_type(
-                lambda m: Json(m if isinstance(m, dict) else getattr(m, "value", {})),
-                Json,
-                chunked.chunks.get(1),
-            ),
-        )
+        from pathway_tpu.xpacks.llm.splitters import NullSplitter
+
+        if type(self.splitter) is NullSplitter:
+            # a null split is one chunk per document with metadata passed
+            # through — the split/flatten/repack stages would only rebuild
+            # identical rows (bulk-ingest host path stays O(1) per doc)
+            self.chunked_docs = parsed
+        else:
+            chunked = parsed.select(
+                chunks=self.splitter(parsed.text, parsed.metadata),
+            ).flatten(thisclass.this.chunks)
+            self.chunked_docs = chunked.select(
+                text=chunked.chunks.get(0),
+                metadata=pw_api.apply_with_type(
+                    lambda m: Json(
+                        m if isinstance(m, dict) else getattr(m, "value", {})
+                    ),
+                    Json,
+                    chunked.chunks.get(1),
+                ),
+            )
         self._index = self.retriever_factory.build_index(
             self.chunked_docs.text,
             self.chunked_docs,

@@ -229,6 +229,54 @@ def test_object_dtype_ndarray_round_trips():
         assert list(out2[3][0][1][0]) == list(arr)
 
 
+def test_error_trace_survives_wire():
+    """Review regression: Error(trace) keeps its diagnostic payload across
+    workers; the bare singleton stays the singleton."""
+    from pathway_tpu.engine.value import Error
+
+    for codecs in (
+        (wire.py_encode_message, wire.py_decode_message),
+        None,
+    ):
+        if codecs is None:
+            ext = native.load_wire_ext()
+            if ext is None:
+                continue
+            enc, dec = ext.encode_message, ext.decode_message
+        else:
+            enc, dec = codecs
+        msg = ("data", 0, 2, [
+            (Pointer(1), (ERROR, Error("div by zero at row 7")), 1)
+        ])
+        out = dec(enc(msg))
+        plain, traced = out[3][0][1]
+        assert plain is ERROR
+        assert isinstance(traced, Error) and traced.trace == (
+            "div by zero at row 7"
+        )
+
+
+def test_unhashable_dict_key_frame_raises_wire_error():
+    """Review regression: a frame encoding a dict whose key decodes to a
+    list must fail as WireError (containment), not TypeError."""
+    out = bytearray([wire.T_DICT])
+    wire._uvarint(out, 1)
+    # key: a list (unhashable), value: int 0
+    out.append(wire.T_LIST)
+    wire._uvarint(out, 0)
+    out.append(wire.T_INT)
+    wire._uvarint(out, 0)
+    with pytest.raises(wire.WireError):
+        wire.decode_value(wire._Reader(bytes(out)))
+    ext = native.load_wire_ext()
+    if ext is not None:
+        frame = bytearray([0x04])  # coord message
+        frame += (7).to_bytes(8, "little")
+        frame += out
+        with pytest.raises((wire.WireError, ValueError)):
+            ext.decode_message(bytes(frame))
+
+
 def test_native_consolidate_matches_python():
     ext = native.load_wire_ext()
     if ext is None:
