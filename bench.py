@@ -96,7 +96,6 @@ def run_pipeline(
         mode="streaming",
         batch_per_file=True,
         refresh_interval=3600.0,  # all files exist up front
-        autocommit_duration_ms=25,
     )
     embedder = SentenceTransformerEmbedder(max_len=64)
     factory = BruteForceKnnFactory(
@@ -108,7 +107,6 @@ def run_pipeline(
     queries = pw.io.python.read(
         _QuerySubject(query_q).subject,
         schema=DocumentStore.RetrieveQuerySchema,
-        autocommit_duration_ms=25,
     )
     results = store.retrieve_query(queries)
 
@@ -131,7 +129,9 @@ def run_pipeline(
             count_q.put((perf_counter(), row["c"]))
 
     pw.io.subscribe(chunk_counts, on_change=on_count)
-    pw.run()
+    # the driver's flush timer (commits flush immediately anyway;
+    # this bounds the idle-poll cadence)
+    pw.run(autocommit_duration_ms=25)
 
 
 def _mk_query(text: str) -> dict:
@@ -346,6 +346,15 @@ def main() -> None:
                 ),
                 "compute_p50_ms": round(compute_p50, 2),
                 "device_rtt_floor_ms": round(rtt, 2),
+                # the co-located-deployment projection as a DERIVED FIELD:
+                # serving latency minus the tunnel's measured no-op RTT —
+                # what the same executable costs when the chip is local
+                "serving_p50_ms_ex_tunnel": round(
+                    max(facts["serving_p50_ms"] - rtt, 0.0), 2
+                ),
+                "compute_p50_ms_ex_tunnel": round(
+                    max(compute_p50 - rtt, 0.0), 2
+                ),
                 "ingest_runs_docs_per_sec": ingest_runs,
                 "n_docs": N_DOCS,
                 "device": _device_name(),

@@ -1,0 +1,134 @@
+"""Pure-python Ed25519 (RFC 8032) — sign + verify.
+
+The reference enforces license keys with Ed25519 signatures
+(src/engine/license.rs); this build verifies the same way without a
+crypto dependency. Not constant-time — fine for VERIFICATION of public
+signatures (the secret-key side here exists for tests and for operators
+minting their own keys; use a hardened library for production signing).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+_P = 2**255 - 19
+_L = 2**252 + 27742317777372353535851937790883648493
+_D = (-121665 * pow(121666, _P - 2, _P)) % _P
+_I = pow(2, (_P - 1) // 4, _P)
+
+
+def _sha512(data: bytes) -> bytes:
+    return hashlib.sha512(data).digest()
+
+
+def _inv(x: int) -> int:
+    return pow(x, _P - 2, _P)
+
+
+# points are extended homogeneous coordinates (X, Y, Z, T), x=X/Z y=Y/Z
+def _point_add(p, q):
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % _P
+    b = (y1 + x1) * (y2 + x2) % _P
+    c = 2 * t1 * t2 * _D % _P
+    dd = 2 * z1 * z2 % _P
+    e, f, g, h = b - a, dd - c, dd + c, b + a
+    return (e * f % _P, g * h % _P, f * g % _P, e * h % _P)
+
+
+def _point_mul(s: int, p):
+    q = (0, 1, 1, 0)  # identity
+    while s > 0:
+        if s & 1:
+            q = _point_add(q, p)
+        p = _point_add(p, p)
+        s >>= 1
+    return q
+
+
+def _point_equal(p, q) -> bool:
+    x1, y1, z1, _ = p
+    x2, y2, z2, _ = q
+    return (x1 * z2 - x2 * z1) % _P == 0 and (y1 * z2 - y2 * z1) % _P == 0
+
+
+def _recover_x(y: int, sign: int) -> int | None:
+    if y >= _P:
+        return None
+    x2 = (y * y - 1) * _inv(_D * y * y + 1) % _P
+    if x2 == 0:
+        return None if sign else 0
+    x = pow(x2, (_P + 3) // 8, _P)
+    if (x * x - x2) % _P != 0:
+        x = x * _I % _P
+    if (x * x - x2) % _P != 0:
+        return None
+    if (x & 1) != sign:
+        x = _P - x
+    return x
+
+
+_G_Y = 4 * _inv(5) % _P
+_G_X = _recover_x(_G_Y, 0)
+_G = (_G_X, _G_Y, 1, _G_X * _G_Y % _P)
+
+
+def _point_compress(p) -> bytes:
+    x, y, z, _ = p
+    zi = _inv(z)
+    x, y = x * zi % _P, y * zi % _P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def _point_decompress(raw: bytes):
+    if len(raw) != 32:
+        return None
+    y = int.from_bytes(raw, "little")
+    sign = y >> 255
+    y &= (1 << 255) - 1
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % _P)
+
+
+def _secret_expand(secret: bytes):
+    h = _sha512(secret)
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:]
+
+
+def public_key(secret: bytes) -> bytes:
+    a, _prefix = _secret_expand(secret)
+    return _point_compress(_point_mul(a, _G))
+
+
+def sign(secret: bytes, msg: bytes) -> bytes:
+    a, prefix = _secret_expand(secret)
+    pub = _point_compress(_point_mul(a, _G))
+    r = int.from_bytes(_sha512(prefix + msg), "little") % _L
+    big_r = _point_compress(_point_mul(r, _G))
+    h = int.from_bytes(_sha512(big_r + pub + msg), "little") % _L
+    s = (r + h * a) % _L
+    return big_r + s.to_bytes(32, "little")
+
+
+def verify(pub: bytes, msg: bytes, signature: bytes) -> bool:
+    if len(pub) != 32 or len(signature) != 64:
+        return False
+    a = _point_decompress(pub)
+    if a is None:
+        return False
+    big_r = _point_decompress(signature[:32])
+    if big_r is None:
+        return False
+    s = int.from_bytes(signature[32:], "little")
+    if s >= _L:
+        return False
+    h = int.from_bytes(_sha512(signature[:32] + pub + msg), "little") % _L
+    left = _point_mul(s, _G)
+    right = _point_add(big_r, _point_mul(h, a))
+    return _point_equal(left, right)

@@ -338,9 +338,26 @@ class ConnectorSubjectBase:
         coalesce into the same engine tick — deterministic batch shapes
         that pipeline host parsing of batch N+1 against the device work of
         batch N (bulk-ingest host/device overlap)."""
-        try:
+        # capability probe once per sink: catching TypeError around the
+        # live call would retry (double-commit) and mask real errors
+        accepts = getattr(self._sink, "_commit_accepts_barrier", None)
+        if accepts is None:
+            import inspect
+
+            try:
+                accepts = (
+                    "barrier"
+                    in inspect.signature(self._sink.commit).parameters
+                )
+            except (TypeError, ValueError):
+                accepts = False
+            try:
+                self._sink._commit_accepts_barrier = accepts
+            except AttributeError:
+                pass
+        if accepts:
             self._sink.commit(barrier=barrier)
-        except TypeError:  # sinks predating the barrier flag
+        else:
             self._sink.commit()
 
     def close(self) -> None:

@@ -296,10 +296,13 @@ def read(
     """Read files as a table (reference: io/fs read; StorageType PosixLike /
     CsvFilesystem, data_storage.rs:359).
 
-    ``batch_per_file=True`` (streaming mode) makes every file its own
-    engine batch — a barrier commit per file, so downstream host work on
-    file N+1 pipelines against the async device work of file N with
-    deterministic batch shapes."""
+    ``batch_per_file=True`` (streaming mode, single-worker) makes every
+    file its own engine batch — a barrier commit per file, so downstream
+    host work on file N+1 pipelines against the async device work of
+    file N with deterministic batch shapes. Multi-worker runs keep the
+    shared timer ticks (the lockstep agreement cadence must stay
+    identical on every worker), so there the flag only gates rows to
+    whole-file prefixes without pinning one file per batch."""
     if schema is None:
         if format in ("plaintext", "plaintext_by_file"):
             schema = _plaintext_schema()

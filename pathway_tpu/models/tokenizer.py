@@ -167,11 +167,23 @@ class WordPieceTokenizer:
 
 
 def bucket_length(n: int, minimum: int = 16, maximum: int = 512) -> int:
-    """Stable padded shapes with bounded compile count: powers of two up
-    to 32, then multiples of 8. The finer high-end granularity matters on
-    the MXU — bulk corpora sit just past a power of two (e.g. 51 tokens),
-    and padding 51 -> 64 instead of 51 -> 56 burns 14% of the FLOPs on
-    pad tokens."""
+    """Power-of-two buckets — the BATCH-dimension policy. Mesh sharding
+    depends on it (power-of-two batches divide any power-of-two dp axis,
+    minilm.py encode), and it bounds the compile cache to ~log2 shapes."""
+    b = minimum
+    while b < n and b < maximum:
+        b *= 2
+    return min(b, maximum)
+
+
+def seq_bucket_length(n: int, minimum: int = 16, maximum: int = 512) -> int:
+    """SEQUENCE-dimension buckets: powers of two up to 32, then multiples
+    of 8. The finer high-end granularity matters on the MXU — bulk
+    corpora sit just past a power of two (e.g. 51 tokens), and padding
+    51 -> 64 instead of 51 -> 56 burns 14% of the FLOPs on pad tokens.
+    The sequence axis is never mesh-sharded by the encoder, so the
+    power-of-two divisibility constraint of `bucket_length` does not
+    apply; shape count stays bounded by maximum/8."""
     if n <= minimum:
         return minimum
     b = minimum
@@ -214,7 +226,7 @@ def encode_batch(
     else:
         encoded = [tokenizer.encode(t, max_len) for t in texts]
     longest = max((len(e) for e in encoded), default=1)
-    seq_len = bucket_length(longest, maximum=max_len)
+    seq_len = seq_bucket_length(longest, maximum=max_len)
     batch = len(encoded)
     padded_batch = bucket_length(max(batch, 1), minimum=8, maximum=1 << 16) if batch_bucket else batch
     pad_id = getattr(tokenizer, "pad_id", PAD_ID)
@@ -265,7 +277,7 @@ def _try_native(tokenizer, texts, max_len, batch_bucket):
         return None
     ids_full, mask_full = result
     longest = int(mask_full.sum(axis=1).max()) if batch else 1
-    seq_len = bucket_length(max(longest, 1), maximum=max_len)
+    seq_len = seq_bucket_length(max(longest, 1), maximum=max_len)
     dtype = _wire_dtype(tokenizer)
     ids = np.full((padded_batch, seq_len), PAD_ID, dtype=dtype)
     mask = np.zeros((padded_batch, seq_len), dtype=dtype)

@@ -452,9 +452,17 @@ PyObject *decode_value(Reader &r) {
         wire_err("truncated frame (error trace)");
         return nullptr;
       }
-      return PyObject_CallFunction(g_error_cls, "s#",
-                                   reinterpret_cast<const char *>(raw),
-                                   static_cast<Py_ssize_t>(n));
+      PyObject *trace = PyUnicode_DecodeUTF8(
+          reinterpret_cast<const char *>(raw), n, nullptr);
+      if (!trace) {
+        PyErr_Clear();
+        wire_err("bad error trace (invalid utf-8)");
+        return nullptr;
+      }
+      PyObject *err =
+          PyObject_CallFunctionObjArgs(g_error_cls, trace, nullptr);
+      Py_DECREF(trace);
+      return err;
     }
     case TAG_PENDING:
       Py_INCREF(g_pending_obj);

@@ -465,3 +465,57 @@ def test_airbyte_create_source_cli(tmp_path, monkeypatch):
     # re-init refuses to clobber an existing connection (clean CLI error)
     rc2 = main(["airbyte", "create-source", "demo"])
     assert rc2 == 1
+
+
+def test_ed25519_license_keys(monkeypatch):
+    """Signed pw-v2 license keys verify with real Ed25519 (reference:
+    license.rs); tampered payloads and wrong keys are rejected."""
+    import os
+
+    import pytest
+
+    from pathway_tpu.internals import _ed25519
+    from pathway_tpu.internals.license import (
+        LicenseError,
+        make_signed_key,
+        parse_license,
+    )
+
+    secret = bytes(range(32))
+    monkeypatch.setenv(
+        "PATHWAY_LICENSE_PUBKEY", _ed25519.public_key(secret).hex()
+    )
+    key = make_signed_key(
+        secret, {"tier": "enterprise", "entitlements": ["unlimited-workers"]}
+    )
+    lic = parse_license(key)
+    assert lic.tier == "enterprise"
+    assert lic.worker_limit is None
+
+    # tampered payload fails
+    head, payload, sig = key.split(".")
+    import base64
+
+    raw = bytearray(base64.urlsafe_b64decode(payload + "=="))
+    raw[10] ^= 0x01
+    bad = (
+        head + "." + base64.urlsafe_b64encode(bytes(raw)).decode().rstrip("=")
+        + "." + sig
+    )
+    with pytest.raises(LicenseError, match="signature"):
+        parse_license(bad)
+
+    # wrong verifying key fails
+    monkeypatch.setenv(
+        "PATHWAY_LICENSE_PUBKEY", _ed25519.public_key(b"\x07" * 32).hex()
+    )
+    with pytest.raises(LicenseError, match="signature"):
+        parse_license(key)
+
+    # unsigned v1 keys still parse (open-build escape hatch)
+    import json as json_mod
+
+    v1 = "pw-v1." + base64.b64encode(
+        json_mod.dumps({"tier": "t", "entitlements": []}).encode()
+    ).decode()
+    assert parse_license(v1).tier == "t"
