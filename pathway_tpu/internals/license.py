@@ -83,6 +83,14 @@ def parse_license(key: str | None) -> License:
                 f"license key payload unreadable: {exc}"
             ) from exc
     elif key.startswith("pw-v1."):
+        if os.environ.get("PATHWAY_LICENSE_PUBKEY"):
+            # a deployment that configured a verifying key has opted into
+            # real enforcement: unsigned keys no longer count
+            raise LicenseError(
+                "unsigned pw-v1 keys are not accepted when "
+                "PATHWAY_LICENSE_PUBKEY is configured; mint a signed "
+                "pw-v2 key (internals.license.make_signed_key)"
+            )
         try:
             payload = json.loads(
                 base64.b64decode(key[len("pw-v1."):] + "==")
@@ -95,6 +103,11 @@ def parse_license(key: str | None) -> License:
         raise LicenseError(
             "unrecognized license key format "
             "(expected 'pw-v1.<payload>' or 'pw-v2.<payload>.<sig>')"
+        )
+    if not isinstance(payload, dict):
+        raise LicenseError(
+            f"license key payload must be a JSON object, got "
+            f"{type(payload).__name__}"
         )
     return License(
         tier=str(payload.get("tier", "enterprise")),

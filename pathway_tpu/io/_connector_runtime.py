@@ -345,9 +345,10 @@ class ConnectorSubjectBase:
             import inspect
 
             try:
-                accepts = (
-                    "barrier"
-                    in inspect.signature(self._sink.commit).parameters
+                params = inspect.signature(self._sink.commit).parameters
+                accepts = "barrier" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values()
                 )
             except (TypeError, ValueError):
                 accepts = False

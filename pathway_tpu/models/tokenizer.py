@@ -185,7 +185,7 @@ def seq_bucket_length(n: int, minimum: int = 16, maximum: int = 512) -> int:
     power-of-two divisibility constraint of `bucket_length` does not
     apply; shape count stays bounded by maximum/8."""
     if n <= minimum:
-        return minimum
+        return min(minimum, maximum)
     b = minimum
     while b < n and b < 32:
         b *= 2

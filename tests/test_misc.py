@@ -512,10 +512,21 @@ def test_ed25519_license_keys(monkeypatch):
     with pytest.raises(LicenseError, match="signature"):
         parse_license(key)
 
-    # unsigned v1 keys still parse (open-build escape hatch)
+    # a configured verifying key means REAL enforcement: unsigned v1
+    # keys are rejected
     import json as json_mod
 
     v1 = "pw-v1." + base64.b64encode(
         json_mod.dumps({"tier": "t", "entitlements": []}).encode()
     ).decode()
+    with pytest.raises(LicenseError, match="unsigned"):
+        parse_license(v1)
+
+    # without a configured pubkey, v1 remains the open-build escape hatch
+    monkeypatch.delenv("PATHWAY_LICENSE_PUBKEY")
     assert parse_license(v1).tier == "t"
+
+    # non-object payloads fail as LicenseError, not AttributeError
+    bad_payload = "pw-v1." + base64.b64encode(b"[1,2]").decode()
+    with pytest.raises(LicenseError, match="JSON object"):
+        parse_license(bad_payload)

@@ -499,3 +499,123 @@ def test_knn_index_distributed_serving(n, tmp_path):
     assert len(servers) >= 2, (
         f"queries funneled to worker(s) {servers}; expected distribution"
     )
+
+
+# -- round-4 operators under real multi-worker execution -------------------
+
+SQL_WINDOW_MW = """
+    import os, sys
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_markdown
+    from pathway_tpu.io.fs import worker_output_path
+
+    out_dir = sys.argv[1]
+    t = table_from_markdown(
+        '''
+        g | v
+        a | 1
+        a | 1
+        a | 2
+        b | 5
+        b | 3
+        c | 7
+        '''
+    )
+    res = pw.sql(
+        "SELECT g, v, "
+        "ROW_NUMBER() OVER (PARTITION BY g ORDER BY v) AS rn, "
+        "SUM(v) OVER (PARTITION BY g) AS total, "
+        "RANK() OVER (PARTITION BY g ORDER BY v) AS r "
+        "FROM t",
+        t=t,
+    )
+    pw.io.jsonlines.write(res, out_dir + "/win.jsonl")
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+"""
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_sql_window_functions_multiworker(n, tmp_path):
+    """WindowFunctionNode partitions co-locate via exchange_by_value and
+    results arrive once across workers — over the binary wire."""
+    run_workers(SQL_WINDOW_MW, n, tmp_path)
+    rows = read_parts(tmp_path, "win.jsonl")
+    final = final_rows(rows, ["g", "v", "rn", "total", "r"])
+    assert all(c == 1 for c in final.values()), final
+    got = sorted(final)
+    assert got == [
+        ("a", 1, 1, 4, 1),
+        ("a", 1, 2, 4, 1),
+        ("a", 2, 3, 4, 3),
+        ("b", 3, 1, 8, 1),
+        ("b", 5, 2, 8, 2),
+        ("c", 7, 1, 7, 1),
+    ], got
+
+
+HLL_MW = """
+    import sys
+    import pandas as pd
+    import pathway_tpu as pw
+
+    out_dir = sys.argv[1]
+    n = 3000
+    df = pd.DataFrame({
+        "g": ["x" if i % 2 else "y" for i in range(n)],
+        "v": [i % 700 for i in range(n)],
+    })
+    t = pw.debug.table_from_pandas(df)
+    res = t.groupby(t.g).reduce(
+        g=t.g,
+        ad=pw.reducers.count_distinct_approximate(t.v, precision=12),
+    )
+    pw.io.jsonlines.write(res, out_dir + "/hll.jsonl")
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+"""
+
+
+def test_hll_multiworker(tmp_path):
+    """HLL groups co-locate on their owner worker; the stable hash makes
+    the estimate identical regardless of which worker computes it."""
+    run_workers(HLL_MW, 2, tmp_path)
+    rows = read_parts(tmp_path, "hll.jsonl")
+    final = final_rows(rows, ["g", "ad"])
+    assert all(c == 1 for c in final.values()), final
+    est = {g: ad for (g, ad) in final}
+    # 700 is even, so i%700 preserves parity: each parity group sees 350
+    # distinct values; HLL p=12 se ~1.6%, allow 4 sigma
+    for g in ("x", "y"):
+        assert abs(est[g] - 350) / 350 < 0.065, est
+
+
+STREAM_SHAPE_MW = """
+    import sys
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_markdown
+
+    out_dir = sys.argv[1]
+    t = table_from_markdown(
+        '''
+        id | k | v | __time__ | __diff__
+         1 | a | 1 |    2     |    1
+         1 | a | 1 |    4     |   -1
+         1 | a | 9 |    4     |    1
+         2 | b | 2 |    4     |    1
+         3 | c | 3 |    6     |    1
+         2 | b | 2 |    6     |   -1
+        '''
+    )
+    s = t.to_stream()
+    rebuilt = s.stream_to_table(pw.this.is_upsert).without(pw.this.is_upsert)
+    pw.io.jsonlines.write(rebuilt, out_dir + "/reb.jsonl")
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+"""
+
+
+def test_stream_shaping_multiworker(tmp_path):
+    """to_stream -> stream_to_table round trip across 2 workers: events
+    keep their original keys so replay state lands on the owner."""
+    run_workers(STREAM_SHAPE_MW, 2, tmp_path)
+    rows = read_parts(tmp_path, "reb.jsonl")
+    final = final_rows(rows, ["k", "v"])
+    assert final == {("a", 9): 1, ("c", 3): 1}, final

@@ -407,3 +407,24 @@ def test_table_api_parity():
         m for m in REFERENCE_TABLE_METHODS if not hasattr(pw.Table, m)
     ]
     assert missing == []
+
+
+def test_forget_with_datetime_threshold():
+    """forget's threshold expression handles datetime + timedelta, like
+    the reference's IntervalType contract (table.py forget:670)."""
+    import datetime as dtm
+
+    import pandas as pd
+
+    base = dtm.datetime(2026, 1, 1)
+    df = pd.DataFrame(
+        {
+            "t": [base, base + dtm.timedelta(minutes=30)],
+            "v": [1, 2],
+        }
+    )
+    t = pw.debug.table_from_pandas(df)
+    res = t.forget(pw.this.t, dtm.timedelta(minutes=10))
+    rows = _rows(res)
+    # the older row's threshold (t+10min) is <= max(t): retracted
+    assert [v for _t, v in rows] == [2], rows
