@@ -954,6 +954,50 @@ class Table:
             {name: name + suffix for name in self.column_names()}
         )
 
+    def eval_type(self, expression) -> dt.DType:
+        """Inferred dtype of an expression over this table (reference:
+        internals/table.py eval_type:3005).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... a
+        ... 1
+        ... ''')
+        >>> t.eval_type(pw.this.a * 2)
+        int
+        """
+        return self._infer(desugar(expression, self._mapping()))
+
+    def debug(self, name: str) -> "Table":
+        """Print every change flowing through this table at runtime,
+        prefixed with ``name`` (reference: internals/table.py debug:2533,
+        DebugOperator)."""
+        from pathway_tpu.io._subscribe import subscribe
+
+        names = self.column_names()
+
+        def on_change(key, row, time, is_addition):
+            sign = "+" if is_addition else "-"
+            cols = ", ".join(f"{c}={row[c]!r}" for c in names)
+            print(f"[debug {name}] {sign} @{time} {key!r}: {cols}")
+
+        subscribe(self, on_change=on_change)
+        return self
+
+    def to(self, sink) -> None:
+        """Write this table to a data sink object (reference:
+        internals/table.py to:2540, table_io.table_to_datasink). A sink is
+        anything exposing ``write(table)`` — e.g. a thin wrapper binding
+        one of the module-level ``pw.io.*.write`` functions to its
+        destination arguments."""
+        write = getattr(sink, "write", None)
+        if write is None:
+            raise TypeError(
+                f"{type(sink).__name__} is not a data sink "
+                "(expected a .write(table) method)"
+            )
+        write(self)
+
     # -- lookup -----------------------------------------------------------
     def ix(self, expression, *, optional: bool = False, context=None, allow_misses: bool = False) -> "Table":
         """`target.ix(keys)` — row lookup by pointer (reference: table.py ix,

@@ -409,6 +409,58 @@ def test_table_api_parity():
     assert missing == []
 
 
+def test_table_api_parity_vs_reference_source():
+    """Diff dir(Table) against the ACTUAL reference Table class + its
+    __init__ grafts (VERDICT r3 item 2's done-criterion). Skipped when the
+    reference checkout is absent."""
+    import ast
+    import os
+    import re
+
+    ref_table = "/root/reference/python/pathway/internals/table.py"
+    ref_init = "/root/reference/python/pathway/__init__.py"
+    if not (os.path.exists(ref_table) and os.path.exists(ref_init)):
+        pytest.skip("reference checkout not available")
+    methods = set()
+    tree = ast.parse(open(ref_table).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Table":
+            for item in node.body:
+                if isinstance(
+                    item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and not item.name.startswith("_"):
+                    methods.add(item.name)
+    for m in re.finditer(r"^Table\.(\w+)\s*=", open(ref_init).read(), re.M):
+        if not m.group(1).startswith("_"):
+            methods.add(m.group(1))
+    missing = sorted(m for m in methods if not hasattr(pw.Table, m))
+    assert missing == [], f"reference Table methods absent: {missing}"
+
+
+def test_debug_to_and_eval_type():
+    from pathway_tpu.internals import dtype as dt
+
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+    assert t.eval_type(pw.this.a * 2) is dt.INT
+    assert t.debug("probe") is t  # chains; prints at runtime
+
+    written = []
+
+    class Sink:
+        def write(self, table):
+            written.append(table)
+
+    t.to(Sink())
+    assert written == [t]
+    with pytest.raises(TypeError):
+        t.to(object())
+
+
 def test_forget_with_datetime_threshold():
     """forget's threshold expression handles datetime + timedelta, like
     the reference's IntervalType contract (table.py forget:670)."""
