@@ -53,8 +53,25 @@ from pathway_tpu.internals.expression import (
     ColumnExpression,
     ColumnReference,
 )
-from pathway_tpu.internals.joins import JoinMode, JoinResult
+from pathway_tpu.internals.joins import (
+    GroupedJoinResult,
+    JoinMode,
+    JoinResult,
+    OuterJoinResult,
+    join,
+    join_inner,
+    join_left,
+    join_outer,
+    join_right,
+)
+from pathway_tpu.internals.joins import groupby as groupby  # noqa: PLC0414
 from pathway_tpu.internals.groupbys import GroupedTable
+from pathway_tpu.internals.api import (
+    PathwayType as Type,
+    PersistenceMode,
+)
+from pathway_tpu.internals.schema import SchemaProperties
+from pathway_tpu.internals.iterate import iterate_universe
 from pathway_tpu.internals.parse_graph import G as parse_graph_G
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.reducers import BaseCustomAccumulator, reducers
@@ -154,6 +171,35 @@ Table.interval_join_outer = temporal.interval_join_outer
 Table.windowby = temporal.windowby
 Table.interpolate = statistical.interpolate
 Table.inactivity_detection = temporal.inactivity_detection
+
+# type exports (reference: pathway/__init__.py __all__ — Joinable/
+# TableLike are base classes there; independent classes here, so the
+# names are virtual base classes preserving isinstance semantics)
+import abc as _abc  # noqa: E402
+
+
+class Joinable(metaclass=_abc.ABCMeta):
+    """Anything join()-able: Table or JoinResult (reference: joins.py
+    Joinable:46 — a real base class there, a virtual one here)."""
+
+
+class TableLike(metaclass=_abc.ABCMeta):
+    """reference: table_like.py TableLike."""
+
+
+Joinable.register(Table)
+Joinable.register(JoinResult)
+TableLike.register(Table)
+
+# the reference lists these in __all__ without binding them (stale
+# entries); bind them to their historical meanings so both names resolve
+asynchronous = udfs  # the pre-rename name of the udfs module
+window = temporal  # window types live in the temporal namespace
+from pathway_tpu.stdlib.temporal import (  # noqa: E402
+    AsofJoinResult,
+    IntervalJoinResult,
+    WindowJoinResult,
+)
 
 
 def __getattr__(name):

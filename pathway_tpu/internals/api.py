@@ -6,6 +6,7 @@ python/pathway/__init__.py, internals/common.py).
 
 from __future__ import annotations
 
+import enum as _enum
 import typing
 from typing import Any, Callable
 
@@ -239,3 +240,59 @@ def import_table(exported: ExportedTable):
                 self.commit()
 
     return read(_ImportSubject, schema=exported.schema)
+
+
+class PathwayType:
+    """Connector-facing type tags (reference: engine.pyi PathwayType:34,
+    exported as ``pw.Type``). Each tag IS the corresponding internal
+    dtype, so schemas built from these flow through unchanged."""
+
+    ANY = dt.ANY
+    STRING = dt.STR
+    INT = dt.INT
+    BOOL = dt.BOOL
+    FLOAT = dt.FLOAT
+    POINTER = dt.POINTER
+    DATE_TIME_NAIVE = dt.DATE_TIME_NAIVE
+    DATE_TIME_UTC = dt.DATE_TIME_UTC
+    DURATION = dt.DURATION
+    JSON = dt.JSON
+    BYTES = dt.BYTES
+    PY_OBJECT_WRAPPER = dt.PY_OBJECT_WRAPPER
+
+    @staticmethod
+    def optional(arg):
+        return dt.Optionalize(arg)
+
+    @staticmethod
+    def array(dim=None, wrapped=None):
+        return dt.ArrayDType(
+            dim, wrapped if wrapped is not None else dt.ANY
+        )
+
+    @staticmethod
+    def tuple(*args):
+        return dt.TupleDType(tuple(args))
+
+    @staticmethod
+    def list(arg):
+        return dt.ListDType(arg)
+
+    @staticmethod
+    def future(arg):
+        return dt.Future(arg)
+
+
+class PersistenceMode(_enum.Enum):
+    """reference: engine.pyi PersistenceMode:937. The engine honors
+    PERSISTING/OPERATOR_PERSISTING (input + operator snapshots) and the
+    replay modes through PATHWAY_REPLAY_MODE; the rest are accepted for
+    config parity."""
+
+    BATCH = "batch"
+    SPEEDRUN_REPLAY = "speedrun_replay"
+    REALTIME_REPLAY = "realtime_replay"
+    PERSISTING = "persisting"
+    SELECTIVE_PERSISTING = "selective_persisting"
+    UDF_CACHING = "udf_caching"
+    OPERATOR_PERSISTING = "operator_persisting"

@@ -162,7 +162,22 @@ class IterateOutputNode(Node):
         self.emit(time, out)
 
 
+class iterate_universe:
+    """Marker for an iterated table whose universe may change between
+    iterations (reference: internals/operator.py iterate_universe:309).
+    This engine's fixed-point loop tracks full table state rather than
+    per-universe arrangements, so changing universes are always allowed —
+    the marker unwraps to its table and exists for API parity."""
+
+    def __init__(self, table: Table):
+        self.table = table
+
+
 def iterate_impl(func, iteration_limit: int | None = None, **kwargs):
+    kwargs = {
+        name: (t.table if isinstance(t, iterate_universe) else t)
+        for name, t in kwargs.items()
+    }
     input_tables: Dict[str, Table] = {
         name: t for name, t in kwargs.items() if isinstance(t, Table)
     }

@@ -384,3 +384,38 @@ class _JoinThisProxy:
             self._jr._left.column_names() + self._jr._right.column_names()
         )
         return list(seen)
+
+
+# flattened-hierarchy aliases (reference: joins.py Joinable:46 is the base
+# of Table and JoinResult; table_like.py TableLike. Here the classes are
+# independent, so the exported names point at the primary types.)
+OuterJoinResult = JoinResult
+GroupedJoinResult = _RemappedGroupBy
+
+
+def join(left, right, *on, id=None, how=JoinMode.INNER, **kwargs):
+    """Free-function form of ``left.join(right, ...)`` (reference:
+    joins.py join:1161)."""
+    return left.join(right, *on, id=id, how=how, **kwargs)
+
+
+def join_inner(left, right, *on, **kwargs):
+    return left.join_inner(right, *on, **kwargs)
+
+
+def join_left(left, right, *on, **kwargs):
+    return left.join_left(right, *on, **kwargs)
+
+
+def join_right(left, right, *on, **kwargs):
+    return left.join_right(right, *on, **kwargs)
+
+
+def join_outer(left, right, *on, **kwargs):
+    return left.join_outer(right, *on, **kwargs)
+
+
+def groupby(grouped, *args, **kwargs):
+    """Free-function form of ``grouped.groupby(...)`` over a Table or a
+    JoinResult (reference: table.py groupby:3048)."""
+    return grouped.groupby(*args, **kwargs)

@@ -17,6 +17,7 @@ from pathway_tpu.stdlib.temporal.temporal_behavior import (
     exactly_once_behavior,
 )
 from pathway_tpu.stdlib.temporal._interval_join import (
+    IntervalJoinResult,
     interval,
     interval_join,
     interval_join_inner,
@@ -39,6 +40,7 @@ from pathway_tpu.stdlib.temporal._asof_now_join import (
     asof_now_join_left,
 )
 from pathway_tpu.stdlib.temporal._window_join import (
+    WindowJoinResult,
     window_join,
     window_join_inner,
     window_join_left,
@@ -51,6 +53,8 @@ from pathway_tpu.stdlib.temporal.time_utils import (
 )
 
 __all__ = [
+    "IntervalJoinResult",
+    "WindowJoinResult",
     "Window",
     "windowby",
     "tumbling",
