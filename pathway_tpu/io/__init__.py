@@ -7,13 +7,21 @@ io/python/__init__.py:47).
 
 from __future__ import annotations
 
-from pathway_tpu.io._subscribe import subscribe
+from pathway_tpu.io._subscribe import (
+    OnChangeCallback,
+    OnFinishCallback,
+    subscribe,
+)
+from pathway_tpu.io.fs import CsvParserSettings
 from pathway_tpu.io._synchronization import register_input_synchronization_group
 
 from pathway_tpu.io import csv, fs, jsonlines, null, plaintext, python
 
 __all__ = [
     "subscribe",
+    "CsvParserSettings",
+    "OnChangeCallback",
+    "OnFinishCallback",
     "register_input_synchronization_group",
     "csv",
     "fs",

@@ -45,8 +45,46 @@ def coerce_json_value(v, dtype: dt.DType):
     return v
 
 
+
+def _comment_filter(lines, cs):
+    """Drop comment lines, but never inside an open quoted field: quote
+    parity tracks whether a record spans lines (doubled quotes cancel,
+    keeping parity correct for the doublequote escape style)."""
+    in_quote = False
+    for ln in lines:
+        if (
+            not in_quote
+            and cs.comment_character
+            and ln.startswith(cs.comment_character)
+        ):
+            continue
+        if cs.enable_quoting and cs.quote:
+            if ln.count(cs.quote) % 2 == 1:
+                in_quote = not in_quote
+        yield ln
+
+
+def build_csv_reader(lines, csv_settings):
+    """DictReader honoring CsvParserSettings; plain reader when None.
+    Shared by the fs and object-store connectors so the settings mean the
+    same thing everywhere (reference: io/_utils.py CsvParserSettings)."""
+    if csv_settings is None:
+        return csv_mod.DictReader(lines)
+    cs = csv_settings
+    return csv_mod.DictReader(
+        _comment_filter(lines, cs),
+        delimiter=cs.delimiter,
+        quotechar=cs.quote if cs.enable_quoting else None,
+        escapechar=cs.escape,
+        doublequote=cs.enable_double_quote_escapes,
+        quoting=(
+            csv_mod.QUOTE_MINIMAL if cs.enable_quoting else csv_mod.QUOTE_NONE
+        ),
+    )
+
+
 def parse_object(
-    payload: bytes, format: str, schema
+    payload: bytes, format: str, schema, csv_settings=None
 ) -> Iterator[Dict[str, Any]]:
     """Parse one object's bytes into rows.
 
@@ -80,8 +118,8 @@ def parse_object(
         return
     if format == "csv":
         names = set(schema.keys())
-        reader = csv_mod.DictReader(
-            io_mod.StringIO(payload.decode(errors="replace"))
+        reader = build_csv_reader(
+            io_mod.StringIO(payload.decode(errors="replace")), csv_settings
         )
         for rec in reader:
             yield {

@@ -3,9 +3,14 @@ python/pathway/io/_subscribe.py:16, engine subscribe_table)."""
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Any, Callable
 
 from pathway_tpu.internals.parse_graph import G
+
+# callback type aliases (reference: io/_subscribe.py OnChangeCallback /
+# OnFinishCallback — used in signatures and exported for user typing)
+OnChangeCallback = Callable[[Any, dict, int, bool], Any]
+OnFinishCallback = Callable[[], Any]
 
 
 def subscribe(

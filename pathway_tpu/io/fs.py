@@ -43,6 +43,28 @@ def _with_metadata(schema):
     return schema_from_columns(cols, name=schema.__name__ + "Meta")
 
 
+class CsvParserSettings:
+    """CSV parser settings (reference: io/_utils.py CsvParserSettings:146).
+    ``delimiter``/``quote``/``escape`` map onto the csv module; the
+    remaining flags are accepted for config parity."""
+
+    def __init__(
+        self,
+        delimiter: str = ",",
+        quote: str = '"',
+        escape: str | None = None,
+        enable_double_quote_escapes: bool = True,
+        enable_quoting: bool = True,
+        comment_character: str | None = None,
+    ):
+        self.delimiter = delimiter
+        self.quote = quote
+        self.escape = escape
+        self.enable_double_quote_escapes = enable_double_quote_escapes
+        self.enable_quoting = enable_quoting
+        self.comment_character = comment_character
+
+
 class _FsSubject(ConnectorSubjectBase):
     def __init__(
         self,
@@ -54,6 +76,7 @@ class _FsSubject(ConnectorSubjectBase):
         refresh_interval: float = 1.0,
         object_pattern: str = "*",
         batch_per_file: bool = False,
+        csv_settings: "CsvParserSettings | None" = None,
     ):
         super().__init__()
         self.path = path
@@ -64,6 +87,7 @@ class _FsSubject(ConnectorSubjectBase):
         self.refresh_interval = refresh_interval
         self.object_pattern = object_pattern
         self.batch_per_file = batch_per_file
+        self.csv_settings = csv_settings
         self._seen: Dict[str, float] = {}
 
     def _list_files(self) -> List[str]:
@@ -148,7 +172,9 @@ class _FsSubject(ConnectorSubjectBase):
         elif self.format == "csv":
             names = set(self.schema.keys())
             with open(f, "r", newline="", errors="replace") as fh:
-                reader = csv_mod.DictReader(fh)
+                from pathway_tpu.io._formats import build_csv_reader
+
+                reader = build_csv_reader(fh, self.csv_settings)
                 chunk = []
                 for rec in reader:
                     row = {
@@ -322,6 +348,7 @@ def read(
             refresh_interval=refresh_interval,
             object_pattern=object_pattern,
             batch_per_file=batch_per_file,
+            csv_settings=csv_settings,
         )
 
     return connector_table(

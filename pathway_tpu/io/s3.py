@@ -107,7 +107,7 @@ class _Boto3Client(S3Client):
 
 
 class _S3Subject(ConnectorSubjectBase):
-    def __init__(self, client_factory, prefix, format, schema, mode, with_metadata, refresh_interval=1.0):
+    def __init__(self, client_factory, prefix, format, schema, mode, with_metadata, refresh_interval=1.0, csv_settings=None):
         super().__init__()
         self.client_factory = client_factory
         self.prefix = prefix
@@ -116,6 +116,7 @@ class _S3Subject(ConnectorSubjectBase):
         self.mode = mode
         self.with_metadata = with_metadata
         self.refresh_interval = refresh_interval
+        self.csv_settings = csv_settings
         self._seen: Dict[str, str] = {}
 
     def _emit_object(self, key: str, payload: bytes) -> None:
@@ -128,7 +129,9 @@ class _S3Subject(ConnectorSubjectBase):
                     {"path": key, "size": len(payload), "seen_at": int(time_mod.time())}
                 )
             }
-        for row in parse_object(payload, self.format, self.schema):
+        for row in parse_object(
+            payload, self.format, self.schema, csv_settings=self.csv_settings
+        ):
             self.next(**row, **meta)
 
     def run(self) -> None:
@@ -163,6 +166,7 @@ def read(
     autocommit_duration_ms: int | None = 1500,
     name: str | None = None,
     refresh_interval: float = 1.0,
+    csv_settings=None,
     _client_factory=None,
     **kwargs,
 ):
@@ -202,6 +206,7 @@ def read(
             mode,
             with_metadata,
             refresh_interval=refresh_interval,
+            csv_settings=csv_settings,
         )
 
     return connector_table(out_schema, factory, mode=mode, name=name)
