@@ -133,11 +133,13 @@ def run(
         http_server = PrometheusServer(engine, process_id=engine.worker_id)
         http_server.start()
     try:
+        from pathway_tpu.persistence import get_persistence_engine_config
+
         with telemetry.span(
             "graph_runner.run",
             workers=engine.worker_count,
             streaming=bool(G.sources),
-        ):
+        ), get_persistence_engine_config(persistence_config):
             if G.sources:
                 _run_streaming(
                     engine, ctx, persistence_config, autocommit_duration_ms

@@ -32,6 +32,7 @@ from pathway_tpu.internals.udfs.retries import (
     with_retry_strategy,
 )
 from pathway_tpu.internals.udfs.executors import (
+    async_options,
     Executor,
     async_executor,
     auto_executor,
@@ -42,6 +43,7 @@ from pathway_tpu.internals.udfs.executors import (
 )
 
 __all__ = [
+    "async_options",
     "UDF",
     "udf",
     "CacheStrategy",

@@ -44,17 +44,14 @@ class AsyncExecutor(Executor):
     retry_strategy: Any = None
 
     def _build_expression(self, udf, fun, args, kwargs):
-        from pathway_tpu.internals.udfs import coerce_async
-
-        afun = coerce_async(fun)
-        if self.retry_strategy is not None:
-            from pathway_tpu.internals.udfs.retries import with_retry_strategy
-
-            afun = with_retry_strategy(afun, self.retry_strategy)
-        if self.timeout is not None:
-            afun = _with_timeout(afun, self.timeout)
-        if self.capacity is not None:
-            afun = _with_capacity(afun, self.capacity)
+        # ONE wrapping order for both public paths (async_options is the
+        # canonical composition; reference semantics: timeout applies to a
+        # single retry attempt)
+        afun = async_options(
+            capacity=self.capacity,
+            timeout=self.timeout,
+            retry_strategy=self.retry_strategy,
+        )(fun)
         afun = _apply_cache(udf, afun, is_async=True)
         return ApplyExpression(
             afun,
@@ -165,3 +162,38 @@ def _apply_cache(udf, fun: Callable, is_async: bool = False) -> Callable:
     from pathway_tpu.internals.udfs.caches import with_cache_strategy
 
     return with_cache_strategy(fun, udf.cache_strategy, is_async=is_async)
+
+
+def async_options(
+    capacity: int | None = None,
+    timeout: float | None = None,
+    retry_strategy=None,
+    cache_strategy=None,
+) -> Callable:
+    """Decorator applying async options to a function (reference:
+    internals/udfs/executors.py async_options:387): the function is
+    coerced to a coroutine and wrapped with timeout / retry / capacity /
+    cache in the reference's order."""
+    from pathway_tpu.internals.udfs import coerce_async
+
+    def decorator(f: Callable) -> Callable:
+        func = coerce_async(f)
+        if timeout is not None:
+            func = _with_timeout(func, timeout)
+        if retry_strategy is not None:
+            from pathway_tpu.internals.udfs.retries import (
+                with_retry_strategy,
+            )
+
+            func = with_retry_strategy(func, retry_strategy)
+        if capacity is not None:
+            func = _with_capacity(func, capacity)
+        if cache_strategy is not None:
+            from pathway_tpu.internals.udfs.caches import (
+                with_cache_strategy,
+            )
+
+            func = with_cache_strategy(func, cache_strategy, is_async=True)
+        return func
+
+    return decorator

@@ -340,6 +340,19 @@ class Config:
     def simple_config(cls, backend: Backend, **kwargs) -> "Config":
         return cls(backend, **kwargs)
 
+    # run-lifecycle hooks (reference: persistence Config.on_before_run /
+    # on_after_run — env setup/teardown for cloud backends); the backend
+    # gets first refusal so e.g. an S3 backend can stage credentials
+    def on_before_run(self) -> None:
+        hook = getattr(self.backend, "on_before_run", None)
+        if hook is not None:
+            hook()
+
+    def on_after_run(self) -> None:
+        hook = getattr(self.backend, "on_after_run", None)
+        if hook is not None:
+            hook()
+
 
 class OperatorSnapshotManager:
     """Checkpoint operator state keyed by frontier + compact input logs
@@ -700,3 +713,25 @@ class CachedObjectStorage:
                 continue
             out[entry["object_id"]] = entry["version"]
         return out
+
+
+from contextlib import contextmanager as _contextmanager
+
+from pathway_tpu.io.s3 import AwsS3Settings  # noqa: E402 — parity re-export
+
+
+@_contextmanager
+def get_persistence_engine_config(persistence_config):
+    """Context manager yielding the engine-facing persistence config with
+    the run-lifecycle hooks bracketed (reference: persistence/__init__.py
+    get_persistence_engine_config:193 — on_before_run before the run,
+    on_after_run guaranteed after it). The runner enters this around
+    every persistent run."""
+    if persistence_config is None:
+        yield None
+        return
+    persistence_config.on_before_run()
+    try:
+        yield persistence_config
+    finally:
+        persistence_config.on_after_run()

@@ -9,7 +9,10 @@ from pathway_tpu.stdlib.indexing.data_index import (
     IdScoreSchema,
 )
 from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    AbstractRetrieverFactory,
     BruteForceKnn,
+    DefaultKnnFactory,
+    LshKnnFactory,
     BruteForceKnnFactory,
     BruteForceKnnMetricKind,
     LshKnn,
@@ -30,6 +33,9 @@ from pathway_tpu.stdlib.indexing.full_text_document_index import (
 )
 
 __all__ = [
+    "AbstractRetrieverFactory",
+    "DefaultKnnFactory",
+    "LshKnnFactory",
     "DataIndex",
     "InnerIndex",
     "IdScoreSchema",

@@ -14,6 +14,9 @@ from typing import Any, Dict, List, Optional
 
 from pathway_tpu.engine.index_node import IndexImpl
 from pathway_tpu.stdlib.indexing._filters import evaluate_filter
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    AbstractRetrieverFactory,
+)
 from pathway_tpu.stdlib.indexing.data_index import DataIndex, InnerIndex
 
 _TOKEN_RE = re.compile(r"[A-Za-z0-9_]+")
@@ -105,7 +108,7 @@ class TantivyBM25(InnerIndex):
 
 
 @dataclass(kw_only=True)
-class TantivyBM25Factory:
+class TantivyBM25Factory(AbstractRetrieverFactory):
     ram_budget: int = 50_000_000
     in_memory_index: bool = True
 
@@ -117,7 +120,3 @@ class TantivyBM25Factory:
             in_memory_index=self.in_memory_index,
         )
 
-    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
-        return DataIndex(
-            data_table, self.build_inner_index(data_column, metadata_column)
-        )

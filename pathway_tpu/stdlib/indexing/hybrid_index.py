@@ -7,6 +7,9 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List
 
 from pathway_tpu.engine.index_node import IndexImpl
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    AbstractRetrieverFactory,
+)
 from pathway_tpu.stdlib.indexing.data_index import DataIndex, InnerIndex
 
 
@@ -69,7 +72,7 @@ class HybridIndex(InnerIndex):
 
 
 @dataclass
-class HybridIndexFactory:
+class HybridIndexFactory(AbstractRetrieverFactory):
     retriever_factories: List[Any]
     k: float = 60.0
 
@@ -80,7 +83,3 @@ class HybridIndexFactory:
         ]
         return HybridIndex(inner, k=self.k)
 
-    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
-        return DataIndex(
-            data_table, self.build_inner_index(data_column, metadata_column)
-        )

@@ -370,8 +370,24 @@ class LshKnn(BruteForceKnn):
     _data_preprocess = _query_preprocess
 
 
+class AbstractRetrieverFactory:
+    """Base for index factories (reference: indexing/retrievers.py
+    AbstractRetrieverFactory:7): subclasses provide build_inner_index;
+    build_index wraps it in a DataIndex."""
+
+    def build_inner_index(self, data_column, metadata_column=None):
+        raise NotImplementedError
+
+    def build_index(self, data_column, data_table, metadata_column=None):
+        from pathway_tpu.stdlib.indexing.data_index import DataIndex
+
+        return DataIndex(
+            data_table, self.build_inner_index(data_column, metadata_column)
+        )
+
+
 @dataclass(kw_only=True)
-class BruteForceKnnFactory:
+class BruteForceKnnFactory(AbstractRetrieverFactory):
     """reference: nearest_neighbors.py BruteForceKnnFactory:407."""
 
     dimensions: int | None = None
@@ -394,14 +410,10 @@ class BruteForceKnnFactory:
             mesh=self.mesh,
         )
 
-    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
-        return DataIndex(
-            data_table, self.build_inner_index(data_column, metadata_column)
-        )
 
 
 @dataclass(kw_only=True)
-class UsearchKnnFactory:
+class UsearchKnnFactory(AbstractRetrieverFactory):
     """reference: nearest_neighbors.py UsearchKnnFactory."""
 
     dimensions: int | None = None
@@ -428,14 +440,10 @@ class UsearchKnnFactory:
             embedder=self.embedder,
         )
 
-    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
-        return DataIndex(
-            data_table, self.build_inner_index(data_column, metadata_column)
-        )
 
 
 @dataclass(kw_only=True)
-class LshKnnFactory:
+class LshKnnFactory(AbstractRetrieverFactory):
     dimensions: int | None = None
     n_or: int = 20
     n_and: int = 10
@@ -455,7 +463,11 @@ class LshKnnFactory:
             embedder=self.embedder,
         )
 
-    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
-        return DataIndex(
-            data_table, self.build_inner_index(data_column, metadata_column)
-        )
+
+
+
+@dataclass(kw_only=True)
+class DefaultKnnFactory(BruteForceKnnFactory):
+    """The default KNN factory — brute force on the device (reference:
+    nearest_neighbors.py DefaultKnnFactory:574, which also defaults to
+    BruteForceKnn)."""

@@ -17,6 +17,7 @@ from pathway_tpu.stdlib.temporal.temporal_behavior import (
     exactly_once_behavior,
 )
 from pathway_tpu.stdlib.temporal._interval_join import (
+    Interval,
     IntervalJoinResult,
     interval,
     interval_join,
@@ -35,6 +36,7 @@ from pathway_tpu.stdlib.temporal._asof_join import (
     asof_join_right,
 )
 from pathway_tpu.stdlib.temporal._asof_now_join import (
+    AsofNowJoinResult,
     asof_now_join,
     asof_now_join_inner,
     asof_now_join_left,
@@ -53,6 +55,8 @@ from pathway_tpu.stdlib.temporal.time_utils import (
 )
 
 __all__ = [
+    "Interval",
+    "AsofNowJoinResult",
     "IntervalJoinResult",
     "WindowJoinResult",
     "Window",

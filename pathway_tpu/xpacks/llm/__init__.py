@@ -2,6 +2,11 @@
 store, vector store, RAG question answering, servers (reference:
 python/pathway/xpacks/llm/)."""
 
+from pathway_tpu.xpacks.llm._typing import (
+    Doc,
+    DocTransformer,
+    DocTransformerCallable,
+)
 from pathway_tpu.xpacks.llm import (
     embedders,
     llms,
@@ -12,6 +17,9 @@ from pathway_tpu.xpacks.llm import (
 )
 
 __all__ = [
+    "Doc",
+    "DocTransformer",
+    "DocTransformerCallable",
     "embedders",
     "llms",
     "parsers",

@@ -536,3 +536,52 @@ def test_iterate_universe_marker():
 
     out = pw.iterate(step, u=pw.iterate_universe(t))
     assert _rows(out.u if hasattr(out, "u") else out) == [(0,)]
+
+
+def test_submodule_namespace_parity_vs_reference():
+    """Reference public names resolve across the stdlib/xpack namespaces
+    (reducers, debug, udfs, persistence, temporal, indexing, ml, llm)."""
+    import ast
+    import os
+
+    import pathway_tpu.xpacks.llm as llm
+
+    ref_root = "/root/reference/python/pathway"
+    if not os.path.exists(ref_root):
+        pytest.skip("reference checkout not available")
+
+    def public_names(path):
+        """__all__ when declared, else the module's own public defs —
+        incidental imports (Table, api, dataclass...) are NOT the
+        module's API and would make the sweep demand noise."""
+        tree = ast.parse(open(path).read())
+        names = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "__all__":
+                        return {ast.literal_eval(e) for e in node.value.elts}
+            if isinstance(
+                node, (ast.FunctionDef, ast.ClassDef)
+            ) and not node.name.startswith("_"):
+                names.add(node.name)
+        return names
+
+    sweeps = [
+        (f"{ref_root}/reducers.py", pw.reducers),
+        (f"{ref_root}/udfs.py", pw.udfs),
+        (f"{ref_root}/debug/__init__.py", pw.debug),
+        (f"{ref_root}/persistence/__init__.py", pw.persistence),
+        (f"{ref_root}/stdlib/temporal/__init__.py", pw.temporal),
+        (f"{ref_root}/stdlib/indexing/__init__.py", pw.indexing),
+        (f"{ref_root}/stdlib/ml/__init__.py", pw.ml),
+        (f"{ref_root}/xpacks/llm/__init__.py", llm),
+    ]
+    problems = {}
+    for path, mod in sweeps:
+        missing = sorted(
+            n for n in public_names(path) if not hasattr(mod, n)
+        )
+        if missing:
+            problems[mod.__name__] = missing
+    assert problems == {}, problems
