@@ -392,3 +392,63 @@ def test_window_rejects_group_by_mix():
         )
     with pytest.raises(ValueError):
         pw.sql("SELECT ROW_NUMBER() OVER () AS rn FROM t", t=t)
+
+
+def test_window_functions_match_pandas_oracle():
+    """Randomized cross-check: WindowFunctionNode vs pandas groupby
+    transforms over 30 random tables (ranking + running/whole-partition
+    aggregates, ties included)."""
+    import random
+
+    import pandas as pd
+
+    rng = random.Random(42)
+    for trial in range(30):
+        n = rng.randrange(1, 40)
+        df = pd.DataFrame(
+            {
+                "g": [rng.choice("abc") for _ in range(n)],
+                "o": [rng.randrange(6) for _ in range(n)],
+                "v": [rng.randrange(-5, 10) for _ in range(n)],
+            }
+        )
+        pw.G.clear()
+        t = pw.debug.table_from_pandas(df)
+        res = pw.sql(
+            "SELECT g, o, v, "
+            "RANK() OVER (PARTITION BY g ORDER BY o) AS r, "
+            "DENSE_RANK() OVER (PARTITION BY g ORDER BY o) AS d, "
+            "SUM(v) OVER (PARTITION BY g ORDER BY o) AS rs, "
+            "COUNT(*) OVER (PARTITION BY g) AS c, "
+            "MIN(v) OVER (PARTITION BY g) AS mn "
+            "FROM t",
+            t=t,
+        )
+        got = sorted(_rows(res))
+
+        # pandas oracle with SQL RANGE-frame (peers included) semantics
+        gdf = df.copy()
+        gdf["r"] = (
+            gdf.groupby("g")["o"].rank(method="min").astype(int)
+        )
+        gdf["d"] = (
+            gdf.groupby("g")["o"].rank(method="dense").astype(int)
+        )
+        # running sum including all peers of the current o value
+        peer_sum = (
+            gdf.groupby(["g", "o"])["v"].sum().groupby("g").cumsum()
+        )
+        gdf["rs"] = [
+            peer_sum[(g, o)] for g, o in zip(gdf["g"], gdf["o"])
+        ]
+        gdf["c"] = gdf.groupby("g")["v"].transform("count")
+        gdf["mn"] = gdf.groupby("g")["v"].transform("min")
+        expect = sorted(
+            map(
+                tuple,
+                gdf[["g", "o", "v", "r", "d", "rs", "c", "mn"]].itertuples(
+                    index=False
+                ),
+            )
+        )
+        assert got == expect, (trial, got[:5], expect[:5])
