@@ -585,3 +585,44 @@ def test_submodule_namespace_parity_vs_reference():
         if missing:
             problems[mod.__name__] = missing
     assert problems == {}, problems
+
+
+def test_to_stream_round_trip_identity_randomized():
+    """Invariant: for ANY change stream, to_stream -> stream_to_table
+    reconstructs the original table's final state (30 random streams of
+    keyed inserts/updates/deletes)."""
+    import random
+
+    rng = random.Random(123)
+    for trial in range(30):
+        n_keys = rng.randrange(1, 6)
+        time = 2
+        rows = []
+        state: dict = {}
+        for _step in range(rng.randrange(1, 12)):
+            key = rng.randrange(n_keys) + 1
+            if key in state and rng.random() < 0.4:
+                # delete or update
+                old = state.pop(key)
+                rows.append((key, old, time, -1))
+                if rng.random() < 0.5:
+                    new = rng.randrange(100)
+                    state[key] = new
+                    rows.append((key, new, time, 1))
+            elif key not in state:
+                v = rng.randrange(100)
+                state[key] = v
+                rows.append((key, v, time, 1))
+            time += 2
+        if not rows:
+            continue
+        md = ["id | v | __time__ | __diff__"] + [
+            f"{k} | {v} | {tm} | {d}" for k, v, tm, d in rows
+        ]
+        pw.G.clear()
+        t = pw.debug.table_from_markdown("\n".join(md))
+        rebuilt = t.to_stream().stream_to_table(pw.this.is_upsert).without(
+            pw.this.is_upsert
+        )
+        got = sorted(v for (v,) in _rows(rebuilt))
+        assert got == sorted(state.values()), (trial, got, state)
