@@ -309,8 +309,6 @@ def test_native_consolidate_matches_python():
 def test_random_value_trees_round_trip_and_byte_parity():
     """Generative coverage: random nested value trees round-trip through
     both codecs with identical bytes."""
-    import datetime as dtm
-
     rng = random.Random(99)
 
     def rand_value(depth=0):
