@@ -167,7 +167,11 @@ class TcpCoordinator(Coordinator):
         return buf
 
     def _recv_loop(self, conn: socket.socket) -> None:
-        from pathway_tpu.engine.wire import WireError, decode_message
+        from pathway_tpu.engine.wire import (
+            MSG_HELLO,
+            WireError,
+            decode_message,
+        )
 
         peer = None
         try:
@@ -179,6 +183,11 @@ class TcpCoordinator(Coordinator):
                 blob = self._recv_exact(conn, length)
                 if blob is None:
                     break
+                if peer is None and (not blob or blob[0] != MSG_HELLO):
+                    # refuse to even decode value payloads (incl. the
+                    # pickle escape) from a connection that has not
+                    # identified itself — the first frame must be a hello
+                    raise ExchangeError("message before hello; dropping")
                 try:
                     msg = decode_message(blob)
                 except WireError as exc:
@@ -204,11 +213,6 @@ class TcpCoordinator(Coordinator):
                             f"expected {self.run_id!r}"
                         )
                     continue
-                if peer is None:
-                    # no data/punct/coord before a valid hello: frames from
-                    # unidentified connections are dropped, closing the
-                    # injection path through a bare socket connect
-                    raise ExchangeError("message before hello; dropping")
                 with self._cv:
                     if kind == "data":
                         _, channel, time, deltas = msg

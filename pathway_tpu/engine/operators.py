@@ -1235,6 +1235,27 @@ class WindowFunctionNode(Node):
         or the running RANGE frame ending at each row's last peer."""
         import numpy as np
 
+        int_result = fname in ("sum", "min", "max") and all(
+            isinstance(a, int) and not isinstance(a, bool)
+            for a in args
+            if a is not None
+        )
+        if int_result:
+            # exact Python-int accumulation: routing through float64 would
+            # silently round ints >= 2**53, diverging from the exact
+            # GROUP BY reducers
+            op = {"sum": sum, "min": min, "max": max}[fname]
+            if frame_end is None:
+                ints = [a for a in args if a is not None]
+                agg_i = op(ints) if ints else None
+                return [agg_i] * n
+            run_i: List[Any] = []
+            acc: Any = None
+            for a in args:
+                if a is not None:
+                    acc = a if acc is None else op((acc, a))
+                run_i.append(acc)
+            return [run_i[frame_end[i]] for i in range(n)]
         if fname == "count" and not has_arg:
             present = np.ones(n, dtype=bool)  # COUNT(*) counts all rows
         else:
@@ -1242,18 +1263,11 @@ class WindowFunctionNode(Node):
         vals = np.array(
             [float(a) if a is not None else 0.0 for a in args]
         )
-        int_result = fname in ("sum", "min", "max") and all(
-            isinstance(a, int) and not isinstance(a, bool)
-            for a in args
-            if a is not None
-        )
 
         def finish(x: Any) -> Any:
             if x is None:
                 return None
             if fname == "count":
-                return int(x)
-            if int_result and float(x).is_integer():
                 return int(x)
             return float(x)
 

@@ -93,13 +93,26 @@ def _zigzag(out: bytearray, n: int) -> None:
     _uvarint(out, (n << 1) ^ (n >> 63))
 
 
+# A frame of repeated 2-byte nested container headers could otherwise
+# drive unbounded decode recursion (Python RecursionError / C stack
+# overflow in the native twin). No legitimate engine value nests anywhere
+# near this deep.
+MAX_DECODE_DEPTH = 128
+
+
 class _Reader:
-    __slots__ = ("buf", "pos", "end")
+    __slots__ = ("buf", "pos", "end", "depth")
 
     def __init__(self, buf: bytes, pos: int = 0):
         self.buf = buf
         self.pos = pos
         self.end = len(buf)
+        self.depth = 0
+
+    def enter(self) -> None:
+        self.depth += 1
+        if self.depth > MAX_DECODE_DEPTH:
+            raise WireError("frame nesting too deep")
 
     def take(self, n: int) -> bytes:
         p = self.pos
@@ -117,15 +130,20 @@ class _Reader:
         return self.buf[p]
 
     def uvarint(self) -> int:
+        # strict u64: a tenth byte may only contribute bit 63, and an
+        # eleventh byte is malformed — byte-for-byte the native decoder's
+        # acceptance set, so fuzzed frames can't split the two decoders
         shift = 0
         acc = 0
         while True:
             b = self.byte()
+            if shift == 63 and b & 0x7E:
+                raise WireError("varint overflow")
             acc |= (b & 0x7F) << shift
             if not b & 0x80:
                 return acc
             shift += 7
-            if shift > 140:
+            if shift > 63:
                 raise WireError("varint overflow")
 
     def zigzag(self) -> int:
@@ -133,7 +151,20 @@ class _Reader:
         return (z >> 1) ^ -(z & 1)
 
 
-def encode_value(out: bytearray, v: Any) -> None:
+def _check_encode_depth(depth: int) -> None:
+    # surface over-deep values at the PRODUCER with a clear error —
+    # otherwise they would encode fine and kill the run at the receiving
+    # peer as a spurious "malformed frame". Counted on container ENTRY
+    # (like the decoder and the native encoder), so an empty container at
+    # the limit is rejected identically everywhere.
+    if depth >= MAX_DECODE_DEPTH:
+        raise WireError(
+            f"value nests deeper than {MAX_DECODE_DEPTH} containers; "
+            "flatten it before sending"
+        )
+
+
+def encode_value(out: bytearray, v: Any, _depth: int = 0) -> None:
     t = type(v)
     if v is None:
         out.append(T_NONE)
@@ -164,24 +195,28 @@ def encode_value(out: bytearray, v: Any) -> None:
         out.append(T_POINTER)
         out += v.value.to_bytes(16, "little")
     elif t is tuple:
+        _check_encode_depth(_depth)
         out.append(T_TUPLE)
         _uvarint(out, len(v))
         for x in v:
-            encode_value(out, x)
+            encode_value(out, x, _depth + 1)
     elif t is list:
+        _check_encode_depth(_depth)
         out.append(T_LIST)
         _uvarint(out, len(v))
         for x in v:
-            encode_value(out, x)
+            encode_value(out, x, _depth + 1)
     elif t is dict:
+        _check_encode_depth(_depth)
         out.append(T_DICT)
         _uvarint(out, len(v))
         for k, x in v.items():
-            encode_value(out, k)
-            encode_value(out, x)
+            encode_value(out, k, _depth + 1)
+            encode_value(out, x, _depth + 1)
     elif t is Json:
+        _check_encode_depth(_depth)
         out.append(T_JSON)
-        encode_value(out, v.value)
+        encode_value(out, v.value, _depth + 1)
     elif isinstance(v, Error):
         # trace payload survives the wire (0-length = the plain singleton)
         out.append(T_ERROR)
@@ -287,22 +322,40 @@ def decode_value(r: _Reader, _tag: int | None = None) -> Any:
     if tag == T_POINTER:
         return Pointer(int.from_bytes(r.take(16), "little"))
     if tag == T_TUPLE:
-        return tuple(decode_value(r) for _ in range(r.uvarint()))
+        r.enter()
+        try:
+            return tuple(decode_value(r) for _ in range(r.uvarint()))
+        finally:
+            r.depth -= 1
     if tag == T_LIST:
-        return [decode_value(r) for _ in range(r.uvarint())]
+        r.enter()
+        try:
+            return [decode_value(r) for _ in range(r.uvarint())]
+        finally:
+            r.depth -= 1
     if tag == T_DICT:
+        r.enter()
         try:
             return {
                 decode_value(r): decode_value(r) for _ in range(r.uvarint())
             }
         except TypeError as exc:  # unhashable decoded key
             raise WireError(f"bad dict key in frame: {exc}") from None
+        finally:
+            r.depth -= 1
     if tag == T_JSON:
-        return Json(decode_value(r))
+        r.enter()
+        try:
+            return Json(decode_value(r))
+        finally:
+            r.depth -= 1
     if tag == T_NDARRAY:
         import numpy as np
 
-        dts = r.take(r.uvarint()).decode("ascii")
+        try:
+            dts = r.take(r.uvarint()).decode("ascii")
+        except UnicodeDecodeError as exc:
+            raise WireError(f"bad ndarray dtype: {exc}") from None
         shape = tuple(r.uvarint() for _ in range(r.uvarint()))
         raw = r.take(r.uvarint())
         try:
@@ -342,7 +395,10 @@ def decode_value(r: _Reader, _tag: int | None = None) -> Any:
     if tag == T_NPSCALAR:
         import numpy as np
 
-        dts = r.take(r.uvarint()).decode("ascii")
+        try:
+            dts = r.take(r.uvarint()).decode("ascii")
+        except UnicodeDecodeError as exc:
+            raise WireError(f"bad numpy scalar dtype: {exc}") from None
         raw = r.take(r.uvarint())
         try:
             return np.frombuffer(raw, dtype=np.dtype(dts))[0]
@@ -389,18 +445,18 @@ _PICKLE_ALLOWLIST = {
 
 
 def _safe_getattr(obj, name, *default):
-    # some stdlib reduce paths go through builtins.getattr; deny
-    # underscore traversal so it cannot walk out of allowlisted objects.
-    # Known-legitimate private hook: ZoneInfo pickles via cls._unpickle.
-    if name.startswith("_"):
-        import zoneinfo
+    # Some stdlib reduce paths go through builtins.getattr. A permissive
+    # shim would let a crafted payload walk to dangerous callables on
+    # otherwise-allowlisted objects (e.g. ndarray.tofile → arbitrary file
+    # write), so only the single known-legitimate pair is allowed: the
+    # ZoneInfo pickle hook. Everything else is a wire error.
+    import zoneinfo
 
-        if not (obj is zoneinfo.ZoneInfo and name == "_unpickle"):
-            raise WireError(
-                f"opaque value getattr({type(obj).__name__}, {name!r}) "
-                "denied"
-            )
-    return getattr(obj, name, *default)
+    if obj is zoneinfo.ZoneInfo and name == "_unpickle":
+        return zoneinfo.ZoneInfo._unpickle
+    raise WireError(
+        f"opaque value getattr({type(obj).__name__}, {name!r}) denied"
+    )
 
 
 def _restricted_loads(raw: bytes) -> Any:
@@ -482,11 +538,24 @@ def py_encode_message(msg: tuple) -> bytes:
 
 
 def py_decode_message(blob: bytes) -> tuple:
+    try:
+        return _py_decode_message(blob)
+    except RecursionError:
+        # belt-and-braces next to the depth cap: interpreter recursion
+        # limits must surface as a protocol error, not escape the
+        # exchange's WireError handler
+        raise WireError("frame nesting exhausted the decoder") from None
+
+
+def _py_decode_message(blob: bytes) -> tuple:
     r = _Reader(blob)
     kind = r.byte()
     if kind == MSG_HELLO:
         worker = _pack_u32.unpack(r.take(4))[0]
-        run_id = r.take(r.uvarint()).decode("utf-8")
+        try:
+            run_id = r.take(r.uvarint()).decode("utf-8")
+        except UnicodeDecodeError as exc:
+            raise WireError(f"bad run id: {exc}") from None
         msg = ("hello", worker, run_id)
     elif kind == MSG_DATA:
         channel = _pack_u32.unpack(r.take(4))[0]
@@ -533,4 +602,6 @@ def decode_message(blob: bytes) -> tuple:
             return ext.decode_message(blob)
         except ValueError as exc:
             raise WireError(str(exc)) from None
+        except RecursionError:
+            raise WireError("frame nesting exhausted the decoder") from None
     return py_decode_message(blob)

@@ -59,6 +59,7 @@ PyObject *g_wire_error = nullptr;    // wire.WireError
 
 struct Buf {
   std::vector<uint8_t> d;
+  int depth = 0;  // container-nesting recursion guard (mirrors wire.py)
   void put(uint8_t b) { d.push_back(b); }
   void put_raw(const void *p, size_t n) {
     const uint8_t *c = static_cast<const uint8_t *>(p);
@@ -84,15 +85,26 @@ struct Buf {
   void u64(uint64_t v) { put_raw(&v, 8); }
 };
 
+// Cap on container-nesting recursion in decode_value: a crafted frame of
+// repeated 2-byte nested container headers would otherwise drive
+// frame-length-deep C recursion and overflow the stack (must be a
+// WireError, never a crash). Mirrors wire.py MAX_DECODE_DEPTH.
+constexpr int kMaxDecodeDepth = 128;
+
 struct Reader {
   const uint8_t *p;
   const uint8_t *end;
   PyObject *frame = nullptr;  // borrowed: the whole frame bytes object
   const uint8_t *base = nullptr;
   bool fail = false;
+  int depth = 0;
 
   bool need(size_t n) {
-    if (static_cast<size_t>(end - p) < n) {
+    // fail is sticky: once any read failed, every later read fails too.
+    // Otherwise a failed uvarint (returning 0) followed by take(0)
+    // yields a valid empty slice and the decoder silently accepts a
+    // truncated frame (fuzz-found: 5-byte hello → run_id "").
+    if (fail || static_cast<size_t>(end - p) < n) {
       fail = true;
       return false;
     }
@@ -109,11 +121,18 @@ struct Reader {
     return r;
   }
   uint64_t uvarint() {
+    // strict u64: a tenth byte may only contribute bit 63 (payload bits
+    // above it would be silently shifted out) — mirrors wire.py exactly
+    // so both decoders accept/reject the same byte strings
     uint64_t acc = 0;
     int shift = 0;
     while (true) {
       uint8_t b = byte();
       if (fail) return 0;
+      if (shift == 63 && (b & 0x7e)) {
+        fail = true;
+        return 0;
+      }
       acc |= static_cast<uint64_t>(b & 0x7f) << shift;
       if (!(b & 0x80)) return acc;
       shift += 7;
@@ -162,6 +181,13 @@ PyObject *decode_key(Reader &r) {
 }
 
 bool encode_value(Buf &out, PyObject *v);
+
+bool encode_too_deep(Buf &) {
+  // surface over-deep values at the producer (mirrors wire.py's
+  // encode-side cap) instead of letting the peer die on decode
+  wire_err("value nests too deeply; flatten it before sending");
+  return false;
+}
 
 bool encode_rare(Buf &out, PyObject *v) {
   // python helper returns the already-tagged bytes for rare values
@@ -225,18 +251,23 @@ bool encode_value(Buf &out, PyObject *v) {
     out.put(TAG_POINTER);
     if (!encode_key(out, v)) return false;
   } else if (PyTuple_CheckExact(v)) {
+    if (++out.depth > kMaxDecodeDepth) return encode_too_deep(out);
     Py_ssize_t n = PyTuple_GET_SIZE(v);
     out.put(TAG_TUPLE);
     out.uvarint(static_cast<uint64_t>(n));
     for (Py_ssize_t i = 0; i < n; i++)
       if (!encode_value(out, PyTuple_GET_ITEM(v, i))) return false;
+    out.depth--;
   } else if (PyList_CheckExact(v)) {
+    if (++out.depth > kMaxDecodeDepth) return encode_too_deep(out);
     Py_ssize_t n = PyList_GET_SIZE(v);
     out.put(TAG_LIST);
     out.uvarint(static_cast<uint64_t>(n));
     for (Py_ssize_t i = 0; i < n; i++)
       if (!encode_value(out, PyList_GET_ITEM(v, i))) return false;
+    out.depth--;
   } else if (PyDict_CheckExact(v)) {
+    if (++out.depth > kMaxDecodeDepth) return encode_too_deep(out);
     out.put(TAG_DICT);
     out.uvarint(static_cast<uint64_t>(PyDict_GET_SIZE(v)));
     PyObject *key, *value;
@@ -245,13 +276,16 @@ bool encode_value(Buf &out, PyObject *v) {
       if (!encode_value(out, key)) return false;
       if (!encode_value(out, value)) return false;
     }
+    out.depth--;
   } else if (Py_TYPE(v) == reinterpret_cast<PyTypeObject *>(g_json_cls)) {
+    if (++out.depth > kMaxDecodeDepth) return encode_too_deep(out);
     PyObject *inner = PyObject_GetAttrString(v, "value");
     if (!inner) return false;
     out.put(TAG_JSON);
     bool ok = encode_value(out, inner);
     Py_DECREF(inner);
     if (!ok) return false;
+    out.depth--;
   } else if (v == g_error_obj) {
     out.put(TAG_ERROR);
     out.uvarint(0);  // plain singleton, no trace
@@ -268,6 +302,15 @@ bool encode_value(Buf &out, PyObject *v) {
 }
 
 PyObject *decode_value(Reader &r);
+
+struct DepthGuard {
+  Reader &r;
+  bool ok;
+  explicit DepthGuard(Reader &rr) : r(rr), ok(++rr.depth <= kMaxDecodeDepth) {
+    if (!ok) wire_err("frame nesting too deep");
+  }
+  ~DepthGuard() { r.depth--; }
+};
 
 PyObject *decode_rare(Reader &r, uint8_t tag) {
   // hand (tag, whole frame, offset) to python — zero-copy; it returns
@@ -361,6 +404,8 @@ PyObject *decode_value(Reader &r) {
     case TAG_POINTER:
       return decode_key(r);
     case TAG_TUPLE: {
+      DepthGuard dg(r);
+      if (!dg.ok) return nullptr;
       uint64_t n = r.uvarint();
       // each element is >= 1 byte
       if (r.fail || n > static_cast<uint64_t>(r.end - r.p)) {
@@ -380,6 +425,8 @@ PyObject *decode_value(Reader &r) {
       return t;
     }
     case TAG_LIST: {
+      DepthGuard dg(r);
+      if (!dg.ok) return nullptr;
       uint64_t n = r.uvarint();
       if (r.fail || n > static_cast<uint64_t>(r.end - r.p)) {
         wire_err("truncated frame (list)");
@@ -398,7 +445,14 @@ PyObject *decode_value(Reader &r) {
       return t;
     }
     case TAG_DICT: {
+      DepthGuard dg(r);
+      if (!dg.ok) return nullptr;
       uint64_t n = r.uvarint();
+      // each entry is a key + value, >= 2 bytes
+      if (r.fail || n > static_cast<uint64_t>(r.end - r.p) / 2) {
+        wire_err("truncated frame (dict)");
+        return nullptr;
+      }
       PyObject *d = PyDict_New();
       if (!d) return nullptr;
       for (uint64_t i = 0; i < n; i++) {
@@ -430,6 +484,8 @@ PyObject *decode_value(Reader &r) {
       return d;
     }
     case TAG_JSON: {
+      DepthGuard dg(r);
+      if (!dg.ok) return nullptr;
       PyObject *inner = decode_value(r);
       if (!inner) return nullptr;
       PyObject *j =
@@ -522,7 +578,10 @@ PyObject *decode_deltas(Reader &r) {
     }
     int64_t diff = r.zigzag();
     uint64_t ncols = r.uvarint();
-    if (r.fail) {
+    // each value is >= 1 byte: bound the tuple allocation by the bytes
+    // actually present (a lying ncols would otherwise drive a huge
+    // PyTuple_New)
+    if (r.fail || ncols > static_cast<uint64_t>(r.end - r.p)) {
       wire_err("truncated frame (delta header)");
       Py_DECREF(key);
       Py_DECREF(out);
@@ -710,8 +769,15 @@ PyObject *py_decode_message(PyObject *, PyObject *arg) {
       wire_err("truncated frame (run id)");
       return nullptr;
     }
-    msg = Py_BuildValue("(sIs#)", "hello", (unsigned int)worker,
-                        (const char *)rid, (Py_ssize_t)len);
+    PyObject *rid_str = PyUnicode_DecodeUTF8(
+        reinterpret_cast<const char *>(rid), static_cast<Py_ssize_t>(len),
+        nullptr);
+    if (!rid_str) {
+      PyErr_Clear();
+      wire_err("bad run id (invalid utf-8)");
+      return nullptr;
+    }
+    msg = Py_BuildValue("(sIN)", "hello", (unsigned int)worker, rid_str);
   } else {
     wire_err("unknown message type");
     return nullptr;
@@ -735,15 +801,27 @@ PyObject *py_consolidate(PyObject *, PyObject *arg) {
     return nullptr;
   }
   Py_ssize_t n = PyList_GET_SIZE(arg);
-  // fast path: all-insert batches with distinct keys pass through
+  // validate shape up front: every element must be a (key, values, diff)
+  // 3-tuple with an in-range int diff, so the loops below may use the
+  // unchecked GET_ITEM / conversion paths safely. The same pass records
+  // whether the batch is all-insert (the bulk-ingest fast-path test).
   bool all_insert = true;
   for (Py_ssize_t i = 0; i < n; i++) {
     PyObject *d = PyList_GET_ITEM(arg, i);
-    PyObject *diff = PyTuple_GET_ITEM(d, 2);
-    if (PyLong_AsLongLong(diff) < 0) {
-      all_insert = false;
-      break;
+    if (!PyTuple_CheckExact(d) || PyTuple_GET_SIZE(d) != 3) {
+      PyErr_SetString(PyExc_TypeError,
+                      "consolidate expects (key, values, diff) 3-tuples");
+      return nullptr;
     }
+    int overflow = 0;
+    long long dv = PyLong_AsLongLongAndOverflow(PyTuple_GET_ITEM(d, 2),
+                                                &overflow);
+    if (dv == -1 && PyErr_Occurred()) return nullptr;  // non-int diff
+    if (overflow) {
+      PyErr_SetString(PyExc_TypeError, "consolidate diff out of i64 range");
+      return nullptr;
+    }
+    if (dv < 0) all_insert = false;
   }
   if (all_insert) {
     PyObject *seen = PySet_New(nullptr);
@@ -793,7 +871,14 @@ PyObject *py_consolidate(PyObject *, PyObject *arg) {
       return nullptr;
     }
     long long sum = PyLong_AsLongLong(PyTuple_GET_ITEM(d, 2));
-    if (prev) sum += PyLong_AsLongLong(prev);
+    if (prev && __builtin_add_overflow(sum, PyLong_AsLongLong(prev), &sum)) {
+      // i64 sum overflow: hand the batch to the caller's arbitrary-
+      // precision python fallback rather than wrapping silently
+      PyErr_SetString(PyExc_TypeError, "consolidate diff sum overflows i64");
+      Py_DECREF(g);
+      Py_DECREF(acc);
+      return nullptr;
+    }
     PyObject *sum_obj = PyLong_FromLongLong(sum);
     if (!sum_obj || PyDict_SetItem(acc, g, sum_obj) < 0) {
       Py_XDECREF(sum_obj);

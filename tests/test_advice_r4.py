@@ -1,0 +1,298 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md r4):
+
+1. wire.py restricted-unpickler getattr shim: must not hand a crafted
+   T_PICKLE payload dangerous callables (ndarray.tofile → arbitrary file
+   write) — only the ZoneInfo._unpickle hook is legitimate.
+2. decoder recursion: deeply nested container frames must raise WireError
+   in both the Python and C++ decoders, never RecursionError / segfault.
+3. wire_ext delta/dict count lies must not drive huge allocations.
+4. py_consolidate must reject malformed delta lists and handle genuine
+   negative diffs without leaving a live exception.
+5. WindowFunctionNode SUM/MIN/MAX over ints >= 2**53 must stay exact
+   (no float64 round-trip).
+"""
+
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+from pathway_tpu import native
+from pathway_tpu.engine import wire
+from pathway_tpu.engine.value import Pointer
+
+
+def _coord_frame(payload: bytes) -> bytes:
+    return bytes([wire.MSG_COORD]) + struct.pack("<Q", 1) + payload
+
+
+def _pickle_frame(raw: bytes) -> bytes:
+    out = bytearray([wire.T_PICKLE])
+    wire._uvarint(out, len(raw))
+    out += raw
+    return _coord_frame(bytes(out))
+
+
+def _decoders():
+    decs = [("py", wire.py_decode_message)]
+    ext = native.load_wire_ext()
+    if ext is not None:
+        decs.append(("native", ext.decode_message))
+    return decs
+
+
+class _GetattrBomb:
+    """Reduce payload reaching for ndarray.tofile through builtins.getattr
+    — the r4 advisor's arbitrary-file-write escape."""
+
+    def __reduce__(self):
+        return (getattr, (np.ndarray, "tofile"))
+
+
+class _UnderscoreBomb:
+    def __reduce__(self):
+        return (getattr, (np.ndarray, "__subclasses__"))
+
+
+@pytest.mark.parametrize("bomb", [_GetattrBomb, _UnderscoreBomb])
+def test_pickle_getattr_escape_denied(bomb):
+    frame = _pickle_frame(pickle.dumps(bomb()))
+    for name, dec in _decoders():
+        with pytest.raises(wire.WireError):
+            dec(frame)
+
+
+def test_zoneinfo_unpickle_hook_still_allowed():
+    import datetime as dt
+    import zoneinfo
+
+    v = dt.datetime(2024, 5, 1, 12, tzinfo=zoneinfo.ZoneInfo("Europe/Paris"))
+    msg = ("coord", 1, v)
+    for _name, dec in _decoders():
+        assert dec(wire.encode_message(msg)) == msg
+
+
+@pytest.mark.parametrize("tag", [wire.T_TUPLE, wire.T_LIST, wire.T_JSON])
+def test_deep_nesting_is_wire_error_not_crash(tag):
+    # 4000 nested single-element container headers: ~8 KB frame that
+    # would drive ~4000-deep decode recursion without the depth cap
+    frame = _coord_frame(bytes([tag, 1]) * 4000 + bytes([wire.T_NONE]))
+    for name, dec in _decoders():
+        with pytest.raises((wire.WireError, ValueError)):
+            dec(frame)
+
+
+def test_deep_dict_nesting_is_wire_error():
+    body = bytearray()
+    for _ in range(4000):
+        body += bytes([wire.T_DICT, 1, wire.T_NONE])  # {None: {None: ...
+    body += bytes([wire.T_NONE])
+    frame = _coord_frame(bytes(body))
+    for name, dec in _decoders():
+        with pytest.raises((wire.WireError, ValueError)):
+            dec(frame)
+
+
+def test_legitimate_nesting_under_cap_round_trips():
+    v = None
+    for _ in range(wire.MAX_DECODE_DEPTH - 8):
+        v = (v,)
+    msg = ("coord", 2, v)
+    for _name, dec in _decoders():
+        assert dec(wire.encode_message(msg)) == msg
+    # the cap resets between sibling values: a WIDE tuple of nested
+    # values must not trip it
+    sib = ("coord", 3, tuple((i, (i,)) for i in range(200)))
+    for _name, dec in _decoders():
+        assert dec(wire.encode_message(sib)) == sib
+
+
+def test_delta_ncols_lie_is_wire_error():
+    # data frame: channel, time, n=1 delta, key, diff, ncols=2**40, no data
+    body = bytearray([wire.MSG_DATA])
+    body += struct.pack("<I", 0)
+    wire._zigzag(body, 0)
+    wire._uvarint(body, 1)  # one delta
+    body += (123).to_bytes(16, "little")
+    wire._zigzag(body, 1)  # diff
+    wire._uvarint(body, 1 << 40)  # lying ncols
+    for name, dec in _decoders():
+        with pytest.raises((wire.WireError, ValueError)):
+            dec(bytes(body))
+
+
+def test_dict_count_lie_is_wire_error():
+    body = bytearray([wire.T_DICT])
+    wire._uvarint(body, 1 << 40)  # lying entry count, no entries
+    frame = _coord_frame(bytes(body))
+    for name, dec in _decoders():
+        with pytest.raises((wire.WireError, ValueError)):
+            dec(frame)
+
+
+def test_uvarint_strict_u64_parity():
+    """A >64-bit varint must be rejected by BOTH decoders — the python
+    side previously accepted up to 140 bits, silently diverging from the
+    native decoder's truncation."""
+    # T_INT with an 11-byte varint
+    frame = _coord_frame(bytes([wire.T_INT]) + b"\x80" * 10 + b"\x01")
+    # T_INT with a 10-byte varint whose last byte has payload bits > bit 0
+    frame2 = _coord_frame(bytes([wire.T_INT]) + b"\xff" * 9 + b"\x7f")
+    for name, dec in _decoders():
+        for f in (frame, frame2):
+            with pytest.raises((wire.WireError, ValueError)):
+                dec(f)
+    # the full i64 range still round-trips (zigzag of INT64_MIN is the
+    # 10-byte varint 2**64-1)
+    msg = ("coord", 1, (-(2**63), 2**63 - 1, -1, 0))
+    for _name, dec in _decoders():
+        assert dec(wire.encode_message(msg)) == msg
+
+
+def test_consolidate_rejects_malformed_and_handles_negative_diffs():
+    ext = native.load_wire_ext()
+    if ext is None:
+        pytest.skip("native toolchain unavailable")
+    # malformed shapes raise TypeError (the caller's fallback signal)
+    for bad in (
+        [("not a 3-tuple",)],
+        [(Pointer(1), ("v",), "diff")],
+        [(Pointer(1), ("v",), 2**70)],
+        [[Pointer(1), ("v",), 1]],
+    ):
+        with pytest.raises(TypeError):
+            ext.consolidate(bad)
+    # a genuine -1 diff is data, not an error sentinel
+    deltas = [
+        (Pointer(1), ("a",), -1),
+        (Pointer(1), ("a",), 1),
+        (Pointer(2), ("b",), -1),
+        (Pointer(3), ("c",), 2),
+    ]
+    out = ext.consolidate(deltas)
+    as_set = {(k.value, v, d) for k, v, d in out}
+    assert as_set == {(2, ("b",), -1), (3, ("c",), 2)}
+    # retractions come before insertions
+    assert [d for _k, _v, d in out] == sorted(
+        (d for _k, _v, d in out), key=lambda x: x >= 0
+    )
+
+
+def _sql_rows(table):
+    from pathway_tpu.internals.runner import run_tables
+
+    (cap,) = run_tables(table)
+    return sorted(cap.state.rows.values())
+
+
+def test_window_sum_min_max_exact_big_ints():
+    """SQL window SUM/MIN/MAX must agree with exact GROUP BY arithmetic
+    for ints >= 2**53 (advisor: float64 routing silently rounded them)."""
+    import pathway_tpu as pw
+
+    big = 2**60 + 1  # not representable in float64
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(g=str, v=int),
+        [("a", big), ("a", big + 2), ("b", 7)],
+    )
+    r = pw.sql(
+        "SELECT g, v, "
+        "SUM(v) OVER (PARTITION BY g) AS s, "
+        "MIN(v) OVER (PARTITION BY g) AS lo, "
+        "MAX(v) OVER (PARTITION BY g) AS hi "
+        "FROM t",
+        t=t,
+    )
+    rows = {(g, v): (s, lo, hi) for g, v, s, lo, hi in _sql_rows(r)}
+    assert rows[("a", big)] == (2 * big + 2, big, big + 2)
+    assert rows[("a", big + 2)] == (2 * big + 2, big, big + 2)
+    assert rows[("b", 7)] == (7, 7, 7)
+    # every value is an exact int, not a float
+    for s, lo, hi in rows.values():
+        assert isinstance(s, int) and isinstance(lo, int)
+        assert isinstance(hi, int)
+
+
+def test_window_running_sum_exact_big_ints():
+    import pathway_tpu as pw
+
+    big = 2**60 + 1
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(g=str, o=int, v=int),
+        [("a", 1, big), ("a", 2, big + 2), ("a", 3, -1)],
+    )
+    r = pw.sql(
+        "SELECT o, SUM(v) OVER (PARTITION BY g ORDER BY o) AS s FROM t",
+        t=t,
+    )
+    rows = dict(_sql_rows(r))
+    assert rows == {1: big, 2: 2 * big + 2, 3: 2 * big + 1}
+
+
+def test_hello_bad_utf8_run_id_is_wire_error():
+    body = bytearray([wire.MSG_HELLO])
+    body += struct.pack("<I", 5)
+    wire._uvarint(body, 2)
+    body += b"\xff\xfe"  # invalid utf-8 run id
+    for name, dec in _decoders():
+        with pytest.raises((wire.WireError, ValueError)) as ei:
+            dec(bytes(body))
+        assert not isinstance(ei.value, UnicodeDecodeError), name
+
+
+def test_consolidate_i64_sum_overflow_falls_back():
+    ext = native.load_wire_ext()
+    if ext is None:
+        pytest.skip("native toolchain unavailable")
+    big = 2**62
+    deltas = [(Pointer(1), ("v",), big), (Pointer(1), ("v",), big)]
+    with pytest.raises(TypeError):
+        ext.consolidate(deltas)
+    # the public consolidate path falls back to exact python arithmetic
+    from pathway_tpu.engine.stream import consolidate
+
+    assert consolidate(deltas) == [(Pointer(1), ("v",), 2 * big)]
+
+
+def test_over_deep_value_fails_at_encode_both_codecs():
+    encoders = [("py", wire.py_encode_message)]
+    ext = native.load_wire_ext()
+    if ext is not None:
+        encoders.append(("native", ext.encode_message))
+    deep = [None]
+    for _ in range(wire.MAX_DECODE_DEPTH + 50):
+        deep = [deep]
+    # empty innermost container: encoders must count container ENTRY, not
+    # leaf calls, or this 129-deep value splits encoder from decoder
+    empty_past_cap = []
+    for _ in range(wire.MAX_DECODE_DEPTH):
+        empty_past_cap = [empty_past_cap]
+    for name, enc in encoders:
+        for v in (deep, empty_past_cap):
+            with pytest.raises((wire.WireError, ValueError)):
+                enc(("coord", 1, v))
+    # exactly AT the cap: encodes and decodes everywhere
+    at_cap = []
+    for _ in range(wire.MAX_DECODE_DEPTH - 1):
+        at_cap = [at_cap]
+    msg = ("coord", 1, at_cap)
+    for _name, dec in _decoders():
+        assert dec(wire.encode_message(msg)) == msg
+    if ext is not None:
+        assert wire.py_encode_message(msg) == ext.encode_message(msg)
+
+
+def test_recursion_error_converts_to_wire_error():
+    # even if a decoder somehow recursed past the cap, the message-level
+    # entry points must convert RecursionError to WireError
+    import pathway_tpu.engine.wire as w
+
+    orig = w.MAX_DECODE_DEPTH
+    frame = _coord_frame(bytes([wire.T_TUPLE, 1]) * 50_000 + bytes([wire.T_NONE]))
+    try:
+        w.MAX_DECODE_DEPTH = 10**9  # disable the cap for the python path
+        with pytest.raises(wire.WireError):
+            w.py_decode_message(frame)
+    finally:
+        w.MAX_DECODE_DEPTH = orig
