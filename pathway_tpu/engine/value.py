@@ -5,6 +5,19 @@ src/engine/value.rs:41-231). Keys are 128-bit hashes (blake2b-derived, the
 stdlib equivalent of the reference's xxh3-128) so row identity is stable across
 workers and restarts; the low SHARD_BITS bits select the data-parallel shard —
 on TPU the shard maps to a mesh device / host worker.
+
+`pw.Json` wraps arbitrary JSON values; expressions index into it and
+extract typed scalars:
+
+>>> import pathway_tpu as pw
+>>> t = pw.debug.table_from_rows(
+...     pw.schema_from_types(data=pw.Json),
+...     [(pw.Json({"k": [1, 2]}),)],
+... )
+>>> r = t.select(n=pw.this.data["k"][0].as_int())
+>>> pw.debug.compute_and_print(r, include_id=False)
+n
+1
 """
 
 from __future__ import annotations

@@ -33,7 +33,18 @@ def _infer_return_type(fun: Callable) -> Any:
 
 
 def apply(fun: Callable, *args, **kwargs) -> ColumnExpression:
-    """Apply a python function rowwise (reference: pw.apply)."""
+    """Apply a python function rowwise (reference: pw.apply).
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... a
+    ... 2
+    ... ''')
+    >>> r = t.select(sq=pw.apply(lambda x: x * x, pw.this.a))
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    sq
+    4
+    """
     return ApplyExpression(fun, _infer_return_type(fun), *args, **kwargs)
 
 
@@ -62,10 +73,38 @@ def declare_type(target_type, col) -> ColumnExpression:
 
 
 def if_else(if_clause, then_clause, else_clause) -> ColumnExpression:
+    """Ternary expression (reference: pw.if_else).
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... a
+    ... 1
+    ... 5
+    ... ''')
+    >>> r = t.select(kind=pw.if_else(pw.this.a > 3, "big", "small"))
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    kind
+    small
+    big
+    """
     return IfElseExpression(if_clause, then_clause, else_clause)
 
 
 def coalesce(*args) -> ColumnExpression:
+    """First non-None argument (reference: pw.coalesce).
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... a | b
+    ... 1 |
+    ...   | 2
+    ... ''')
+    >>> r = t.select(v=pw.coalesce(pw.this.a, pw.this.b))
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    v
+    1
+    2
+    """
     return CoalesceExpression(*args)
 
 
@@ -74,6 +113,17 @@ def require(val, *deps) -> ColumnExpression:
 
 
 def unwrap(col) -> ColumnExpression:
+    """Strip Optional from a column's type, asserting no Nones at runtime
+    (reference: pw.unwrap).
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... a
+    ... 1
+    ... ''')
+    >>> t.select(v=pw.unwrap(pw.this.a)).typehints()["v"]
+    <class 'int'>
+    """
     return UnwrapExpression(col)
 
 
@@ -82,6 +132,18 @@ def fill_error(col, replacement) -> ColumnExpression:
 
 
 def make_tuple(*args) -> ColumnExpression:
+    """Pack expressions into a tuple column (reference: pw.make_tuple).
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... a | b
+    ... 1 | 2
+    ... ''')
+    >>> r = t.select(pair=pw.make_tuple(pw.this.a, pw.this.b))
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    pair
+    (1, 2)
+    """
     return MakeTupleExpression(*args)
 
 

@@ -1,5 +1,11 @@
 """Data type lattice for the Table DSL.
 
+>>> from pathway_tpu.internals import dtype as dt
+>>> dt.wrap(int)
+int
+>>> dt.types_lca(dt.INT, dt.FLOAT)
+float
+
 TPU-native rebuild of the reference's dtype system (reference:
 python/pathway/internals/dtype.py, src/engine/value.rs:510). Types map 1:1 onto
 engine value representations; numeric columns additionally carry a numpy/JAX

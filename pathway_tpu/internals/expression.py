@@ -1,5 +1,16 @@
 """Column expression trees.
 
+>>> import pathway_tpu as pw
+>>> t = pw.debug.table_from_markdown('''
+... a
+... 5
+... ''')
+>>> pw.debug.compute_and_print(
+...     t.select(b=(pw.this.a * 2 + 1) % 4), include_id=False
+... )
+b
+3
+
 TPU-native rebuild of the reference expression DSL (reference:
 python/pathway/internals/expression.py, src/engine/expression.rs). Expressions
 are built lazily from column references and constants; the engine compiles

@@ -4,6 +4,17 @@ TPU-native rebuild of the reference datetime expression surface (reference:
 python/pathway/internals/expressions/date_time.py, src/engine/time.rs).
 Naive and UTC datetimes are python `datetime.datetime` (tz-aware for UTC);
 durations are `datetime.timedelta`.
+
+>>> import pathway_tpu as pw
+>>> t = pw.debug.table_from_markdown('''
+... s
+... 2024-05-01T12:30:00
+... ''')
+>>> stamped = t.select(ts=pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S"))
+>>> r = stamped.select(y=pw.this.ts.dt.year(), h=pw.this.ts.dt.hour())
+>>> pw.debug.compute_and_print(r, include_id=False)
+y    | h
+2024 | 12
 """
 
 from __future__ import annotations

@@ -1,5 +1,16 @@
 """`.num` numerical expression namespace (reference:
-python/pathway/internals/expressions/numerical.py)."""
+python/pathway/internals/expressions/numerical.py).
+
+>>> import pathway_tpu as pw
+>>> t = pw.debug.table_from_markdown('''
+... x
+... -2.5
+... ''')
+>>> r = t.select(a=pw.this.x.num.abs(), c=pw.this.x.num.ceil())
+>>> pw.debug.compute_and_print(r, include_id=False)
+a   | c
+2.5 | -2
+"""
 
 from __future__ import annotations
 

@@ -1,5 +1,27 @@
 """`.str` string expression namespace (reference:
-python/pathway/internals/expressions/string.py)."""
+python/pathway/internals/expressions/string.py).
+
+>>> import pathway_tpu as pw
+>>> t = pw.debug.table_from_markdown('''
+... s
+... Hello
+... ''')
+>>> r = t.select(up=pw.this.s.str.upper(), n=pw.this.s.str.len())
+>>> pw.debug.compute_and_print(r, include_id=False)
+up    | n
+HELLO | 5
+
+Parsing helpers return typed columns:
+
+>>> t2 = pw.debug.table_from_markdown('''
+... s
+... 12
+... ''')
+>>> r2 = t2.select(v=t2.s.str.parse_int() + 1)
+>>> pw.debug.compute_and_print(r2, include_id=False)
+v
+13
+"""
 
 from __future__ import annotations
 

@@ -1,5 +1,21 @@
 """groupby().reduce() desugaring (reference:
-python/pathway/internals/groupbys.py)."""
+python/pathway/internals/groupbys.py).
+
+>>> import pathway_tpu as pw
+>>> t = pw.debug.table_from_markdown('''
+... g | h | v
+... a | x | 1
+... a | y | 2
+... a | x | 3
+... ''')
+>>> r = t.groupby(pw.this.g, pw.this.h).reduce(
+...     pw.this.g, pw.this.h, s=pw.reducers.sum(pw.this.v)
+... )
+>>> pw.debug.compute_and_print(r, include_id=False)
+g | h | s
+a | y | 2
+a | x | 4
+"""
 
 from __future__ import annotations
 

@@ -1,6 +1,21 @@
 """pw.iterate — fixed-point iteration (reference:
 src/engine/dataflow/complex_columns.rs:493, Graph::iterate graph.rs:895).
 
+>>> import pathway_tpu as pw
+>>> t = pw.debug.table_from_markdown('''
+... v
+... 1
+... 16
+... ''')
+>>> def halve_big(tbl):
+...     return tbl.select(
+...         v=pw.if_else(pw.this.v > 2, pw.this.v // 2, pw.this.v)
+...     )
+>>> pw.debug.compute_and_print(pw.iterate(halve_big, tbl=t), include_id=False)
+v
+1
+2
+
 The body is re-executed as a nested batch dataflow per iteration until the
 outputs stop changing. Each engine time recomputes the fixpoint from the
 current input snapshot, so streaming updates re-converge incrementally at the

@@ -1,5 +1,24 @@
 """Join desugaring (reference: python/pathway/internals/joins.py,
-src/engine/dataflow.rs join_tables:2691)."""
+src/engine/dataflow.rs join_tables:2691).
+
+`pw.left` / `pw.right` disambiguate columns present on both sides:
+
+>>> import pathway_tpu as pw
+>>> orders = pw.debug.table_from_markdown('''
+... item | qty
+... pen  | 2
+... ''')
+>>> prices = pw.debug.table_from_markdown('''
+... item | price
+... pen  | 3
+... ''')
+>>> r = orders.join(prices, pw.left.item == pw.right.item).select(
+...     pw.left.item, cost=pw.left.qty * pw.right.price
+... )
+>>> pw.debug.compute_and_print(r, include_id=False)
+item | cost
+pen  | 6
+"""
 
 from __future__ import annotations
 

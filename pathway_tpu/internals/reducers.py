@@ -826,6 +826,56 @@ class _ReducersNamespace:
     g | total | n | lo | hi | distinct
     b | 5     | 1 | 5  | 5  | 1
     a | 4     | 2 | 1  | 3  | 2
+
+    ``argmin``/``argmax`` return the row's pointer, resolvable with
+    ``Table.ix``:
+
+    >>> t2 = pw.debug.table_from_markdown('''
+    ... g | k | v
+    ... a | p | 1
+    ... a | q | 9
+    ... ''')
+    >>> r2 = t2.groupby(pw.this.g).reduce(
+    ...     pw.this.g, best=pw.reducers.argmax(pw.this.v, pw.this.k)
+    ... )
+    >>> pw.debug.compute_and_print(
+    ...     r2.select(pw.this.g, name=t2.ix(r2.best).k), include_id=False
+    ... )
+    g | name
+    a | q
+
+    ``avg`` divides exactly; ``sorted_tuple``/``tuple`` collect values;
+    ``unique`` asserts one distinct value per group:
+
+    >>> t3 = pw.debug.table_from_markdown('''
+    ... g | v
+    ... a | 2
+    ... a | 1
+    ... ''')
+    >>> r3 = t3.groupby(pw.this.g).reduce(
+    ...     pw.this.g,
+    ...     mean=pw.reducers.avg(pw.this.v),
+    ...     vs=pw.reducers.sorted_tuple(pw.this.v),
+    ... )
+    >>> pw.debug.compute_and_print(r3, include_id=False)
+    g | mean | vs
+    a | 1.5  | (1, 2)
+
+    ``earliest``/``latest`` follow engine time (``__time__``):
+
+    >>> t4 = pw.debug.table_from_markdown('''
+    ... g | v | __time__
+    ... a | 1 | 2
+    ... a | 2 | 4
+    ... ''')
+    >>> r4 = t4.groupby(pw.this.g).reduce(
+    ...     pw.this.g,
+    ...     first=pw.reducers.earliest(pw.this.v),
+    ...     last=pw.reducers.latest(pw.this.v),
+    ... )
+    >>> pw.debug.compute_and_print(r4, include_id=False)
+    g | first | last
+    a | 1     | 2
     """
 
     count = staticmethod(count)

@@ -1,5 +1,12 @@
 """Schema: typed column layout of a Table.
 
+>>> import pathway_tpu as pw
+>>> S = pw.schema_from_types(name=str, age=int)
+>>> S.column_names()
+['name', 'age']
+>>> S.typehints()["age"]
+<class 'int'>
+
 TPU-native rebuild of the reference schema system (reference:
 python/pathway/internals/schema.py). Schemas are declared with class syntax::
 

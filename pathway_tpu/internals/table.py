@@ -153,7 +153,20 @@ class Table:
 
     # -- core transformations --------------------------------------------
     def select(self, *args, **kwargs) -> "Table":
-        """Project/compute columns (reference: table.py select)."""
+        """Project/compute columns (reference: table.py select).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... a | b
+        ... 3 | 4
+        ... 5 | 6
+        ... ''')
+        >>> r = t.select(pw.this.a, total=pw.this.a + pw.this.b)
+        >>> pw.debug.compute_and_print(r, include_id=False)
+        a | total
+        3 | 7
+        5 | 11
+        """
         mapping = self._mapping()
         cols = expand_select_args(args, self, mapping)
         for name, e in kwargs.items():
@@ -170,7 +183,20 @@ class Table:
         return Table(schema=schema, universe=self._universe, build=build)
 
     def filter(self, filter_expression) -> "Table":
-        """Subset rows (reference: table.py filter)."""
+        """Subset rows (reference: table.py filter).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... a
+        ... 1
+        ... 2
+        ... 3
+        ... ''')
+        >>> pw.debug.compute_and_print(t.filter(pw.this.a > 1), include_id=False)
+        a
+        3
+        2
+        """
         expr = desugar(filter_expression, self._mapping())
         self_ = self
 
@@ -186,6 +212,21 @@ class Table:
         )
 
     def split(self, split_expression) -> tuple["Table", "Table"]:
+        """Two disjoint tables: rows satisfying the predicate and the rest
+        (reference: table.py split).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... a
+        ... 1
+        ... 2
+        ... 3
+        ... ''')
+        >>> pos, neg = t.split(pw.this.a > 1)
+        >>> pw.debug.compute_and_print(neg, include_id=False)
+        a
+        1
+        """
         pos = self.filter(split_expression)
         from pathway_tpu.internals.expression import UnaryOpExpression
 
@@ -193,6 +234,20 @@ class Table:
         return pos, neg
 
     def with_columns(self, *args, **kwargs) -> "Table":
+        """All existing columns plus the given ones (reference: table.py
+        with_columns).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... a | b
+        ... 1 | 2
+        ... ''')
+        >>> pw.debug.compute_and_print(
+        ...     t.with_columns(c=pw.this.a * 10), include_id=False
+        ... )
+        a | b | c
+        1 | 2 | 10
+        """
         mapping = self._mapping()
         cols: Dict[str, ColumnExpression] = {
             name: self[name] for name in self.column_names()
@@ -203,6 +258,17 @@ class Table:
         return self._select_impl(cols)
 
     def without(self, *columns) -> "Table":
+        """Drop the given columns (reference: table.py without).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... a | b | c
+        ... 1 | 2 | 3
+        ... ''')
+        >>> pw.debug.compute_and_print(t.without(pw.this.b), include_id=False)
+        a | c
+        1 | 3
+        """
         drop = {c if isinstance(c, str) else c.name for c in columns}
         cols = {
             name: self[name] for name in self.column_names() if name not in drop
@@ -210,7 +276,19 @@ class Table:
         return self._select_impl(cols)
 
     def rename_columns(self, **kwargs) -> "Table":
-        """rename_columns(new_name=pw.this.old) (reference: table.py)."""
+        """rename_columns(new_name=pw.this.old) (reference: table.py).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... a | b
+        ... 1 | 2
+        ... ''')
+        >>> pw.debug.compute_and_print(
+        ...     t.rename_columns(x=pw.this.a, y=pw.this.b), include_id=False
+        ... )
+        x | y
+        1 | 2
+        """
         renames: Dict[str, str] = {}
         for new, old in kwargs.items():
             old_name = old if isinstance(old, str) else old.name
@@ -218,6 +296,20 @@ class Table:
         return self._rename_impl(renames)
 
     def rename_by_dict(self, names_mapping: Mapping) -> "Table":
+        """Rename columns by an old→new mapping (reference: table.py
+        rename_by_dict).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... a | b
+        ... 1 | 2
+        ... ''')
+        >>> pw.debug.compute_and_print(
+        ...     t.rename_by_dict({"a": "x"}), include_id=False
+        ... )
+        x | b
+        1 | 2
+        """
         renames = {
             (k if isinstance(k, str) else k.name): v
             for k, v in names_mapping.items()
@@ -255,6 +347,17 @@ class Table:
 
     # -- typing -----------------------------------------------------------
     def cast_to_types(self, **kwargs) -> "Table":
+        """Cast columns to the given types (reference: table.py
+        cast_to_types).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... a
+        ... 1
+        ... ''')
+        >>> t.cast_to_types(a=float).typehints()["a"]
+        <class 'float'>
+        """
         cols: Dict[str, ColumnExpression] = {
             name: self[name] for name in self.column_names()
         }
@@ -280,6 +383,21 @@ class Table:
         )
 
     def with_id_from(self, *args, instance=None) -> "Table":
+        """Re-key rows by a pointer computed from the given expressions
+        (reference: table.py with_id_from).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... k | v
+        ... a | 1
+        ... b | 2
+        ... ''')
+        >>> r = t.with_id_from(pw.this.k)
+        >>> pw.debug.compute_and_print(r.select(pw.this.v), include_id=False)
+        v
+        2
+        1
+        """
         expr = PointerExpression(
             self,
             *(desugar(a, self._mapping()) for a in args),
@@ -318,6 +436,24 @@ class Table:
         _filter_out_results_of_forgetting: bool = False,
         **kwargs,
     ):
+        """Group rows; call ``.reduce`` on the result (reference: table.py
+        groupby).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... g | v
+        ... a | 1
+        ... a | 2
+        ... b | 3
+        ... ''')
+        >>> r = t.groupby(pw.this.g).reduce(
+        ...     pw.this.g, total=pw.reducers.sum(pw.this.v)
+        ... )
+        >>> pw.debug.compute_and_print(r, include_id=False)
+        g | total
+        b | 3
+        a | 3
+        """
         from pathway_tpu.internals.groupbys import GroupedTable
 
         mapping = self._mapping()
@@ -343,7 +479,22 @@ class Table:
         persistent_id: str | None = None,
     ) -> "Table":
         """Keep the latest accepted row per instance (reference: table.py
-        deduplicate / Graph::deduplicate)."""
+        deduplicate / Graph::deduplicate).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... v | __time__
+        ... 1 | 2
+        ... 2 | 4
+        ... 1 | 6
+        ... ''')
+        >>> r = t.deduplicate(
+        ...     value=pw.this.v, acceptor=lambda new, old: new != old
+        ... )
+        >>> pw.debug.compute_and_print(r, include_id=False)
+        v
+        1
+        """
         mapping = self._mapping()
         value_expr = (
             desugar(value, mapping) if value is not None else IdReference(self)
@@ -376,6 +527,27 @@ class Table:
 
     # -- joins ------------------------------------------------------------
     def join(self, other: "Table", *on, id=None, how=None, **kwargs):
+        """Join with another table; ``.select`` on the result picks output
+        columns (reference: table.py join).
+
+        >>> import pathway_tpu as pw
+        >>> left = pw.debug.table_from_markdown('''
+        ... k | a
+        ... 1 | x
+        ... 2 | y
+        ... ''')
+        >>> right = pw.debug.table_from_markdown('''
+        ... k | b
+        ... 2 | u
+        ... 3 | w
+        ... ''')
+        >>> r = left.join(right, left.k == right.k).select(
+        ...     left.k, left.a, right.b
+        ... )
+        >>> pw.debug.compute_and_print(r, include_id=False)
+        k | a | b
+        2 | y | u
+        """
         from pathway_tpu.internals.joins import JoinMode, JoinResult
 
         if how is None:
@@ -390,6 +562,27 @@ class Table:
         return JoinResult(self, other, on, id_expr=id, mode=JoinMode.INNER)
 
     def join_left(self, other: "Table", *on, id=None, **kwargs):
+        """Left join: unmatched left rows keep ``None`` right columns
+        (reference: table.py join_left).
+
+        >>> import pathway_tpu as pw
+        >>> left = pw.debug.table_from_markdown('''
+        ... k | a
+        ... 1 | x
+        ... 2 | y
+        ... ''')
+        >>> right = pw.debug.table_from_markdown('''
+        ... k | b
+        ... 2 | u
+        ... ''')
+        >>> r = left.join_left(right, left.k == right.k).select(
+        ...     left.k, right.b
+        ... )
+        >>> pw.debug.compute_and_print(r, include_id=False)
+        k | b
+        2 | u
+        1 | None
+        """
         from pathway_tpu.internals.joins import JoinMode, JoinResult
 
         return JoinResult(self, other, on, id_expr=id, mode=JoinMode.LEFT)
@@ -406,20 +599,92 @@ class Table:
 
     # -- universe algebra -------------------------------------------------
     def intersect(self, *tables: "Table") -> "Table":
+        """Rows whose keys appear in every argument (reference: table.py
+        intersect).
+
+        >>> import pathway_tpu as pw
+        >>> t1 = pw.debug.table_from_markdown('''
+        ... id | v
+        ... 1  | 10
+        ... 2  | 20
+        ... ''')
+        >>> t2 = pw.debug.table_from_markdown('''
+        ... id | w
+        ... 2  | 200
+        ... 3  | 300
+        ... ''')
+        >>> pw.debug.compute_and_print(t1.intersect(t2), include_id=False)
+        v
+        20
+        """
         out = self
         for other in tables:
             out = _semijoin(out, other, keep_present=True)
         return out
 
     def difference(self, other: "Table") -> "Table":
+        """Rows whose keys do NOT appear in ``other`` (reference: table.py
+        difference).
+
+        >>> import pathway_tpu as pw
+        >>> t1 = pw.debug.table_from_markdown('''
+        ... id | v
+        ... 1  | 10
+        ... 2  | 20
+        ... ''')
+        >>> t2 = pw.debug.table_from_markdown('''
+        ... id | w
+        ... 2  | 200
+        ... ''')
+        >>> pw.debug.compute_and_print(t1.difference(t2), include_id=False)
+        v
+        10
+        """
         return _semijoin(self, other, keep_present=False)
 
     def restrict(self, other: "Table") -> "Table":
+        """Like ``intersect`` but promises ``other``'s universe is a
+        subset, so the result keeps it (reference: table.py restrict).
+
+        >>> import pathway_tpu as pw
+        >>> t1 = pw.debug.table_from_markdown('''
+        ... id | v
+        ... 1  | 10
+        ... 2  | 20
+        ... ''')
+        >>> t2 = pw.debug.table_from_markdown('''
+        ... id | w
+        ... 2  | 200
+        ... ''')
+        >>> pw.debug.compute_and_print(t1.restrict(t2), include_id=False)
+        v
+        20
+        """
         result = _semijoin(self, other, keep_present=True)
         solver.register_equal(result._universe, other._universe)
         return result
 
     def having(self, *indexers) -> "Table":
+        """Rows whose key appears in each indexer expression's values
+        (reference: table.py having).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... k | v
+        ... a | 1
+        ... b | 2
+        ... ''')
+        >>> keys = pw.debug.table_from_markdown('''
+        ... k
+        ... a
+        ... ''')
+        >>> r = t.with_id_from(pw.this.k).having(
+        ...     keys.with_id_from(pw.this.k).id
+        ... )
+        >>> pw.debug.compute_and_print(r.select(pw.this.v), include_id=False)
+        v
+        1
+        """
         out = self
         for indexer in indexers:
             expr = smart_wrap(indexer)
@@ -432,7 +697,25 @@ class Table:
 
     def update_rows(self, other: "Table") -> "Table":
         """Rows of `other` override/add to `self` (reference: table.py
-        update_rows, update_rows_table in graph.rs)."""
+        update_rows, update_rows_table in graph.rs).
+
+        >>> import pathway_tpu as pw
+        >>> old = pw.debug.table_from_markdown('''
+        ... id | v
+        ... 1  | 10
+        ... 2  | 20
+        ... ''')
+        >>> new = pw.debug.table_from_markdown('''
+        ... id | v
+        ... 2  | 99
+        ... 3  | 30
+        ... ''')
+        >>> pw.debug.compute_and_print(old.update_rows(new), include_id=False)
+        v
+        30
+        99
+        10
+        """
         if set(other.column_names()) != set(self.column_names()):
             raise ValueError(
                 "update_rows: schemas must have the same columns; "
@@ -463,7 +746,23 @@ class Table:
 
     def update_cells(self, other: "Table") -> "Table":
         """Override a subset of columns for keys present in `other`
-        (reference: table.py update_cells, `t << other`)."""
+        (reference: table.py update_cells, `t << other`).
+
+        >>> import pathway_tpu as pw
+        >>> old = pw.debug.table_from_markdown('''
+        ... id | a | b
+        ... 1  | 1 | x
+        ... 2  | 2 | y
+        ... ''')
+        >>> new = pw.debug.table_from_markdown('''
+        ... id | b
+        ... 1  | z
+        ... ''')
+        >>> pw.debug.compute_and_print(old.update_cells(new), include_id=False)
+        a | b
+        2 | y
+        1 | z
+        """
         extra = set(other.column_names()) - set(self.column_names())
         if extra:
             raise ValueError(f"update_cells: unknown columns {sorted(extra)}")
@@ -540,7 +839,22 @@ class Table:
 
     # -- concat / flatten / sort -----------------------------------------
     def concat(self, *others: "Table") -> "Table":
-        """Disjoint union (reference: table.py concat)."""
+        """Disjoint union (reference: table.py concat).
+
+        >>> import pathway_tpu as pw
+        >>> t1 = pw.debug.table_from_markdown('''
+        ... id | v
+        ... 1  | 10
+        ... ''')
+        >>> t2 = pw.debug.table_from_markdown('''
+        ... id | v
+        ... 2  | 20
+        ... ''')
+        >>> pw.debug.compute_and_print(t1.concat(t2), include_id=False)
+        v
+        20
+        10
+        """
         tables = [self] + [
             o.select(**{c: o[c] for c in self.column_names()}) for o in others
         ]
@@ -562,6 +876,23 @@ class Table:
         )
 
     def concat_reindex(self, *others: "Table") -> "Table":
+        """Concat tables whose keys may collide by re-keying each side
+        first (reference: table.py concat_reindex).
+
+        >>> import pathway_tpu as pw
+        >>> t1 = pw.debug.table_from_markdown('''
+        ... id | v
+        ... 1  | 10
+        ... ''')
+        >>> t2 = pw.debug.table_from_markdown('''
+        ... id | v
+        ... 1  | 20
+        ... ''')
+        >>> pw.debug.compute_and_print(t1.concat_reindex(t2), include_id=False)
+        v
+        20
+        10
+        """
         reindexed = [
             t.with_id_from(IdReference(t), i)
             for i, t in enumerate([self, *others])
@@ -570,7 +901,20 @@ class Table:
 
     def flatten(self, to_flatten: ColumnReference, *, origin_id: str | None = None) -> "Table":
         """One row per element of a sequence column (reference: table.py
-        flatten, flatten_table)."""
+        flatten, flatten_table).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_rows(
+        ...     pw.schema_from_types(k=str, vs=list),
+        ...     [("a", [1, 2]), ("b", [3])],
+        ... )
+        >>> r = t.flatten(pw.this.vs).select(pw.this.k, pw.this.vs)
+        >>> pw.debug.compute_and_print(r, include_id=False)
+        k | vs
+        a | 2
+        a | 1
+        b | 3
+        """
         ref = desugar(to_flatten, self._mapping())
         if not isinstance(ref, ColumnReference):
             raise TypeError("flatten expects a column reference")
@@ -613,7 +957,23 @@ class Table:
 
     def sort(self, key, instance=None) -> "Table":
         """prev/next pointers in key order (reference: table.py sort,
-        operators/prev_next.rs)."""
+        operators/prev_next.rs).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... v
+        ... 30
+        ... 10
+        ... 20
+        ... ''')
+        >>> s = t.sort(pw.this.v)
+        >>> r = t.select(pw.this.v, has_next=s.next.is_not_none())
+        >>> pw.debug.compute_and_print(r, include_id=False)
+        v  | has_next
+        20 | True
+        30 | False
+        10 | True
+        """
         mapping = self._mapping()
         key_expr = desugar(key, mapping)
         instance_expr = desugar(instance, mapping) if instance is not None else None
@@ -1001,7 +1361,21 @@ class Table:
     # -- lookup -----------------------------------------------------------
     def ix(self, expression, *, optional: bool = False, context=None, allow_misses: bool = False) -> "Table":
         """`target.ix(keys)` — row lookup by pointer (reference: table.py ix,
-        ix_table in graph.rs)."""
+        ix_table in graph.rs).
+
+        >>> import pathway_tpu as pw
+        >>> people = pw.debug.table_from_markdown('''
+        ... name | boss
+        ... Abe  | Abe
+        ... Bea  | Abe
+        ... ''').with_id_from(pw.this.name)
+        >>> refs = people.select(b=people.pointer_from(pw.this.boss))
+        >>> r = refs.select(boss_name=people.ix(refs.b).name)
+        >>> pw.debug.compute_and_print(r, include_id=False)
+        boss_name
+        Abe
+        Abe
+        """
         expr = smart_wrap(expression)
         src_tables = [t for t in collect_tables(expr, set()) if t is not self]
         if not src_tables:

@@ -1,6 +1,18 @@
 """`pw.this`, `pw.left`, `pw.right` deferred references (reference:
 python/pathway/internals/thisclass.py). They are placeholders resolved to a
-concrete table during desugaring (see desugaring.py)."""
+concrete table during desugaring (see desugaring.py).
+
+>>> import pathway_tpu as pw
+>>> t = pw.debug.table_from_markdown('''
+... a | b
+... 1 | 2
+... ''')
+>>> pw.debug.compute_and_print(
+...     t.select(s=pw.this.a + pw.this.b), include_id=False
+... )
+s
+3
+"""
 
 from __future__ import annotations
 

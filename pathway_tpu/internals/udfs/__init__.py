@@ -137,7 +137,22 @@ def udf(
     max_batch_size: int | None = None,
     **kwargs,
 ):
-    """Decorator turning a function into a UDF (reference: pw.udf)."""
+    """Decorator turning a function into a UDF (reference: pw.udf).
+
+    >>> import pathway_tpu as pw
+    >>> @pw.udf
+    ... def double(x: int) -> int:
+    ...     return 2 * x
+    >>> t = pw.debug.table_from_markdown('''
+    ... a
+    ... 3
+    ... ''')
+    >>> pw.debug.compute_and_print(
+    ...     t.select(d=double(pw.this.a)), include_id=False
+    ... )
+    d
+    6
+    """
     if isinstance(executor, str):
         executor = {"async": async_executor(), "sync": sync_executor()}[executor]
 

@@ -14,7 +14,29 @@ from pathway_tpu.internals.table import Table
 
 def bellman_ford(vertices: Table, edges: Table) -> Table:
     """vertices: (is_source: bool); edges: (u, v pointers, dist float).
-    Returns dist_from_source per vertex."""
+    Returns dist_from_source per vertex.
+
+    >>> import pathway_tpu as pw
+    >>> verts = pw.debug.table_from_markdown('''
+    ... name | is_source
+    ... a    | True
+    ... b    | False
+    ... ''').with_id_from(pw.this.name)
+    >>> e = pw.debug.table_from_markdown('''
+    ... us | vs | dist
+    ... a  | b  | 2.0
+    ... ''')
+    >>> E = e.select(
+    ...     u=verts.pointer_from(e.us),
+    ...     v=verts.pointer_from(e.vs),
+    ...     dist=pw.this.dist,
+    ... )
+    >>> from pathway_tpu.stdlib.graphs.bellman_ford import bellman_ford
+    >>> pw.debug.compute_and_print(bellman_ford(verts, E), include_id=False)
+    dist
+    2.0
+    0.0
+    """
 
     base = vertices.select(
         dist=pw_api.if_else(vertices.is_source, 0.0, math.inf)

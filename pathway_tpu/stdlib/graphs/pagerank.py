@@ -14,7 +14,23 @@ from pathway_tpu.internals.table import Table
 
 
 def pagerank(edges: Table, steps: int = 5, damping: int = 85) -> Table:
-    """Iterative PageRank over an edge table with columns u, v."""
+    """Iterative PageRank over an edge table with columns u, v.
+
+    >>> import pathway_tpu as pw
+    >>> edges = pw.debug.table_from_markdown('''
+    ... a | b
+    ... x | y
+    ... y | z
+    ... z | y
+    ... ''')
+    >>> E = edges.select(
+    ...     u=edges.pointer_from(pw.this.a), v=edges.pointer_from(pw.this.b)
+    ... )
+    >>> from pathway_tpu.stdlib.graphs.pagerank import pagerank
+    >>> ranks = pagerank(E, steps=3)
+    >>> ranks.column_names()
+    ['rank']
+    """
     # vertex set = endpoints of edges
     us = edges.select(vid=edges.u)
     vs = edges.select(vid=edges.v)

@@ -2,7 +2,20 @@
 TantivyBM25:41; backend src/external_integration/tantivy_integration.rs).
 
 A pure-python incremental BM25 (Okapi) replaces the tantivy crate; scoring is
-vectorized with numpy over the candidate postings."""
+vectorized with numpy over the candidate postings.
+
+>>> import pathway_tpu as pw
+>>> from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25Factory
+>>> docs = pw.debug.table_from_rows(
+...     pw.schema_from_types(text=str),
+...     [("the quick brown fox",), ("lazy dogs sleep",)],
+... )
+>>> index = TantivyBM25Factory().build_index(docs.text, docs)
+>>> q = pw.debug.table_from_rows(pw.schema_from_types(q=str), [("fox",)])
+>>> r = index.query_as_of_now(q.q, number_of_matches=1)
+>>> sorted(r.column_names())
+['_pw_index_reply_id', '_pw_index_reply_score', 'q', 'text']
+"""
 
 from __future__ import annotations
 

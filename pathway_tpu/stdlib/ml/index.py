@@ -1,5 +1,22 @@
 """Legacy KNNIndex API (reference: python/pathway/stdlib/ml/index.py:9 —
-LSH-based; here backed by the XLA brute-force kernel)."""
+LSH-based; here backed by the XLA brute-force kernel).
+
+>>> import numpy as np
+>>> import pathway_tpu as pw
+>>> from pathway_tpu.stdlib.ml.index import KNNIndex
+>>> data = pw.debug.table_from_rows(
+...     pw.schema_from_types(doc=str, emb=np.ndarray),
+...     [("apple", np.array([1.0, 0.0])), ("pear", np.array([0.9, 0.1]))],
+... )
+>>> index = KNNIndex(data.emb, data, n_dimensions=2)
+>>> qs = pw.debug.table_from_rows(
+...     pw.schema_from_types(qemb=np.ndarray), [(np.array([1.0, 0.05]),)]
+... )
+>>> r = index.get_nearest_items(qs.qemb, k=1).select(pw.this.doc)
+>>> pw.debug.compute_and_print(r, include_id=False)
+doc
+('apple',)
+"""
 
 from __future__ import annotations
 

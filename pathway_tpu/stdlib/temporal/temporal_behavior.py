@@ -3,7 +3,16 @@
 
 In batch mode behaviors are no-ops (all data shares one time); in streaming
 they wire the engine's buffer/forget/freeze operators (reference:
-src/engine/dataflow/operators/time_column.rs)."""
+src/engine/dataflow/operators/time_column.rs).
+
+>>> import pathway_tpu as pw
+>>> b = pw.temporal.common_behavior(delay=2, cutoff=10)
+>>> type(b).__name__
+'CommonBehavior'
+>>> e = pw.temporal.exactly_once_behavior()
+>>> type(e).__name__
+'ExactlyOnceBehavior'
+"""
 
 from __future__ import annotations
 

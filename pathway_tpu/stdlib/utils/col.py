@@ -1,4 +1,15 @@
-"""Column utilities (reference: python/pathway/stdlib/utils/col.py)."""
+"""Column utilities (reference: python/pathway/stdlib/utils/col.py).
+
+>>> import pathway_tpu as pw
+>>> t = pw.debug.table_from_rows(
+...     pw.schema_from_types(pair=tuple), [((1, "x"),)]
+... )
+>>> from pathway_tpu.stdlib.utils.col import unpack_col
+>>> r = unpack_col(t.pair, pw.this.num, pw.this.name)
+>>> pw.debug.compute_and_print(r, include_id=False)
+num | name
+1   | x
+"""
 
 from __future__ import annotations
 

@@ -1,4 +1,20 @@
-"""Row filters (reference: python/pathway/stdlib/utils/filtering.py)."""
+"""Row filters (reference: python/pathway/stdlib/utils/filtering.py).
+
+>>> import pathway_tpu as pw
+>>> from pathway_tpu.stdlib.utils.filtering import argmax_rows
+>>> t = pw.debug.table_from_markdown('''
+... g | v
+... a | 1
+... a | 5
+... b | 2
+... ''')
+>>> pw.debug.compute_and_print(
+...     argmax_rows(t, pw.this.g, what=pw.this.v), include_id=False
+... )
+g | v
+b | 2
+a | 5
+"""
 
 from __future__ import annotations
 

@@ -66,3 +66,30 @@ def test_doctest_sweep_is_package_wide():
     assert len(ALL_MODULES) > 100, len(ALL_MODULES)
     assert "pathway_tpu.internals.table" in ALL_MODULES
     assert "pathway_tpu.xpacks.llm.prompts" in ALL_MODULES
+
+
+def test_doctest_example_density_floor():
+    """Modules without examples pass the sweep vacuously, so coverage
+    could silently regress to zero. Count the examples the sweep will
+    execute and hold a floor (VERDICT r4 item 9)."""
+    finder = doctest.DocTestFinder()
+    total = 0
+    modules_with_examples = 0
+    for name in ALL_MODULES:
+        if name in SKIP:
+            continue
+        try:
+            mod = importlib.import_module(name)
+        except ImportError:
+            continue
+        n = sum(len(t.examples) for t in finder.find(mod))
+        total += n
+        if n:
+            modules_with_examples += 1
+    # floors, not targets: today's package has ~2x these numbers; a
+    # regression that strips examples from whole subsystems trips this
+    # long before the sweep goes vacuous
+    assert total >= 150, f"only {total} doctest examples package-wide"
+    assert modules_with_examples >= 30, (
+        f"only {modules_with_examples} modules carry examples"
+    )
