@@ -220,16 +220,27 @@ def write_ocf(
         out += _zigzag_encode(len(block))
         out += block
         out += sync
+    if hasattr(path, "write"):  # file-like sink (object-store lakes)
+        path.write(bytes(out))
+        return
     tmp = path + ".tmp"
     with open(tmp, "wb") as fh:
         fh.write(bytes(out))
     os.rename(tmp, path)
 
 
-def read_ocf(path: str) -> Tuple[dict, List[dict]]:
-    """Read an Avro Object Container File; returns (schema, records)."""
-    with open(path, "rb") as fh:
-        buf = io.BytesIO(fh.read())
+def read_ocf(path) -> Tuple[dict, List[dict]]:
+    """Read an Avro Object Container File (by path, bytes, or file-like);
+    returns (schema, records)."""
+    if isinstance(path, (bytes, bytearray)):
+        buf = io.BytesIO(bytes(path))
+        path = "<bytes>"
+    elif hasattr(path, "read"):
+        buf = io.BytesIO(path.read())
+        path = "<stream>"
+    else:
+        with open(path, "rb") as fh:
+            buf = io.BytesIO(fh.read())
     if buf.read(4) != _MAGIC:
         raise ValueError(f"{path}: not an Avro object container file")
     meta: Dict[str, bytes] = {}

@@ -13,7 +13,6 @@ is written with the reference's extra columns `time` and `diff`.
 from __future__ import annotations
 
 import json
-import os
 import time as time_mod
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -21,6 +20,13 @@ from pathway_tpu.internals import dtype as dt
 from pathway_tpu.io._connector_runtime import (
     ConnectorSubjectBase,
     connector_table,
+)
+from pathway_tpu.io._lake_fs import (
+    LakeFS,
+    as_fs as _as_fs,
+    read_parquet as _read_parquet,
+    resolve_lake_fs,
+    write_parquet as _write_parquet,
 )
 from pathway_tpu.io._writer import OutputWriter, RowEvent, attach_writer, jsonable
 
@@ -57,16 +63,14 @@ def _schema_string(column_types: Dict[str, Any]) -> str:
     )
 
 
-def _log_path(uri: str, version: int) -> str:
-    return os.path.join(uri, _LOG_DIR, f"{version:020d}.json")
+def _log_path(version: int) -> str:
+    return f"{_LOG_DIR}/{version:020d}.json"
 
 
-def _list_versions(uri: str) -> List[int]:
-    log_dir = os.path.join(uri, _LOG_DIR)
-    if not os.path.isdir(log_dir):
-        return []
+def _list_versions(fs: LakeFS) -> List[int]:
+    fs = _as_fs(fs)
     out = []
-    for f in os.listdir(log_dir):
+    for f in fs.listdir(_LOG_DIR):
         if f.endswith(".json"):
             try:
                 out.append(int(f[: -len(".json")]))
@@ -75,48 +79,48 @@ def _list_versions(uri: str) -> List[int]:
     return sorted(out)
 
 
-def _read_actions(uri: str, version: int) -> List[dict]:
-    with open(_log_path(uri, version)) as fh:
-        return [json.loads(line) for line in fh if line.strip()]
+def _read_actions(fs: LakeFS, version: int) -> List[dict]:
+    fs = _as_fs(fs)
+    text = fs.read_bytes(_log_path(version)).decode("utf-8")
+    return [json.loads(line) for line in text.splitlines() if line.strip()]
 
 
-def _write_commit(uri: str, actions: List[dict]) -> int:
-    os.makedirs(os.path.join(uri, _LOG_DIR), exist_ok=True)
-    versions = _list_versions(uri)
+def _write_commit(fs: LakeFS, actions: List[dict]) -> int:
+    versions = _list_versions(fs)
     version = (versions[-1] + 1) if versions else 0
-    path = _log_path(uri, version)
-    tmp = path + ".tmp"
     # every commit carries a timestamp so readers can seek by time
     # (reference: delta.rs:720-733 version_timestamp)
     stamped = [{"commitInfo": {"timestamp": int(time_mod.time() * 1000)}}]
     stamped += [a for a in actions if "commitInfo" not in a]
-    with open(tmp, "w") as fh:
-        for action in stamped:
-            fh.write(json.dumps(action) + "\n")
-    os.rename(tmp, path)  # atomic publish of the commit
+    payload = "".join(json.dumps(a) + "\n" for a in stamped)
+    fs.write_bytes(_log_path(version), payload.encode("utf-8"))
     return version
 
 
-def _version_timestamp_ms(uri: str, version: int) -> int:
+def _version_timestamp_ms(fs: LakeFS, version: int) -> int | None:
     """Commit timestamp of a version: commitInfo when present, file mtime
-    otherwise (reference: snapshot.version_timestamp, delta.rs:708)."""
+    otherwise (reference: snapshot.version_timestamp, delta.rs:708).
+    Returns None when the backend has neither (foreign-written table on
+    an object store) — callers must NOT treat unknown as epoch 0."""
     try:
-        for action in _read_actions(uri, version):
+        for action in _read_actions(fs, version):
             info = action.get("commitInfo")
             if info and "timestamp" in info:
                 return int(info["timestamp"])
-    except OSError:
+    except (OSError, FileNotFoundError):
         pass
-    return int(os.path.getmtime(_log_path(uri, version)) * 1000)
+    m = fs.mtime(_log_path(version))
+    return None if m is None else int(m * 1000)
 
 
-def _live_files(uri: str, up_to_version: int | None = None) -> List[str]:
+def _live_files(fs: LakeFS, up_to_version: int | None = None) -> List[str]:
     """Replay the log: the add-minus-remove file set at a version."""
+    fs = _as_fs(fs)
     live: Dict[str, bool] = {}
-    for v in _list_versions(uri):
+    for v in _list_versions(fs):
         if up_to_version is not None and v > up_to_version:
             break
-        for action in _read_actions(uri, v):
+        for action in _read_actions(fs, v):
             if "add" in action:
                 live[action["add"]["path"]] = True
             elif "remove" in action:
@@ -125,15 +129,15 @@ def _live_files(uri: str, up_to_version: int | None = None) -> List[str]:
 
 
 def _create_table_if_absent(
-    uri: str, column_types: Dict[str, Any], extra_cols: List[tuple]
+    fs: LakeFS, column_types: Dict[str, Any], extra_cols: List[tuple]
 ) -> bool:
     """Version-0 protocol/metaData commit for a fresh table. Returns True
     when the table already existed."""
-    os.makedirs(uri, exist_ok=True)
-    if _list_versions(uri):
+    fs.makedirs("")
+    if _list_versions(fs):
         return True
     _write_commit(
-        uri,
+        fs,
         [
             {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}},
             {
@@ -157,19 +161,24 @@ class DeltaTableWriter(OutputWriter):
     """Appends one parquet file + one Delta commit per closed engine time
     (reference: data_lake/writer.rs + buffering.rs)."""
 
-    def __init__(self, uri: str, column_types: Dict[str, Any], *, min_commit_frequency=None):
+    def __init__(
+        self,
+        uri: str | LakeFS,
+        column_types: Dict[str, Any],
+        *,
+        min_commit_frequency=None,
+    ):
         import pyarrow  # noqa: F401  (hard requirement for the lake writers)
 
-        self.uri = uri
+        self.fs = uri if isinstance(uri, LakeFS) else resolve_lake_fs(uri)
         self.column_types = dict(column_types)
         _create_table_if_absent(
-            uri, self.column_types, [("time", dt.INT), ("diff", dt.INT)]
+            self.fs, self.column_types, [("time", dt.INT), ("diff", dt.INT)]
         )
         self._file_counter = 0
 
     def write_batch(self, events: Sequence[RowEvent]) -> None:
         import pyarrow as pa
-        import pyarrow.parquet as pq
 
         cols: Dict[str, list] = {name: [] for name in self.column_types}
         cols["time"] = []
@@ -182,16 +191,15 @@ class DeltaTableWriter(OutputWriter):
         table = pa.table(cols)
         self._file_counter += 1
         fname = f"part-{int(time_mod.time() * 1e6)}-{self._file_counter:05d}.parquet"
-        fpath = os.path.join(self.uri, fname)
-        pq.write_table(table, fpath)
+        size = _write_parquet(self.fs, fname, table)
         _write_commit(
-            self.uri,
+            self.fs,
             [
                 {
                     "add": {
                         "path": fname,
                         "partitionValues": {},
-                        "size": os.path.getsize(fpath),
+                        "size": size,
                         "modificationTime": int(time_mod.time() * 1000),
                         "dataChange": True,
                     }
@@ -207,10 +215,10 @@ class DeltaSnapshotWriter(OutputWriter):
     deletion rewrites the full snapshot, removing all prior files in the
     same commit)."""
 
-    def __init__(self, uri: str, column_types: Dict[str, Any]):
+    def __init__(self, uri: str | LakeFS, column_types: Dict[str, Any]):
         import pyarrow  # noqa: F401
 
-        self.uri = uri
+        self.fs = uri if isinstance(uri, LakeFS) else resolve_lake_fs(uri)
         self.column_types = dict(column_types)
         self._file_counter = 0
         # key -> row dict (current table state)
@@ -219,7 +227,7 @@ class DeltaSnapshotWriter(OutputWriter):
         # not replay the whole transaction log (one replay at startup)
         self._live: List[str] = []
         existed = _create_table_if_absent(
-            uri, self.column_types, [("_id", dt.STR)]
+            self.fs, self.column_types, [("_id", dt.STR)]
         )
         if existed:
             self._restore_state()
@@ -227,21 +235,19 @@ class DeltaSnapshotWriter(OutputWriter):
     def _restore_state(self) -> None:
         """Resume onto an existing table: its current content is the
         initial snapshot (reference: buffering.rs new_for_delta_table)."""
-        import pyarrow.parquet as pq
-
-        self._live = _live_files(self.uri)
+        self._live = _live_files(self.fs)
         for fname in self._live:
-            fpath = os.path.join(self.uri, fname)
-            if not os.path.exists(fpath):
+            try:
+                table = _read_parquet(self.fs, fname)
+            except FileNotFoundError:
                 continue
-            for rec in pq.read_table(fpath).to_pylist():
+            for rec in table.to_pylist():
                 key = rec.get("_id")
                 if key is not None:
                     self.state[key] = rec
 
-    def _new_file(self, rows: List[Dict[str, Any]]) -> str:
+    def _new_file(self, rows: List[Dict[str, Any]]) -> tuple[str, int]:
         import pyarrow as pa
-        import pyarrow.parquet as pq
 
         cols: Dict[str, list] = {name: [] for name in self.column_types}
         cols["_id"] = []
@@ -254,15 +260,16 @@ class DeltaSnapshotWriter(OutputWriter):
             f"part-{int(time_mod.time() * 1e6)}-{self._file_counter:05d}"
             ".parquet"
         )
-        pq.write_table(pa.table(cols), os.path.join(self.uri, fname))
-        return fname
+        size = _write_parquet(self.fs, fname, pa.table(cols))
+        return fname, size
 
-    def _add_action(self, fname: str) -> dict:
+    @staticmethod
+    def _add_action(fname: str, size: int) -> dict:
         return {
             "add": {
                 "path": fname,
                 "partitionValues": {},
-                "size": os.path.getsize(os.path.join(self.uri, fname)),
+                "size": size,
                 "modificationTime": int(time_mod.time() * 1000),
                 "dataChange": True,
             }
@@ -286,9 +293,9 @@ class DeltaSnapshotWriter(OutputWriter):
         if only_appends:
             if not appended:
                 return
-            fname = self._new_file(appended)
+            fname, size = self._new_file(appended)
             self._live.append(fname)
-            _write_commit(self.uri, [self._add_action(fname)])
+            _write_commit(self.fs, [self._add_action(fname, size)])
             return
         # a deletion occurred: rewrite the whole snapshot in one commit
         actions = [
@@ -301,10 +308,10 @@ class DeltaSnapshotWriter(OutputWriter):
             }
             for f in self._live
         ]
-        fname = self._new_file(list(self.state.values()))
+        fname, size = self._new_file(list(self.state.values()))
         self._live = [fname]
-        actions.append(self._add_action(fname))
-        _write_commit(self.uri, actions)
+        actions.append(self._add_action(fname, size))
+        _write_commit(self.fs, actions)
 
 
 def write(
@@ -315,7 +322,9 @@ def write(
     partition_columns=None,
     min_commit_frequency: int | None = 60_000,
     output_table_type: str = "stream_of_changes",
+    s3_connection_settings=None,
     name: str | None = None,
+    _object_client=None,
     **kwargs,
 ) -> None:
     """Write to a Delta table (reference: io/deltalake write:466).
@@ -323,16 +332,24 @@ def write(
     ``output_table_type="stream_of_changes"`` appends the change stream
     with ``time``/``diff`` columns; ``"snapshot"`` maintains the current
     table state keyed by ``_id`` (reference: deltalake/__init__.py:477,
-    snapshot_maintenance_on_output)."""
+    snapshot_maintenance_on_output). ``uri`` may be a local path or an
+    ``s3://`` / ``az://`` object-store location; S3 credentials come from
+    ``s3_connection_settings`` (an ``pw.io.s3.AwsS3Settings``), matching
+    the reference's storage-options plumbing (delta.rs:215,273)."""
+    fs = resolve_lake_fs(
+        uri,
+        s3_connection_settings=s3_connection_settings,
+        _object_client=_object_client,
+    )
     column_types = {
         c: table.schema[c].dtype if c in table.schema.keys() else dt.ANY
         for c in table.column_names()
     }
     if output_table_type == "snapshot":
-        writer: OutputWriter = DeltaSnapshotWriter(uri, column_types)
+        writer: OutputWriter = DeltaSnapshotWriter(fs, column_types)
     elif output_table_type == "stream_of_changes":
         writer = DeltaTableWriter(
-            uri, column_types, min_commit_frequency=min_commit_frequency
+            fs, column_types, min_commit_frequency=min_commit_frequency
         )
     else:
         raise ValueError(
@@ -356,7 +373,7 @@ class _DeltaSubject(ConnectorSubjectBase):
         start_from_timestamp_ms: int | None = None,
     ):
         super().__init__()
-        self.uri = uri
+        self.fs = uri if isinstance(uri, LakeFS) else resolve_lake_fs(uri)
         self.schema = schema
         self.mode = mode
         self.refresh_interval = refresh_interval
@@ -372,11 +389,11 @@ class _DeltaSubject(ConnectorSubjectBase):
         if self.start_from_timestamp_ms is None:
             return
         last_below = None
-        for v in _list_versions(self.uri):
-            if (
-                _version_timestamp_ms(self.uri, v)
-                <= self.start_from_timestamp_ms
-            ):
+        for v in _list_versions(self.fs):
+            ts = _version_timestamp_ms(self.fs, v)
+            # unknown timestamp: conservatively treat the version as
+            # after the threshold (re-reading beats silent data loss)
+            if ts is not None and ts <= self.start_from_timestamp_ms:
                 last_below = v
             else:
                 break
@@ -384,10 +401,8 @@ class _DeltaSubject(ConnectorSubjectBase):
             self._next_version = last_below + 1
 
     def _emit_file(self, fname: str, sign: int) -> None:
-        import pyarrow.parquet as pq
-
         names = list(self.schema.keys())
-        table = pq.read_table(os.path.join(self.uri, fname))
+        table = _read_parquet(self.fs, fname)
         data = table.to_pylist()
         for rec in data:
             row = {
@@ -402,18 +417,20 @@ class _DeltaSubject(ConnectorSubjectBase):
                 self._remove(row)
 
     def _apply_new_versions(self) -> bool:
-        versions = [v for v in _list_versions(self.uri) if v >= self._next_version]
+        versions = [v for v in _list_versions(self.fs) if v >= self._next_version]
         changed = False
         for v in versions:
-            for action in _read_actions(self.uri, v):
+            for action in _read_actions(self.fs, v):
                 if "add" in action:
                     self._emit_file(action["add"]["path"], 1)
                     changed = True
                 elif "remove" in action:
                     fname = action["remove"]["path"]
-                    if os.path.exists(os.path.join(self.uri, fname)):
+                    try:
                         self._emit_file(fname, -1)
                         changed = True
+                    except FileNotFoundError:
+                        pass  # data file already vacuumed
             self._next_version = v + 1
         return changed
 
@@ -455,8 +472,10 @@ def read(
     autocommit_duration_ms: int | None = 1500,
     refresh_interval: float = 0.5,
     start_from_timestamp_ms: int | None = None,
+    s3_connection_settings=None,
     name: str | None = None,
     _has_diff_column: bool = True,
+    _object_client=None,
     **kwargs,
 ):
     """Read a Delta table as a (streaming) table (reference: io/deltalake
@@ -464,11 +483,17 @@ def read(
     stream; otherwise every row is an insertion. With
     ``start_from_timestamp_ms``, only changes committed after the given
     timestamp are read (reference: deltalake/__init__.py:298,
-    delta.rs:707)."""
+    delta.rs:707). ``uri`` may be local or ``s3://`` / ``az://`` with
+    credentials via ``s3_connection_settings``."""
+    fs = resolve_lake_fs(
+        uri,
+        s3_connection_settings=s3_connection_settings,
+        _object_client=_object_client,
+    )
 
     def factory():
         return _DeltaSubject(
-            uri,
+            fs,
             schema,
             mode,
             refresh_interval,

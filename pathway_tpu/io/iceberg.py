@@ -17,8 +17,8 @@ change stream carries the reference's `time`/`diff` columns.
 
 from __future__ import annotations
 
+import io as io_mod
 import json
-import os
 import time as time_mod
 from typing import Dict, List, Sequence
 
@@ -26,6 +26,13 @@ from pathway_tpu.internals import dtype as dt
 from pathway_tpu.io._connector_runtime import (
     ConnectorSubjectBase,
     connector_table,
+)
+from pathway_tpu.io._lake_fs import (
+    LakeFS,
+    as_fs as _as_fs,
+    read_parquet as _read_parquet,
+    resolve_lake_fs,
+    write_parquet as _write_parquet,
 )
 from pathway_tpu.io._writer import OutputWriter, RowEvent, attach_writer, jsonable
 from pathway_tpu.io.deltalake import _coerce_delta
@@ -112,42 +119,39 @@ _MANIFEST_FILE_SCHEMA = {
 }
 
 
-def _load_manifest_list(path: str) -> List[dict]:
+def _load_manifest_list(fs: LakeFS, path: str) -> List[dict]:
     """Manifest-list entries from an Avro file (spec) or legacy JSON."""
+    fs = _as_fs(fs)
     if path.endswith(".avro"):
         from pathway_tpu.io._avro import read_ocf
 
-        _schema, records = read_ocf(path)
+        _schema, records = read_ocf(fs.read_bytes(path))
         return records
-    with open(path) as fh:
-        return json.load(fh).get("manifests", [])
+    return json.loads(fs.read_bytes(path)).get("manifests", [])
 
 
-def _load_manifest_entries(path: str) -> List[dict]:
+def _load_manifest_entries(fs: LakeFS, path: str) -> List[dict]:
     """Manifest entries from an Avro file (spec) or legacy JSON."""
+    fs = _as_fs(fs)
     if path.endswith(".avro"):
         from pathway_tpu.io._avro import read_ocf
 
-        _schema, records = read_ocf(path)
+        _schema, records = read_ocf(fs.read_bytes(path))
         return records
-    with open(path) as fh:
-        return json.load(fh).get("entries", [])
+    return json.loads(fs.read_bytes(path)).get("entries", [])
 
 
-def _current_metadata(uri: str):
-    meta_dir = os.path.join(uri, _META_DIR)
-    if not os.path.isdir(meta_dir):
-        return None, 0
+def _current_metadata(fs: LakeFS):
+    fs = _as_fs(fs)
     versions = sorted(
         int(f.split(".")[0][1:])
-        for f in os.listdir(meta_dir)
+        for f in fs.listdir(_META_DIR)
         if f.endswith(".metadata.json")
     )
     if not versions:
         return None, 0
     v = versions[-1]
-    with open(os.path.join(meta_dir, f"v{v}.metadata.json")) as fh:
-        return json.load(fh), v
+    return json.loads(fs.read_bytes(f"{_META_DIR}/v{v}.metadata.json")), v
 
 
 def _iceberg_type(dtype) -> str:
@@ -169,14 +173,16 @@ class IcebergTableWriter(OutputWriter):
     """Appends change-stream batches as Iceberg v2 snapshots (reference:
     iceberg.rs snapshot commit path)."""
 
-    def __init__(self, uri: str, column_names: Sequence[str], schema=None):
+    def __init__(
+        self, uri: str | LakeFS, column_names: Sequence[str], schema=None
+    ):
         import pyarrow  # noqa: F401
 
-        self.uri = uri
+        self.fs = _as_fs(uri)
         self.column_names = list(column_names)
         self.schema = schema
-        os.makedirs(os.path.join(uri, _META_DIR), exist_ok=True)
-        os.makedirs(os.path.join(uri, _DATA_DIR), exist_ok=True)
+        self.fs.makedirs(_META_DIR)
+        self.fs.makedirs(_DATA_DIR)
         self._counter = 0
 
     def _schema_fields(self) -> List[dict]:
@@ -201,7 +207,6 @@ class IcebergTableWriter(OutputWriter):
         import uuid
 
         import pyarrow as pa
-        import pyarrow.parquet as pq
 
         cols: Dict[str, list] = {name: [] for name in self.column_names}
         cols["time"] = []
@@ -213,21 +218,19 @@ class IcebergTableWriter(OutputWriter):
             cols["diff"].append(ev.diff)
         self._counter += 1
         now_ms = int(time_mod.time() * 1000)
-        fname = os.path.join(
-            _DATA_DIR,
-            f"data-{int(time_mod.time() * 1e6)}-{self._counter:05d}.parquet",
+        fname = (
+            f"{_DATA_DIR}/data-{int(time_mod.time() * 1e6)}"
+            f"-{self._counter:05d}.parquet"
         )
-        data_path = os.path.join(self.uri, fname)
-        pq.write_table(pa.table(cols), data_path)
-        file_size = os.path.getsize(data_path)
+        file_size = _write_parquet(self.fs, fname, pa.table(cols))
 
-        meta, version = _current_metadata(self.uri)
+        meta, version = _current_metadata(self.fs)
         new_version = version + 1
         if meta is None:
             meta = {
                 "format-version": 2,
                 "table-uuid": str(uuid.uuid4()),
-                "location": os.path.abspath(self.uri),
+                "location": self.fs.display_uri,
                 "last-sequence-number": 0,
                 "last-updated-ms": now_ms,
                 "last-column-id": len(self.column_names) + 2,
@@ -258,9 +261,7 @@ class IcebergTableWriter(OutputWriter):
         # field-ids (reference: iceberg.rs via iceberg-rust's writers)
         from pathway_tpu.io._avro import write_ocf
 
-        manifest_name = os.path.join(
-            _META_DIR, f"manifest-{snapshot_id}.avro"
-        )
+        manifest_name = f"{_META_DIR}/manifest-{snapshot_id}.avro"
         manifest_entries = [
             {
                 "status": 1,  # ADDED
@@ -277,8 +278,9 @@ class IcebergTableWriter(OutputWriter):
                 },
             }
         ]
+        sink = io_mod.BytesIO()
         write_ocf(
-            os.path.join(self.uri, manifest_name),
+            sink,
             _MANIFEST_ENTRY_SCHEMA,
             manifest_entries,
             metadata={
@@ -287,7 +289,8 @@ class IcebergTableWriter(OutputWriter):
                 "partition-spec-id": "0",
             },
         )
-        manifest_len = os.path.getsize(os.path.join(self.uri, manifest_name))
+        manifest_len = len(sink.getvalue())
+        self.fs.write_bytes(manifest_name, sink.getvalue())
 
         # manifest list: the spec requires a snapshot's manifest list to
         # represent FULL table state, so carry every prior manifest
@@ -298,14 +301,12 @@ class IcebergTableWriter(OutputWriter):
             if prev_snap["snapshot-id"] == cur_id and "manifest-list" in prev_snap:
                 try:
                     prior_manifests = _load_manifest_list(
-                        os.path.join(self.uri, prev_snap["manifest-list"])
+                        self.fs, prev_snap["manifest-list"]
                     )
-                except OSError:
+                except (OSError, FileNotFoundError):
                     prior_manifests = []
                 break
-        mlist_name = os.path.join(
-            _META_DIR, f"snap-{snapshot_id}-manifest-list.avro"
-        )
+        mlist_name = f"{_META_DIR}/snap-{snapshot_id}-manifest-list.avro"
         new_entry = {
             "manifest_path": manifest_name,
             "manifest_length": manifest_len,
@@ -331,8 +332,9 @@ class IcebergTableWriter(OutputWriter):
             }
             for e in prior_manifests
         ]
+        mlist_sink = io_mod.BytesIO()
         write_ocf(
-            os.path.join(self.uri, mlist_name),
+            mlist_sink,
             _MANIFEST_FILE_SCHEMA,
             prior_manifests + [new_entry],
             metadata={
@@ -342,6 +344,7 @@ class IcebergTableWriter(OutputWriter):
                 "parent-snapshot-id": str(parent),
             },
         )
+        self.fs.write_bytes(mlist_name, mlist_sink.getvalue())
 
         meta["snapshots"].append(
             {
@@ -367,24 +370,62 @@ class IcebergTableWriter(OutputWriter):
         if version:
             meta.setdefault("metadata-log", []).append(
                 {
-                    "metadata-file": os.path.join(
-                        _META_DIR, f"v{version}.metadata.json"
-                    ),
+                    "metadata-file": f"{_META_DIR}/v{version}.metadata.json",
                     "timestamp-ms": now_ms,
                 }
             )
-        path = os.path.join(
-            self.uri, _META_DIR, f"v{new_version}.metadata.json"
+        self.fs.write_bytes(
+            f"{_META_DIR}/v{new_version}.metadata.json",
+            json.dumps(meta).encode("utf-8"),
         )
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(meta, fh)
-        os.rename(tmp, path)
         # catalogs resolve the current version through the hint file
-        hint = os.path.join(self.uri, _META_DIR, "version-hint.text")
-        with open(hint + ".tmp", "w") as fh:
-            fh.write(str(new_version))
-        os.rename(hint + ".tmp", hint)
+        self.fs.write_bytes(
+            f"{_META_DIR}/version-hint.text",
+            str(new_version).encode("ascii"),
+        )
+
+
+def _resolve_table_fs(
+    catalog_uri,
+    warehouse,
+    namespace,
+    table_name,
+    s3_connection_settings=None,
+    _object_client=None,
+) -> LakeFS:
+    """A table lives under ``warehouse/<namespace...>/<table_name>``.
+
+    The reference's ``catalog_uri`` names an Iceberg REST catalog
+    (io/iceberg/__init__.py:52); this implementation speaks the
+    warehouse layout directly (local or object store) and refuses to
+    silently treat a catalog URL as a directory — pass ``warehouse=``."""
+    if warehouse is None:
+        if catalog_uri is None:
+            raise ValueError(
+                "pw.io.iceberg needs warehouse=<path or s3:// uri>"
+            )
+        if catalog_uri.startswith(("http://", "https://", "thrift://")):
+            raise ValueError(
+                "pw.io.iceberg needs warehouse=<path or s3:// uri>: this "
+                "implementation maintains Iceberg v2 tables directly in a "
+                "warehouse (local or object store) and does not speak the "
+                f"REST catalog protocol ({catalog_uri!r} is a catalog "
+                "URL, which would otherwise be silently treated as a "
+                "directory)"
+            )
+        # path-like catalog_uri: historical alias for warehouse
+        warehouse = catalog_uri
+    uri = warehouse
+    parts = [p for p in (namespace or []) if p]
+    if table_name:
+        parts.append(table_name)
+    if parts:
+        uri = uri.rstrip("/") + "/" + "/".join(parts)
+    return resolve_lake_fs(
+        uri,
+        s3_connection_settings=s3_connection_settings,
+        _object_client=_object_client,
+    )
 
 
 def write(
@@ -395,18 +436,25 @@ def write(
     *,
     warehouse: str | None = None,
     min_commit_frequency: int | None = 60_000,
+    s3_connection_settings=None,
     name: str | None = None,
+    _object_client=None,
     **kwargs,
 ) -> None:
     """Append the change stream to an Iceberg table (reference: io/iceberg
     write)."""
-    uri = warehouse or catalog_uri
-    if namespace or table_name:
-        uri = os.path.join(uri, *(namespace or []), table_name or "")
+    fs = _resolve_table_fs(
+        catalog_uri,
+        warehouse,
+        namespace,
+        table_name,
+        s3_connection_settings,
+        _object_client,
+    )
     attach_writer(
         table,
         IcebergTableWriter(
-            uri, table.column_names(), schema=getattr(table, "schema", None)
+            fs, table.column_names(), schema=getattr(table, "schema", None)
         ),
         name=name,
     )
@@ -415,7 +463,7 @@ def write(
 class _IcebergSubject(ConnectorSubjectBase):
     def __init__(self, uri, schema, mode, refresh_interval):
         super().__init__()
-        self.uri = uri
+        self.fs = _as_fs(uri)
         self.schema = schema
         self.mode = mode
         self.refresh_interval = refresh_interval
@@ -425,9 +473,7 @@ class _IcebergSubject(ConnectorSubjectBase):
         self._seen_files: set[str] = set()
 
     def _poll(self) -> bool:
-        import pyarrow.parquet as pq
-
-        meta, _ = _current_metadata(self.uri)
+        meta, _ = _current_metadata(self.fs)
         if meta is None:
             return False
         names = list(self.schema.keys())
@@ -439,12 +485,10 @@ class _IcebergSubject(ConnectorSubjectBase):
             self._seen_snapshots.add(sid)
             data_files: List[str] = []
             if "manifest-list" in snap:
-                mlist = _load_manifest_list(
-                    os.path.join(self.uri, snap["manifest-list"])
-                )
+                mlist = _load_manifest_list(self.fs, snap["manifest-list"])
                 for mf in mlist:
                     entries = _load_manifest_entries(
-                        os.path.join(self.uri, mf["manifest_path"])
+                        self.fs, mf["manifest_path"]
                     )
                     for entry in entries:
                         if entry.get("status") != 2:  # not DELETED
@@ -453,8 +497,7 @@ class _IcebergSubject(ConnectorSubjectBase):
                                 self._seen_files.add(path)
                                 data_files.append(path)
             else:  # pre-spec layout written by older versions
-                with open(os.path.join(self.uri, snap["manifest"])) as fh:
-                    manifest = json.load(fh)
+                manifest = json.loads(self.fs.read_bytes(snap["manifest"]))
                 data_files = [
                     f
                     for f in manifest.get("data_files", [])
@@ -462,7 +505,7 @@ class _IcebergSubject(ConnectorSubjectBase):
                 ]
                 self._seen_files.update(data_files)
             for fname in data_files:
-                for rec in pq.read_table(os.path.join(self.uri, fname)).to_pylist():
+                for rec in _read_parquet(self.fs, fname).to_pylist():
                     row = {
                         k: _coerce_delta(rec.get(k), self.schema[k].dtype)
                         for k in names
@@ -504,16 +547,23 @@ def read(
     warehouse: str | None = None,
     mode: str = "streaming",
     refresh_interval: float = 0.5,
+    s3_connection_settings=None,
     name: str | None = None,
+    _object_client=None,
     **kwargs,
 ):
     """Read an Iceberg table as a (streaming) table (reference: io/iceberg
     read)."""
-    uri = warehouse or catalog_uri
-    if namespace or table_name:
-        uri = os.path.join(uri, *(namespace or []), table_name or "")
+    fs = _resolve_table_fs(
+        catalog_uri,
+        warehouse,
+        namespace,
+        table_name,
+        s3_connection_settings,
+        _object_client,
+    )
 
     def factory():
-        return _IcebergSubject(uri, schema, mode, refresh_interval)
+        return _IcebergSubject(fs, schema, mode, refresh_interval)
 
     return connector_table(schema, factory, mode=mode, name=name)

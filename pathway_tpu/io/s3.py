@@ -50,6 +50,17 @@ class AwsS3Settings:
         self.region = region
         self.endpoint = endpoint
 
+    def boto3_kwargs(self) -> dict:
+        """boto3.client("s3", ...) keyword mapping — the single place the
+        settings-to-boto3 translation lives (shared with the lake
+        connectors' resolve_lake_fs)."""
+        return {
+            "aws_access_key_id": self.access_key,
+            "aws_secret_access_key": self.secret_access_key,
+            "region_name": self.region,
+            "endpoint_url": self.endpoint,
+        }
+
     def create_client(self):
         try:
             import boto3  # type: ignore
@@ -59,13 +70,7 @@ class AwsS3Settings:
                 "_client_factory"
             )
         return _Boto3Client(
-            boto3.client(
-                "s3",
-                aws_access_key_id=self.access_key,
-                aws_secret_access_key=self.secret_access_key,
-                region_name=self.region,
-                endpoint_url=self.endpoint,
-            ),
+            boto3.client("s3", **self.boto3_kwargs()),
             self.bucket_name,
         )
 
