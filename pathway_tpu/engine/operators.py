@@ -551,8 +551,9 @@ class SemijoinNode(Node):
 
 
 class ConcatNode(Node):
-    """Disjoint union (reference: concat_tables). Key collisions are
-    logged as errors and resolved first-writer-wins."""
+    """Disjoint union (reference: concat_tables). A key collision means
+    the build-time disjointness promise was false — fail the run like the
+    reference's `duplicated entries for key` KeyError."""
 
     name = "concat"
     snapshot_attrs = ('owner',)
@@ -563,18 +564,15 @@ class ConcatNode(Node):
         self.owner: Dict[Pointer, int] = {}
 
     def process(self, time: int) -> None:
+        # retractions apply before insertions within one timestamp, so a
+        # key legitimately MOVING between inputs at time T (retract on one
+        # port, insert on another) is not misread as a duplicate
         out: List[Delta] = []
+        inserts: List[Tuple[int, Delta]] = []
         for port in range(len(self.inputs)):
             for key, values, diff in self.take(port):
                 if diff > 0:
-                    cur = self.owner.get(key)
-                    if cur is not None and cur != port:
-                        self.log_error(
-                            f"concat: duplicate key {key!r} across inputs"
-                        )
-                        continue
-                    self.owner[key] = port
-                    out.append((key, values, diff))
+                    inserts.append((port, (key, values, diff)))
                 else:
                     if self.owner.get(key) == port:
                         del self.owner[key]
@@ -585,6 +583,14 @@ class ConcatNode(Node):
                         self.log_error(
                             f"concat: retraction of non-owned key {key!r}"
                         )
+        for port, (key, values, diff) in inserts:
+            cur = self.owner.get(key)
+            if cur is not None and cur != port:
+                raise KeyError(
+                    f"duplicated entries for key {key!r} in concat"
+                )
+            self.owner[key] = port
+            out.append((key, values, diff))
         self.emit(time, out)
 
 

@@ -184,6 +184,8 @@ def iterate(func, iteration_limit: int | None = None, **kwargs):
     """
     from pathway_tpu.internals.iterate import iterate_impl
 
+    if iteration_limit is not None and iteration_limit < 1:
+        raise ValueError("wrong iteration limit")
     return iterate_impl(func, iteration_limit=iteration_limit, **kwargs)
 
 

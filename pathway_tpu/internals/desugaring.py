@@ -10,6 +10,7 @@ from pathway_tpu.internals import thisclass
 from pathway_tpu.internals.expression import (
     ColumnExpression,
     ColumnReference,
+    DelayedIxRef,
     IdReference,
     PointerExpression,
     ThisColumnReference,
@@ -30,6 +31,27 @@ def desugar(expr: Any, mapping: Dict[Any, Any]) -> ColumnExpression:
     expr = smart_wrap(expr)
 
     def rec(node: ColumnExpression) -> ColumnExpression:
+        if isinstance(node, DelayedIxRef):
+            # const-keyed ix: the lookup row set is this context's table
+            context = _substitute_table(thisclass.this, mapping)
+            if context is thisclass.this:
+                raise ValueError(
+                    "ix_ref with constant keys needs an enclosing "
+                    "select/reduce to provide its row context"
+                )
+            ptr = node._ptr
+            bound = PointerExpression(
+                node._target,
+                *(rec(a) for a in ptr._args),
+                optional=ptr._optional,
+                instance=(
+                    rec(ptr._instance) if ptr._instance is not None else None
+                ),
+            )
+            resolved = node._target.ix(
+                bound, optional=node._optional, context=context
+            )
+            return resolved[node._name]
         if isinstance(node, ThisColumnReference):
             concrete = _substitute_table(node._this, mapping)
             if concrete is node._this:
@@ -81,7 +103,11 @@ def expand_select_args(args, this_table, mapping) -> Dict[str, ColumnExpression]
     pw.this.without(...) and pw.this[...] slices expand."""
     out: Dict[str, ColumnExpression] = {}
     for arg in args:
-        if isinstance(arg, thisclass._ThisWithout):
+        if isinstance(arg, thisclass._ThisAll):
+            concrete = _substitute_table(arg.this_cls, mapping)
+            for name in concrete.column_names():
+                out[name] = concrete[name]
+        elif isinstance(arg, thisclass._ThisWithout):
             concrete = _substitute_table(arg.this_cls, mapping)
             for name in concrete.column_names():
                 if name not in arg.columns:

@@ -459,6 +459,43 @@ class PointerExpression(ColumnExpression):
         return (*self._args, *extra)
 
 
+class DelayedIxRef(ColumnExpression):
+    """`target.ix_ref(<consts>).col` outside any select context: the row
+    set the lookup runs over is only known at desugar time (the enclosing
+    select/reduce table), so the reference defers resolution via
+    `thisclass.this` (reference: table.py ix — `context._delayed_op`).
+    Desugaring rewrites this node into a concrete `target.ix(...)` column
+    reference."""
+
+    def __init__(self, target, ptr, optional: bool, name: str):
+        self._target = target
+        self._ptr = ptr
+        self._optional = optional
+        self._name = name
+
+    def _deps(self):
+        return ()
+
+
+class _DelayedIxTable:
+    """Proxy returned by `ix`/`ix_ref` with constant-only keys; column
+    access produces DelayedIxRef expressions resolved during select
+    desugaring."""
+
+    def __init__(self, target, ptr, optional: bool):
+        object.__setattr__(self, "_target", target)
+        object.__setattr__(self, "_ptr", ptr)
+        object.__setattr__(self, "_optional", optional)
+
+    def __getattr__(self, name):
+        if name.startswith("__"):
+            raise AttributeError(name)
+        return DelayedIxRef(self._target, self._ptr, self._optional, name)
+
+    def __getitem__(self, name):
+        return DelayedIxRef(self._target, self._ptr, self._optional, name)
+
+
 class MethodCallExpression(ColumnExpression):
     """Namespace method call (`.dt.year()`, `.str.lower()`, ...). Carries its
     scalar implementation; the engine vectorizes it over batches."""
@@ -507,6 +544,43 @@ class ReducerExpression(ColumnExpression):
 
     def _deps(self):
         return self._args
+
+
+def map_refs(expr: ColumnExpression, fn):
+    """Structurally copy `expr`, replacing every ColumnReference /
+    IdReference node by `fn(node)` (returning the node unchanged is
+    fine)."""
+    import copy as _copy
+
+    if isinstance(expr, (ColumnReference, IdReference)):
+        return fn(expr)
+    out = _copy.copy(expr)
+    for attr, value in list(vars(expr).items()):
+        if isinstance(value, ColumnExpression):
+            setattr(out, attr, map_refs(value, fn))
+        elif isinstance(value, tuple) and any(
+            isinstance(v, ColumnExpression) for v in value
+        ):
+            setattr(
+                out,
+                attr,
+                tuple(
+                    map_refs(v, fn) if isinstance(v, ColumnExpression) else v
+                    for v in value
+                ),
+            )
+        elif isinstance(value, dict) and any(
+            isinstance(v, ColumnExpression) for v in value.values()
+        ):
+            setattr(
+                out,
+                attr,
+                {
+                    k: map_refs(v, fn) if isinstance(v, ColumnExpression) else v
+                    for k, v in value.items()
+                },
+            )
+    return out
 
 
 def collect_tables(expr: ColumnExpression, out: set) -> set:

@@ -35,7 +35,13 @@ class NumericalNamespace:
         )
 
     def abs(self):
-        return self._call("abs", abs)
+        # preserves the input's numeric dtype (reference:
+        # expressions/test_numerical.py test_abs_int/test_abs_float)
+        def same_numeric(d):
+            core = dt.unoptionalize(d)
+            return core if core in (dt.INT, dt.FLOAT) else dt.FLOAT
+
+        return self._call("abs", abs, return_type=same_numeric)
 
     def round(self, decimals=0):
         return self._call(

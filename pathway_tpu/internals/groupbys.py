@@ -20,7 +20,7 @@ a | x | 4
 from __future__ import annotations
 
 import copy
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import thisclass
@@ -30,6 +30,7 @@ from pathway_tpu.internals.expression import (
     ColumnReference,
     IdReference,
     ReducerExpression,
+    collect_tables,
     smart_wrap,
 )
 from pathway_tpu.internals.schema import ColumnSchema, schema_from_columns
@@ -104,6 +105,79 @@ class GroupedTable:
         instance = self._instance
         id_expr = self._id_expr
         sort_by = self._sort_by
+
+        # absorb same-universe foreign columns: the reference lets
+        # reducers read other tables sharing the groupby's universe
+        # (test_common.py test_groupby_foreign_column). Select them onto
+        # the source first, then reduce single-table.
+        from pathway_tpu.internals.expression import map_refs
+        from pathway_tpu.internals.universe import solver
+
+        all_exprs = (
+            [a for r in reducers for a in r._args]
+            + list(cols.values())
+            + grouping
+            + [e for e in (instance, id_expr, sort_by) if e is not None]
+        )
+        foreign: Dict[int, ColumnReference] = {}
+        for e in all_exprs:
+            for tbl in collect_tables(e, set()):
+                if tbl is not source and isinstance(tbl, Table):
+                    foreign[id(tbl)] = tbl
+        if foreign:
+            for tbl in foreign.values():
+                if not solver.query_are_equal(
+                    tbl._universe, source._universe
+                ):
+                    raise ValueError(
+                        "reduce() may only reference the grouped table "
+                        "or tables sharing its universe"
+                    )
+            helper_cols = {c: source[c] for c in source.column_names()}
+            gen: Dict[Tuple[int, str], str] = {}
+
+            def note(ref):
+                key = (id(ref._table), ref._name)
+                if key not in gen:
+                    name = f"_pw_fx{len(gen)}"
+                    gen[key] = name
+                    helper_cols[name] = ref
+                return gen[key]
+
+            # first pass registers every foreign ref on the helper
+            def scan(node):
+                if (
+                    isinstance(node, ColumnReference)
+                    and not isinstance(node, IdReference)
+                    and node._table is not source
+                ):
+                    note(node)
+                return node
+
+            for e in all_exprs:
+                map_refs(e, scan)
+            helper = source._select_impl(helper_cols)
+
+            def retable(node):
+                if node._table is helper:
+                    return node  # idempotent: slots share reducer exprs
+                if isinstance(node, IdReference):
+                    return IdReference(helper)
+                if node._table is source:
+                    return helper[node._name]
+                return helper[gen[(id(node._table), node._name)]]
+
+            for r in reducers:
+                r._args = tuple(map_refs(a, retable) for a in r._args)
+            cols = {n: map_refs(e, retable) for n, e in cols.items()}
+            grouping = [map_refs(g, retable) for g in grouping]
+            if instance is not None:
+                instance = map_refs(instance, retable)
+            if id_expr is not None:
+                id_expr = map_refs(id_expr, retable)
+            if sort_by is not None:
+                sort_by = map_refs(sort_by, retable)
+            source = helper
         n_group = len(grouping)
 
         # group-key caching (and the fused raw-value code map) relies on
@@ -304,15 +378,55 @@ class GroupedTable:
 
         # rewrite output expressions against the raw table
         group_index: Dict[tuple, int] = {}
+        expr_group_index: Dict[tuple, int] = {}
+
+        def _fingerprint(e) -> tuple:
+            """Structural identity of an expression, strict enough that
+            two different lambdas never collide (functions compare by
+            object identity, tables by object identity)."""
+            if isinstance(e, IdReference):
+                return ("id", id(e._table))
+            if isinstance(e, ColumnReference):
+                return ("col", id(e._table), e._name)
+            parts = [type(e).__name__]
+            for attr, value in sorted(vars(e).items()):
+                if isinstance(value, ColumnExpression):
+                    parts.append((attr, _fingerprint(value)))
+                elif isinstance(value, tuple):
+                    parts.append(
+                        (
+                            attr,
+                            tuple(
+                                _fingerprint(v)
+                                if isinstance(v, ColumnExpression)
+                                else repr(v)
+                                for v in value
+                            ),
+                        )
+                    )
+                elif callable(value):
+                    parts.append((attr, id(value)))
+                else:
+                    parts.append((attr, repr(value)))
+            return tuple(parts)
+
         for i, g in enumerate(grouping):
             if isinstance(g, ColumnReference) and not isinstance(g, IdReference):
                 group_index[(id(g._table), g.name)] = i
             elif isinstance(g, IdReference):
                 group_index[(id(g._table), "id")] = i
+            else:
+                # expression grouping key (e.g. t.v % 2): outputs equal to
+                # it (structurally) read the group value
+                expr_group_index[_fingerprint(g)] = i
 
         def rewrite(expr: ColumnExpression) -> ColumnExpression:
             if isinstance(expr, _ReducerSlot):
                 return raw[f"_r{expr.index}"]
+            if expr_group_index and not isinstance(expr, ColumnReference):
+                loc = expr_group_index.get(_fingerprint(expr))
+                if loc is not None:
+                    return raw[f"_g{loc}"]
             if isinstance(expr, IdReference):
                 loc = group_index.get((id(expr._table), "id"))
                 if loc is not None:

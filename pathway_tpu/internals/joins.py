@@ -60,11 +60,16 @@ class JoinResult:
         *,
         id_expr=None,
         mode: JoinMode = JoinMode.INNER,
+        remap=None,
     ):
         self._left = left
         self._right = right
         self._mode = mode
         self._filters: List[ColumnExpression] = []
+        # chained joins: references to tables absorbed by an earlier join
+        # in the chain resolve through this map (original table, column)
+        # -> column of the materialized left side
+        self._remap: Dict = dict(remap or {})
         mapping = {
             thisclass.left: left,
             thisclass.right: right,
@@ -73,7 +78,7 @@ class JoinResult:
         self._on_left: List[ColumnExpression] = []
         self._on_right: List[ColumnExpression] = []
         for cond in on:
-            cond = desugar(cond, mapping)
+            cond = self._apply_remap(desugar(cond, mapping))
             if not (
                 isinstance(cond, BinaryOpExpression) and cond._op == "=="
             ):
@@ -107,6 +112,71 @@ class JoinResult:
                     raise ValueError("join id= must be pw.left.id or pw.right.id")
             else:
                 raise ValueError("join id= must be pw.left.id or pw.right.id")
+
+    # -- chained joins ----------------------------------------------------
+    def _apply_remap(self, expr: ColumnExpression) -> ColumnExpression:
+        if not self._remap:
+            return expr
+        from pathway_tpu.internals.expression import map_refs
+
+        def sub(node):
+            if isinstance(node, IdReference):
+                return node
+            hit = self._remap.get((id(node._table), node._name))
+            return hit if hit is not None else node
+
+        return map_refs(expr, sub)
+
+    def _materialize_all(self):
+        """Flatten this join into a Table holding every column of both
+        sides under unique names; returns (table, remap) where remap sends
+        (original table, column) to the flattened column reference."""
+        cols: Dict[str, ColumnExpression] = {}
+        pending = []
+        for tbl in (self._left, self._right):
+            for n in tbl.column_names():
+                pending.append((tbl, n))
+        names: Dict[Tuple[int, str], str] = {}
+        for tbl, n in pending:
+            out_name = n
+            while out_name in cols:
+                out_name = "_pw_j_" + out_name
+            cols[out_name] = tbl[n]
+            names[(id(tbl), n)] = out_name
+        tab = self.select(**cols)
+        remap = {key: tab[name] for key, name in names.items()}
+        # compose with the chain so far: tables absorbed two joins ago
+        # still resolve
+        for key, ref in self._remap.items():
+            inner = names.get((id(ref._table), ref._name))
+            if inner is not None:
+                remap[key] = tab[inner]
+        return tab, remap
+
+    def join(self, other, *on, id=None, how=None, **kwargs):
+        """Chain another join onto this one (reference: test_common.py
+        test_join_chain_1/2 — conditions and later selects may keep
+        referencing the original tables)."""
+        if how is None:
+            how = JoinMode.INNER
+        if isinstance(how, str):
+            how = JoinMode[how.upper()]
+        tab, remap = self._materialize_all()
+        return JoinResult(
+            tab, other, on, id_expr=id, mode=how, remap=remap
+        )
+
+    def join_inner(self, other, *on, id=None, **kwargs):
+        return self.join(other, *on, id=id, how=JoinMode.INNER)
+
+    def join_left(self, other, *on, id=None, **kwargs):
+        return self.join(other, *on, id=id, how=JoinMode.LEFT)
+
+    def join_right(self, other, *on, id=None, **kwargs):
+        return self.join(other, *on, id=id, how=JoinMode.RIGHT)
+
+    def join_outer(self, other, *on, id=None, **kwargs):
+        return self.join(other, *on, id=id, how=JoinMode.OUTER)
 
     # -- combined-storage helpers ----------------------------------------
     def _resolve_this(self, name: str) -> ColumnReference:
@@ -213,11 +283,13 @@ class JoinResult:
             else:
                 sub = expand_select_args([arg], self._left, mapping)
                 out.update(sub)
-        return out
+        return {n: self._apply_remap(e) for n, e in out.items()}
 
     def filter(self, expression) -> "JoinResult":
         out = copy.copy(self)
-        out._filters = self._filters + [desugar(expression, self._mapping())]
+        out._filters = self._filters + [
+            self._apply_remap(desugar(expression, self._mapping()))
+        ]
         return out
 
     def select(self, *args, **kwargs):
@@ -226,7 +298,7 @@ class JoinResult:
         cols = self._expand_args(args)
         mapping = self._mapping()
         for name, e in kwargs.items():
-            cols[name] = desugar(e, mapping)
+            cols[name] = self._apply_remap(desugar(e, mapping))
         jr = self
 
         def build(ctx):

@@ -462,7 +462,10 @@ def _make_tuple_reducer(sort_by_value: bool):
         if any(isinstance(v, Error) for v in vals):
             return ERROR
         if sort_by_value:
-            vals = sorted(vals)
+            # engine value ordering: None sorts before everything
+            # (reference: sorted_tuple with skip_nones=False yields
+            # (None, -1, 1) — test_common.py test_tuple_reducer)
+            vals = sorted(vals, key=lambda v: (v is not None, v))
         return tuple(vals)
 
     return compute

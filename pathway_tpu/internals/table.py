@@ -198,6 +198,25 @@ class Table:
         2
         """
         expr = desugar(filter_expression, self._mapping())
+        foreign = [t for t in collect_tables(expr, set()) if t is not self]
+        if foreign:
+            for other in foreign:
+                if not solver.query_are_equal(
+                    other._universe, self._universe
+                ):
+                    raise ValueError(
+                        "filter() predicates may only reference the "
+                        "filtered table or tables sharing its universe"
+                    )
+            # predicate over same-universe foreign columns: materialize it
+            # next to our columns first, then take the single-table path
+            tmp = "_pw_filter_pred"
+            while tmp in self.column_names():
+                tmp += "_"
+            helper = self._select_impl(
+                {**{c: self[c] for c in self.column_names()}, tmp: expr}
+            )
+            return helper.filter(helper[tmp]).without(tmp)
         self_ = self
 
         def build(ctx):
@@ -827,6 +846,7 @@ class Table:
         return self
 
     def promise_universes_are_disjoint(self, other: "Table") -> "Table":
+        solver.register_disjoint(self._universe, other._universe)
         return self
 
     def promise_universe_is_subset_of(self, other: "Table") -> "Table":
@@ -850,11 +870,24 @@ class Table:
         ... id | v
         ... 2  | 20
         ... ''')
+        >>> pw.universes.promise_are_pairwise_disjoint(t1, t2)
         >>> pw.debug.compute_and_print(t1.concat(t2), include_id=False)
         v
         20
         10
         """
+        # like the reference, refuse to build unless key-set disjointness
+        # is promised/derived — silent key collisions corrupt data
+        # (reference: test_common.py test_concat_unsafe_collision)
+        all_tables = [self, *others]
+        for i, a in enumerate(all_tables):
+            for b in all_tables[i + 1:]:
+                if not solver.query_are_disjoint(a._universe, b._universe):
+                    raise ValueError(
+                        "Table.concat() requires universes to be "
+                        "disjoint; use concat_reindex, or promise it "
+                        "via pw.universes.promise_are_pairwise_disjoint"
+                    )
         tables = [self] + [
             o.select(**{c: o[c] for c in self.column_names()}) for o in others
         ]
@@ -897,6 +930,11 @@ class Table:
             t.with_id_from(IdReference(t), i)
             for i, t in enumerate([self, *others])
         ]
+        # the distinct per-side instance mixed into each key makes the
+        # reindexed key sets disjoint by construction
+        for i, a in enumerate(reindexed):
+            for b in reindexed[i + 1:]:
+                solver.register_disjoint(a._universe, b._universe)
         return reindexed[0].concat(*reindexed[1:])
 
     def flatten(self, to_flatten: ColumnReference, *, origin_id: str | None = None) -> "Table":
@@ -946,8 +984,15 @@ class Table:
                     dtype = out
                 elif core is dt.STR:
                     dtype = dt.STR
-                else:
+                elif isinstance(core, dt.ArrayDType) or core is dt.ANY:
                     dtype = dt.ANY
+                else:
+                    # scalars are not flattenable — refuse at build time
+                    # (reference: test_common.py test_flatten_incorrect_type)
+                    raise TypeError(
+                        f"flatten: column {flat_name!r} of type {core} "
+                        "is not a sequence"
+                    )
             schema_cols[name] = ColumnSchema(name=name, dtype=dtype)
         return Table(
             schema=schema_from_columns(schema_cols),
@@ -1377,12 +1422,19 @@ class Table:
         Abe
         """
         expr = smart_wrap(expression)
-        src_tables = [t for t in collect_tables(expr, set()) if t is not self]
-        if not src_tables:
-            src_tables = list(collect_tables(expr, set()))
-        if len(src_tables) != 1:
-            raise ValueError("ix() key expression must reference exactly one table")
-        source = src_tables[0]
+        if context is not None:
+            source = context
+        else:
+            src_tables = [
+                t for t in collect_tables(expr, set()) if t is not self
+            ]
+            if not src_tables:
+                src_tables = list(collect_tables(expr, set()))
+            if len(src_tables) != 1:
+                raise ValueError(
+                    "ix() key expression must reference exactly one table"
+                )
+            source = src_tables[0]
         optional = optional or allow_misses
         self_ = self
 
@@ -1416,9 +1468,23 @@ class Table:
             build=build,
         )
 
-    def ix_ref(self, *args, optional: bool = False, context=None, instance=None) -> "Table":
+    def ix_ref(self, *args, optional: bool = False, context=None, instance=None):
         exprs = [smart_wrap(a) for a in args]
         ptr = PointerExpression(self, *exprs, optional=optional, instance=instance)
+        if context is None:
+            arg_tables: set = set()
+            for e in exprs:
+                collect_tables(e, arg_tables)
+            if instance is not None:
+                collect_tables(smart_wrap(instance), arg_tables)
+            if not arg_tables:
+                # constant-only key (incl. the zero-arg broadcast form):
+                # the lookup's row set is the ENCLOSING select/reduce
+                # table, only known at desugar time (reference: table.py
+                # ix context=thisclass.this delayed op)
+                from pathway_tpu.internals.expression import _DelayedIxTable
+
+                return _DelayedIxTable(self, ptr, optional)
         return self.ix(ptr, optional=optional, context=context)
 
     # -- misc -------------------------------------------------------------
@@ -1451,6 +1517,11 @@ class Table:
         if not refs:
             raise ValueError(
                 "Table.from_columns() cannot have empty arguments list"
+            )
+        names = [r.name for r in args] + list(kwargs.keys())
+        if len(set(names)) != len(names):
+            raise ValueError(
+                "Table.from_columns() got duplicate output column names"
             )
         tables = {id(r._table): r._table for r in refs}
         base = refs[0]._table

@@ -45,7 +45,9 @@ class ThisMetaclass(type):
         return _ThisWithout(cls, columns)
 
     def __iter__(cls):
-        raise TypeError("pw.this is not iterable at definition time")
+        # `select(*pw.this)` — expands to every context column at
+        # desugar time (reference: test_common.py test_wildcard_basic)
+        yield _ThisAll(cls)
 
     def __repr__(cls):
         return f"<{cls.__name__}>"
@@ -63,6 +65,13 @@ class right(metaclass=ThisMetaclass):
     """`pw.right` — the right side of a join."""
 
 
+class _ThisAll:
+    """`*pw.this` used as a select argument — all context columns."""
+
+    def __init__(self, this_cls):
+        self.this_cls = this_cls
+
+
 class _ThisWithout:
     """`pw.this.without(col, ...)` used as a select argument."""
 
@@ -70,11 +79,34 @@ class _ThisWithout:
         self.this_cls = this_cls
         self.columns = [c if isinstance(c, str) else c.name for c in columns]
 
+    def __iter__(self):
+        # `select(*pw.this.without(...))` — the marker itself expands
+        yield self
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in self.columns:
+            raise KeyError(
+                f"column {name!r} was removed by without()"
+            )
+        return ThisColumnReference(self.this_cls, name)
+
 
 class _ThisSlice:
     def __init__(self, this_cls, refs):
         self.this_cls = this_cls
         self.refs = refs
+
+    def __iter__(self):
+        yield self
+
+    def without(self, *columns):
+        drop = {c if isinstance(c, str) else c.name for c in columns}
+        return _ThisSlice(
+            self.this_cls,
+            [r for r in self.refs if r._name not in drop],
+        )
 
 
 def is_this_ref(expr: Any) -> bool:

@@ -197,9 +197,12 @@ def infer_dtype(
         if isinstance(e, PointerExpression):
             return dt.Optionalize(dt.POINTER) if e._optional else dt.POINTER
         if isinstance(e, MethodCallExpression):
-            if e._return_type is not None:
-                base = e._return_type
-            else:
+            base = e._return_type
+            if base is not None and not isinstance(base, dt.DType) and callable(base):
+                # dtype-dependent return (e.g. num.abs: int->int,
+                # float->float)
+                base = base(rec(e._args[0]) if e._args else dt.ANY)
+            if base is None:
                 base = dt.ANY
             if e._propagate_none and e._args and dt.is_optional(rec(e._args[0])):
                 return dt.Optionalize(base)

@@ -17,6 +17,55 @@ from pathway_tpu.internals.expression import (
 )
 
 
+def _scalar_return_type(ret_type):
+    """float/int if the declared return type (possibly Optional) is one."""
+    import types
+    import typing
+
+    origin = typing.get_origin(ret_type)
+    # both Optional[float] and the PEP-604 spelling `float | None`
+    if origin is typing.Union or origin is types.UnionType:
+        args = [a for a in typing.get_args(ret_type) if a is not type(None)]
+        if len(args) == 1:
+            ret_type = args[0]
+    return ret_type if ret_type in (float, int) else None
+
+
+def _coerce_scalar(target, value):
+    if target is float and isinstance(value, int):
+        # bools included: declared float wins, like pw.cast
+        return float(value)
+    if target is int and isinstance(value, bool):
+        return int(value)
+    return value
+
+
+def _coerce_returns(fun, ret_type, *, is_batch: bool, is_async: bool):
+    """Cast returned values to the DECLARED return type (reference:
+    test_udf.py test_cast_on_return — a udf annotated/declared float may
+    return int and the column is still float-valued)."""
+    target = _scalar_return_type(ret_type)
+    if target is None:
+        return fun
+    if is_async:
+
+        @functools.wraps(fun)
+        async def awrapper(*args, **kwargs):
+            out = await fun(*args, **kwargs)
+            return _coerce_scalar(target, out)
+
+        return awrapper
+
+    @functools.wraps(fun)
+    def wrapper(*args, **kwargs):
+        out = fun(*args, **kwargs)
+        if is_batch and isinstance(out, list):
+            return [_coerce_scalar(target, v) for v in out]
+        return _coerce_scalar(target, out)
+
+    return wrapper
+
+
 class Executor:
     def _build_expression(self, udf, fun, args, kwargs) -> ApplyExpression:
         raise NotImplementedError
@@ -25,10 +74,16 @@ class Executor:
 @dataclass
 class SyncExecutor(Executor):
     def _build_expression(self, udf, fun, args, kwargs):
-        wrapped = _apply_cache(udf, fun)
+        ret_type = udf._resolve_return_type(fun)
+        wrapped = _coerce_returns(
+            _apply_cache(udf, fun),
+            ret_type,
+            is_batch=udf.max_batch_size is not None,
+            is_async=False,
+        )
         return ApplyExpression(
             wrapped,
-            udf._resolve_return_type(fun),
+            ret_type,
             *args,
             propagate_none=udf.propagate_none,
             deterministic=udf.deterministic,
@@ -53,9 +108,13 @@ class AsyncExecutor(Executor):
             retry_strategy=self.retry_strategy,
         )(fun)
         afun = _apply_cache(udf, afun, is_async=True)
+        ret_type = udf._resolve_return_type(fun)
+        afun = _coerce_returns(
+            afun, ret_type, is_batch=False, is_async=True
+        )
         return ApplyExpression(
             afun,
-            udf._resolve_return_type(fun),
+            ret_type,
             *args,
             propagate_none=udf.propagate_none,
             deterministic=udf.deterministic,

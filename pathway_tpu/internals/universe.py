@@ -43,6 +43,7 @@ class UniverseSolver:
     def __init__(self):
         self._parent: Dict[Universe, Universe] = {}
         self._subsets: Set[Tuple[int, int]] = set()
+        self._disjoint: Set[Tuple[int, int]] = set()
 
     def _find(self, u: Universe) -> Universe:
         while self._parent.get(u, u) is not u:
@@ -77,6 +78,32 @@ class UniverseSolver:
                     seen.add(p)
                     frontier.append(p)
         return False
+
+    def register_disjoint(self, a: Universe, b: Universe) -> None:
+        ra, rb = self._find(a).id, self._find(b).id
+        self._disjoint.add((ra, rb))
+        self._disjoint.add((rb, ra))
+
+    def _supersets(self, u: Universe) -> Set[int]:
+        """Root ids of u and every registered superset (transitively)."""
+        root = self._find(u).id
+        seen = {root}
+        frontier = [root]
+        while frontier:
+            cur = frontier.pop()
+            for s, p in self._subsets:
+                if s == cur and p not in seen:
+                    seen.add(p)
+                    frontier.append(p)
+        return seen
+
+    def query_are_disjoint(self, a: Universe, b: Universe) -> bool:
+        """True when some registered superset of `a` is known disjoint
+        from some registered superset of `b` (subsets of disjoint sets
+        are disjoint)."""
+        sup_a = self._supersets(a)
+        sup_b = self._supersets(b)
+        return any((x, y) in self._disjoint for x in sup_a for y in sup_b)
 
     def get_intersection(self, *universes: Universe) -> Universe:
         u = Universe(multiset=any(x.multiset for x in universes))

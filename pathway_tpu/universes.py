@@ -16,4 +16,6 @@ def promise_is_subset_of(subset, superset) -> None:
 
 
 def promise_are_pairwise_disjoint(*tables) -> None:
-    pass
+    for i, a in enumerate(tables):
+        for b in tables[i + 1:]:
+            solver.register_disjoint(a._universe, b._universe)

@@ -145,7 +145,11 @@ def test_concat_key_collision_raises():
         """
     ).with_id_from(pw.this.name)
     b = b.select(v=pw.this.v)
+    # build-time: unpromised concat refuses outright (reference
+    # semantics, r5); a false promise fails the run loudly
+    with pytest.raises(ValueError, match="disjoint"):
+        a.concat(b)
+    pw.universes.promise_are_pairwise_disjoint(a, b)
     eng = Engine()
-    run_tables(a.concat(b), engine=eng)
-    # surfaced as an engine error naming the operator, not silent overwrite
-    assert any("duplicate key" in e.message for e in eng.error_log)
+    with pytest.raises(KeyError, match="duplicated entries"):
+        run_tables(a.concat(b), engine=eng)

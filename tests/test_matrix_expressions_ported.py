@@ -1,0 +1,424 @@
+"""Expression-namespace matrix adapted from the reference's
+`tests/expressions/` suites (test_datetimes.py, test_string.py,
+test_numerical.py; reference: python/pathway/tests/expressions/) — the
+same `.dt` / `.str` / `.num` behaviors through pathway_tpu's API
+(VERDICT r4 item 1).
+
+Where possible, expectations come from a python oracle (datetime /
+str methods / math), so every parametrized case checks engine output
+against the host-language ground truth the reference also encodes.
+"""
+
+import datetime as dt
+import math
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import run_tables
+
+
+def _col(table, name="v"):
+    (cap,) = run_tables(table)
+    names = table.column_names()
+    i = names.index(name)
+    return [row[i] for row in cap.state.rows.values()]
+
+
+def _one(table, name="v"):
+    (col,) = _col(table, name)
+    return col
+
+
+def _t_of(value, typ):
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(x=typ), [(value,)]
+    )
+
+
+# ---------------------------------------------------------------------------
+# .dt — datetimes (reference: expressions/test_datetimes.py)
+# ---------------------------------------------------------------------------
+
+_NAIVE = dt.datetime(2023, 5, 15, 10, 51, 4, 123456)
+_UTC = dt.datetime(2023, 5, 15, 10, 51, 4, 123456, tzinfo=dt.timezone.utc)
+
+
+@pytest.mark.parametrize("is_naive", [True, False])
+@pytest.mark.parametrize(
+    "field",
+    ["year", "month", "day", "hour", "minute", "second", "microsecond"],
+)
+def test_date_time_fields_match_python(is_naive, field):
+    value = _NAIVE if is_naive else _UTC
+    t = _t_of(value, dt.datetime)
+    r = t.select(v=getattr(t.x.dt, field)())
+    assert _one(r) == getattr(value, field)
+
+
+@pytest.mark.parametrize("is_naive", [True, False])
+def test_weekday_matches_python(is_naive):
+    value = _NAIVE if is_naive else _UTC
+    t = _t_of(value, dt.datetime)
+    r = t.select(v=t.x.dt.weekday())
+    assert _one(r) == value.weekday()
+
+
+@pytest.mark.parametrize(
+    "unit,expected",
+    [
+        ("weeks", 2),
+        ("days", 16),
+        ("hours", 16 * 24 + 7),
+        ("minutes", (16 * 24 + 7) * 60 + 30),
+        ("seconds", ((16 * 24 + 7) * 60 + 30) * 60 + 5),
+    ],
+)
+def test_duration_units_match_python(unit, expected):
+    delta = dt.timedelta(days=16, hours=7, minutes=30, seconds=5)
+    t = _t_of(delta, dt.timedelta)
+    r = t.select(v=getattr(t.x.dt, unit)())
+    assert _one(r) == expected
+
+
+@pytest.mark.parametrize(
+    "fmt",
+    ["%Y-%m-%d", "%d.%m.%Y %H:%M:%S", "%H:%M:%S.%f", "%Y-%m-%dT%H:%M:%S"],
+)
+def test_strftime_round_trips_with_python(fmt):
+    t = _t_of(_NAIVE, dt.datetime)
+    r = t.select(v=t.x.dt.strftime(fmt))
+    assert _one(r) == _NAIVE.strftime(fmt)
+
+
+@pytest.mark.parametrize(
+    "text,fmt",
+    [
+        ("2023-03-25 12:00:00", "%Y-%m-%d %H:%M:%S"),
+        ("25.03.2023 12:00", "%d.%m.%Y %H:%M"),
+        ("2023-03-25", "%Y-%m-%d"),
+    ],
+)
+def test_strptime_naive_matches_python(text, fmt):
+    t = _t_of(text, str)
+    r = t.select(v=t.x.dt.strptime(fmt))
+    assert _one(r) == dt.datetime.strptime(text, fmt)
+
+
+def test_strptime_wrong_format_is_error():
+    t = _t_of("not-a-date", str)
+    r = t.select(v=t.x.dt.strptime("%Y-%m-%d"))
+    assert repr(_one(r)) == "Error"
+
+
+def test_strftime_with_format_in_column():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=dt.datetime, fmt=str),
+        [(_NAIVE, "%Y"), (_NAIVE, "%m")],
+    )
+    r = t.select(fmt=t.fmt, v=t.x.dt.strftime(t.fmt))
+    got = dict(
+        (row for row in zip(_col(r, "fmt"), _col(r, "v")))
+    )
+    assert got == {"%Y": "2023", "%m": "05"}
+
+
+def test_naive_to_utc_and_back():
+    t = _t_of(_NAIVE, dt.datetime)
+    r = t.select(v=t.x.dt.to_utc("Europe/Paris"))
+    utc_val = _one(r)
+    assert utc_val.tzinfo is not None
+    import zoneinfo
+
+    expected = _NAIVE.replace(
+        tzinfo=zoneinfo.ZoneInfo("Europe/Paris")
+    ).astimezone(dt.timezone.utc)
+    assert utc_val == expected
+    pw.G.clear()
+    t2 = _t_of(utc_val, dt.datetime)
+    r2 = t2.select(v=t2.x.dt.to_naive_in_timezone("Europe/Paris"))
+    assert _one(r2).replace(tzinfo=None) == _NAIVE
+
+
+def test_timestamp_matches_python():
+    t = _t_of(_UTC, dt.datetime)
+    r = t.select(v=t.x.dt.timestamp(unit="s"))
+    assert _one(r) == pytest.approx(_UTC.timestamp())
+
+
+@pytest.mark.parametrize(
+    "unit,factor", [("s", 1), ("ms", 1e3), ("us", 1e6)]
+)
+def test_from_timestamp_units(unit, factor):
+    epoch = dt.datetime(2023, 5, 15, tzinfo=dt.timezone.utc)
+    stamp = int(epoch.timestamp() * factor)
+    t = _t_of(stamp, int)
+    r = t.select(v=t.x.dt.utc_from_timestamp(unit=unit))
+    assert _one(r) == epoch
+
+
+def test_datetime_arithmetic_with_durations():
+    t = _t_of(_NAIVE, dt.datetime)
+    delta = dt.timedelta(hours=3)
+    r = t.select(
+        plus=t.x + delta,
+        minus=t.x - delta,
+        diff=(t.x + delta) - t.x,
+    )
+    (cap,) = run_tables(r)
+    ((plus, minus, diff),) = cap.state.rows.values()
+    assert plus == _NAIVE + delta
+    assert minus == _NAIVE - delta
+    assert diff == delta
+
+
+def test_datetime_comparison():
+    a = _NAIVE
+    b = _NAIVE + dt.timedelta(seconds=1)
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=dt.datetime, y=dt.datetime), [(a, b)]
+    )
+    r = t.select(lt=t.x < t.y, ge=t.x >= t.y, eq=t.x == t.x)
+    (cap,) = run_tables(r)
+    assert list(cap.state.rows.values()) == [(True, False, True)]
+
+
+# ---------------------------------------------------------------------------
+# .str — strings (reference: expressions/test_string.py)
+# ---------------------------------------------------------------------------
+
+_STR_CASES = [
+    ("upper", (), "MiXeD"),
+    ("lower", (), "MiXeD"),
+    ("strip", (), "  pad  "),
+    ("lstrip", (), "  pad"),
+    ("rstrip", ("d",), "pad"),
+    ("title", (), "a tale"),
+    ("swapcase", (), "MiXeD"),
+    ("count", ("a",), "banana"),
+    ("find", ("na",), "banana"),
+    ("rfind", ("na",), "banana"),
+    ("startswith", ("ba",), "banana"),
+    ("endswith", ("na",), "banana"),
+    ("replace", ("na", "NA"), "banana"),
+]
+
+
+@pytest.mark.parametrize(
+    "method,args,value", _STR_CASES, ids=[c[0] for c in _STR_CASES]
+)
+def test_str_methods_match_python(method, args, value):
+    t = _t_of(value, str)
+    r = t.select(v=getattr(t.x.str, method)(*args))
+    assert _one(r) == getattr(value, method)(*args)
+
+
+def test_str_len_and_reversed():
+    t = _t_of("hello", str)
+    r = t.select(n=t.x.str.len(), rev=t.x.str.reversed())
+    (cap,) = run_tables(r)
+    assert list(cap.state.rows.values()) == [(5, "olleh")]
+
+
+def test_str_slice():
+    t = _t_of("abcdef", str)
+    r = t.select(v=t.x.str.slice(1, 4))
+    assert _one(r) == "bcd"
+
+
+def test_str_split_produces_tuple():
+    t = _t_of("a,b,c", str)
+    r = t.select(v=t.x.str.split(","))
+    assert tuple(_one(r)) == ("a", "b", "c")
+
+
+@pytest.mark.parametrize(
+    "text,expected", [("12", 12), ("-7", -7), ("0", 0)]
+)
+def test_parse_int(text, expected):
+    t = _t_of(text, str)
+    assert _one(t.select(v=t.x.str.parse_int())) == expected
+
+
+def test_parse_int_garbage_is_error():
+    t = _t_of("xyz", str)
+    assert repr(_one(t.select(v=t.x.str.parse_int()))) == "Error"
+
+
+@pytest.mark.parametrize(
+    "text,expected", [("1.5", 1.5), ("-0.25", -0.25), ("3", 3.0)]
+)
+def test_parse_float(text, expected):
+    t = _t_of(text, str)
+    assert _one(t.select(v=t.x.str.parse_float())) == expected
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [("true", True), ("1", True), ("false", False), ("0", False)],
+)
+def test_parse_bool_default_mapping(text, expected):
+    t = _t_of(text, str)
+    assert _one(t.select(v=t.x.str.parse_bool())) is expected
+
+
+def test_parse_bool_custom_mapping():
+    t = _t_of("si", str)
+    r = t.select(
+        v=t.x.str.parse_bool(
+            true_values=["si"], false_values=["no"]
+        )
+    )
+    assert _one(r) is True
+
+
+def test_to_string_of_values():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int, b=float, c=bool),
+        [(5, 2.5, True)],
+    )
+    r = t.select(
+        sa=t.a.to_string(), sb=t.b.to_string(), sc=t.c.to_string()
+    )
+    (cap,) = run_tables(r)
+    ((sa, sb, sc),) = cap.state.rows.values()
+    assert (sa, sb, sc) == ("5", "2.5", "True")
+
+
+# ---------------------------------------------------------------------------
+# .num — numerics (reference: expressions/test_numerical.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("value", [-3, 3])
+def test_abs_int(value):
+    t = _t_of(value, int)
+    r = t.select(v=t.x.num.abs())
+    assert _one(r) == abs(value)
+    assert r.typehints()["v"] is int
+
+
+@pytest.mark.parametrize("value", [-2.5, 2.5])
+def test_abs_float(value):
+    t = _t_of(value, float)
+    assert _one(t.select(v=t.x.num.abs())) == abs(value)
+
+
+@pytest.mark.parametrize(
+    "fn,value",
+    [
+        ("floor", 2.7),
+        ("ceil", 2.1),
+        ("trunc", -2.7),
+        ("sqrt", 9.0),
+        ("exp", 1.0),
+        ("log", math.e),
+        ("sin", 0.5),
+        ("cos", 0.5),
+        ("tan", 0.3),
+    ],
+)
+def test_num_functions_match_math(fn, value):
+    t = _t_of(value, float)
+    r = t.select(v=getattr(t.x.num, fn)())
+    expected = getattr(math, fn)(value)
+    assert _one(r) == pytest.approx(expected)
+
+
+def test_round_with_precision():
+    t = _t_of(2.7182818, float)
+    r = t.select(a=t.x.num.round(), b=t.x.num.round(2))
+    (cap,) = run_tables(r)
+    ((a, b),) = cap.state.rows.values()
+    assert (a, b) == (round(2.7182818), round(2.7182818, 2))
+
+
+def test_isnan_isinf():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=float),
+        [(float("nan"),), (float("inf"),), (1.0,)],
+    )
+    r = t.select(nan=t.x.num.isnan(), inf=t.x.num.isinf())
+    got = set(map(tuple, run_tables(r)[0].state.rows.values()))
+    assert got == {(True, False), (False, True), (False, False)}
+
+
+def test_fill_na_on_optional():
+    from typing import Optional
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=Optional[float]),
+        [(1.5,), (None,)],
+    )
+    r = t.select(v=t.x.num.fill_na(0.0))
+    assert sorted(_col(r)) == [0.0, 1.5]
+
+
+def test_fill_na_on_nan():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=float),
+        [(float("nan"),), (2.0,)],
+    )
+    r = t.select(v=t.x.num.fill_na(-1.0))
+    assert sorted(_col(r)) == [-1.0, 2.0]
+
+
+# ---------------------------------------------------------------------------
+# dtype lattice (reference: test_dtypes.py)
+# ---------------------------------------------------------------------------
+
+
+def test_dtype_wrap_identities():
+    from pathway_tpu.internals import dtype as dtm
+
+    for hint, expected in [
+        (int, dtm.INT),
+        (float, dtm.FLOAT),
+        (bool, dtm.BOOL),
+        (str, dtm.STR),
+        (bytes, dtm.BYTES),
+    ]:
+        assert dtm.wrap(hint) is expected
+        # wrap is idempotent
+        assert dtm.wrap(dtm.wrap(hint)) is expected
+
+
+def test_dtype_lca_matrix():
+    from pathway_tpu.internals import dtype as dtm
+
+    assert dtm.types_lca(dtm.INT, dtm.FLOAT) is dtm.FLOAT
+    assert dtm.types_lca(dtm.BOOL, dtm.INT) in (dtm.INT, dtm.ANY)
+    assert dtm.types_lca(dtm.INT, dtm.INT) is dtm.INT
+    # unrelated types meet at ANY
+    assert dtm.types_lca(dtm.STR, dtm.INT) is dtm.ANY
+
+
+def test_dtype_optional_absorption():
+    from typing import Optional
+
+    from pathway_tpu.internals import dtype as dtm
+
+    o = dtm.wrap(Optional[int])
+    assert dtm.unoptionalize(o) is dtm.INT
+    # Optional[Optional[int]] collapses
+    assert dtm.wrap(Optional[Optional[int]]) == o
+
+
+def test_schema_inference_through_operations():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int, b=float, s=str),
+        [(1, 0.5, "x")],
+    )
+    r = t.select(
+        add=t.a + t.b,      # int + float -> float
+        div=t.a / t.a,      # int / int -> float
+        fdiv=t.a // t.a,    # int // int -> int
+        cmp=t.a > t.b,      # -> bool
+        cat=t.s + t.s,      # -> str
+    )
+    hints = r.typehints()
+    assert hints["add"] is float
+    assert hints["div"] is float
+    assert hints["fdiv"] is int
+    assert hints["cmp"] is bool
+    assert hints["cat"] is str

@@ -131,6 +131,9 @@ def test_universe_union_of_disjoint_concat():
     )
     a = base.filter(pw.this.k == "a")
     b = base.filter(pw.this.k == "b")
+    # disjoint predicates are not provable statically — promise it, like
+    # the reference requires
+    pw.universes.promise_are_pairwise_disjoint(a, b)
     c = a.concat(b)
     assert {r[0] for r in _rows(c)} == {"a", "b"}
     # the concat result joins against either parent by key semantics

@@ -47,7 +47,12 @@ def test_windowby_sliding():
     assert starts == [2, 4]
 
 
-def test_concat_nonowner_retraction_ignored():
+def test_concat_duplicate_insert_fails_loudly():
+    """r5: a key inserted by two concat inputs is a broken disjointness
+    promise — the run fails with the reference's duplicated-entries error
+    instead of silently keeping the first writer."""
+    import pytest
+
     t1 = table_from_markdown(
         """
         id | a
@@ -61,9 +66,10 @@ def test_concat_nonowner_retraction_ignored():
         1  | 99 | 4       | -1
         """
     )
+    pw.universes.promise_are_pairwise_disjoint(t1, t2)
     result = t1.concat(t2)
-    rows = _rows(result)
-    assert rows == [(10,)]
+    with pytest.raises(KeyError, match="duplicated entries"):
+        _rows(result)
 
 
 def test_filter_accepts_numpy_bool():
