@@ -39,6 +39,14 @@ def desugar(expr: Any, mapping: Dict[Any, Any]) -> ColumnExpression:
                     "ix_ref with constant keys needs an enclosing "
                     "select/reduce to provide its row context"
                 )
+            if not hasattr(context, "_universe"):
+                # join/grouped contexts resolve `this` to a proxy, not a
+                # Table — fail clearly instead of crashing downstream
+                raise ValueError(
+                    "ix_ref with constant keys is not supported inside "
+                    "join or groupby expressions; select the looked-up "
+                    "value onto a table first"
+                )
             ptr = node._ptr
             bound = PointerExpression(
                 node._target,

@@ -140,6 +140,8 @@ class GroupedTable:
                 key = (id(ref._table), ref._name)
                 if key not in gen:
                     name = f"_pw_fx{len(gen)}"
+                    while name in helper_cols:  # user column collision
+                        name = "_" + name
                     gen[key] = name
                     helper_cols[name] = ref
                 return gen[key]

@@ -136,9 +136,13 @@ class FullyAsyncExecutor(Executor):
         afun = coerce_async(fun)
         if self.capacity is not None:
             afun = _with_capacity(afun, self.capacity)
+        ret_type = udf._resolve_return_type(fun)
+        afun = _coerce_returns(
+            afun, ret_type, is_batch=False, is_async=True
+        )
         expr = FullyAsyncApplyExpression(
             afun,
-            udf._resolve_return_type(fun),
+            ret_type,
             *args,
             propagate_none=udf.propagate_none,
             deterministic=udf.deterministic,

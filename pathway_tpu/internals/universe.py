@@ -43,7 +43,10 @@ class UniverseSolver:
     def __init__(self):
         self._parent: Dict[Universe, Universe] = {}
         self._subsets: Set[Tuple[int, int]] = set()
-        self._disjoint: Set[Tuple[int, int]] = set()
+        # disjointness facts keep the ORIGINAL universe objects: roots
+        # are recomputed at query time, so a later register_equal merge
+        # cannot orphan a fact registered against a pre-merge root
+        self._disjoint_facts: list = []
 
     def _find(self, u: Universe) -> Universe:
         while self._parent.get(u, u) is not u:
@@ -80,9 +83,7 @@ class UniverseSolver:
         return False
 
     def register_disjoint(self, a: Universe, b: Universe) -> None:
-        ra, rb = self._find(a).id, self._find(b).id
-        self._disjoint.add((ra, rb))
-        self._disjoint.add((rb, ra))
+        self._disjoint_facts.append((a, b))
 
     def _supersets(self, u: Universe) -> Set[int]:
         """Root ids of u and every registered superset (transitively)."""
@@ -100,10 +101,17 @@ class UniverseSolver:
     def query_are_disjoint(self, a: Universe, b: Universe) -> bool:
         """True when some registered superset of `a` is known disjoint
         from some registered superset of `b` (subsets of disjoint sets
-        are disjoint)."""
+        are disjoint). Fact roots are resolved NOW, surviving merges
+        registered after the fact."""
         sup_a = self._supersets(a)
         sup_b = self._supersets(b)
-        return any((x, y) in self._disjoint for x in sup_a for y in sup_b)
+        for x, y in self._disjoint_facts:
+            rx, ry = self._find(x).id, self._find(y).id
+            if (rx in sup_a and ry in sup_b) or (
+                ry in sup_a and rx in sup_b
+            ):
+                return True
+        return False
 
     def get_intersection(self, *universes: Universe) -> Universe:
         u = Universe(multiset=any(x.multiset for x in universes))

@@ -69,10 +69,12 @@ class LiveSource:
         *,
         exclusive: bool = False,
         exclusive_worker: int = 0,
+        partitioned: bool = False,
     ):
         self.subject_factory = subject_factory
         self.schema = schema
         self.name = name
+        self.partitioned = partitioned
         self.node = None  # set at build time
         self.sync_group = None  # set by register_input_synchronization_group
         self.sync_column = None
@@ -114,6 +116,7 @@ def connector_table(
         name,
         exclusive=exclusive,
         exclusive_worker=exclusive_worker,
+        partitioned=partitioned,
     )
     live.gated_commits = gated_commits
 
@@ -278,6 +281,9 @@ class ConnectorSubjectBase:
     """Base for python connector subjects (reference:
     io/python/__init__.py:47 ConnectorSubject): background thread calling
     next()/commit()/close()."""
+
+    _worker_id = 0
+    _worker_count = 1
 
     def __init__(self):
         self._sink = None
@@ -594,7 +600,22 @@ class StreamingDriver:
                 # source routes rows to their shard owners
                 continue
             subject = live.subject_factory()
+            # partitioned subjects divide the input among workers by
+            # these coordinates (fs partitioned file ownership, kafka
+            # consumer-group analogue)
+            subject._worker_id = my_worker
+            subject._worker_count = self.engine.worker_count
             sink = _QueueSink(self.queue, live)
+            if live.partitioned and self.engine.worker_count > 1:
+                # each worker reads DIFFERENT rows, so generated sequence
+                # keys must be globally unique — salt the seed per worker
+                # (replicated sources need the OPPOSITE: identical seeds,
+                # because every worker re-reads the same rows)
+                from pathway_tpu.engine.value import seq_key_seed
+
+                sink._seed = seq_key_seed(
+                    "live", f"{live.name}@w{my_worker}"
+                )
             sink.subject = subject
             sink.persistence_enabled = self.persistence_config is not None
             subject._bind(sink)

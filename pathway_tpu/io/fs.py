@@ -10,6 +10,7 @@ import glob as glob_mod
 import json
 import os
 import time as time_mod
+import zlib
 from typing import Any, Dict, List, Optional
 
 from pathway_tpu.internals import dtype as dt
@@ -77,6 +78,7 @@ class _FsSubject(ConnectorSubjectBase):
         object_pattern: str = "*",
         batch_per_file: bool = False,
         csv_settings: "CsvParserSettings | None" = None,
+        partitioned: bool = False,
     ):
         super().__init__()
         self.path = path
@@ -88,7 +90,21 @@ class _FsSubject(ConnectorSubjectBase):
         self.object_pattern = object_pattern
         self.batch_per_file = batch_per_file
         self.csv_settings = csv_settings
+        self.partitioned = partitioned
         self._seen: Dict[str, float] = {}
+
+    def _owns(self, f: str) -> bool:
+        """Partitioned reads: files are divided among workers by a stable
+        name hash, so each worker PARSES a disjoint subset (reference:
+        partitioned source mode — kafka consumer groups; for files this
+        removes the replicated-parse bottleneck of the default mode)."""
+        if not self.partitioned:
+            return True
+        wc = getattr(self, "_worker_count", 1)
+        if wc <= 1:
+            return True
+        wid = getattr(self, "_worker_id", 0)
+        return zlib.crc32(os.path.basename(f).encode()) % wc == wid
 
     def _list_files(self) -> List[str]:
         p = self.path
@@ -97,7 +113,9 @@ class _FsSubject(ConnectorSubjectBase):
             files = glob_mod.glob(pattern, recursive=True)
         else:
             files = glob_mod.glob(p, recursive=True)
-        return sorted(f for f in files if os.path.isfile(f))
+        return sorted(
+            f for f in files if os.path.isfile(f) and self._owns(f)
+        )
 
     def _metadata(self, f: str):
         from pathway_tpu.engine.value import Json
@@ -317,6 +335,7 @@ def read(
     name: str | None = None,
     refresh_interval: float = 1.0,
     batch_per_file: bool = False,
+    partitioned: bool = False,
     **kwargs,
 ):
     """Read files as a table (reference: io/fs read; StorageType PosixLike /
@@ -349,6 +368,7 @@ def read(
             object_pattern=object_pattern,
             batch_per_file=batch_per_file,
             csv_settings=csv_settings,
+            partitioned=partitioned,
         )
 
     return connector_table(
@@ -356,6 +376,7 @@ def read(
         factory,
         mode=mode,
         name=name,
+        partitioned=partitioned,
         gated_commits=batch_per_file,
     )
 

@@ -56,9 +56,17 @@ def init_decoder_params(rng, config: DecoderConfig) -> Dict[str, Any]:
     kv_dim = config.kv_heads * hd
     keys = jax.random.split(rng, 2 + config.layers)
     scale = 0.02
+    # store params in the config dtype: a 7B-class config in bf16 is
+    # 14 GB and fits a single v5e; float32 storage would not (the
+    # forward already computes in config.dtype either way)
+    param_dtype = (
+        jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    )
 
     def dense(key, shape):
-        return jax.random.normal(key, shape, dtype=jnp.float32) * scale
+        return (
+            jax.random.normal(key, shape, dtype=jnp.float32) * scale
+        ).astype(param_dtype)
 
     params: Dict[str, Any] = {
         "embed": dense(keys[0], (config.vocab_size, h)),

@@ -1204,3 +1204,67 @@ def test_ndarray_reducer_stacks():
     r = t.groupby(t.g).reduce(t.g, arr=pw.reducers.ndarray(t.v))
     ((_, arr),) = _rows_plain(r)
     assert sorted(np.asarray(arr).tolist()) == [1, 2]
+
+
+# -- review-found edge cases (r5, second pass) ------------------------------
+
+
+def test_disjoint_promise_survives_later_equal_merge():
+    t1 = T(
+        """
+        id | v
+        1  | 10
+        """
+    )
+    t2 = T(
+        """
+        id | v
+        2  | 20
+        """
+    )
+    t3 = T(
+        """
+        id | w
+        1  | 0
+        """
+    )
+    pw.universes.promise_are_pairwise_disjoint(t1, t2)
+    # merging t1's universe with t3's AFTER the promise must not orphan it
+    t1.promise_universe_is_equal_to(t3)
+    assert sorted(v for (v,) in _rows_plain(t1.concat(t2))) == [10, 20]
+
+
+def test_const_ix_ref_in_join_context_fails_clearly():
+    kv = T(
+        """
+        k | v
+        a | 1
+        """
+    ).with_id_from(pw.this.k)
+    t = T(
+        """
+        k
+        a
+        """
+    )
+    u = T(
+        """
+        k
+        a
+        """
+    )
+    with pytest.raises(ValueError, match="join or groupby"):
+        t.join(u, t.k == u.k).select(w=kv.ix_ref("a").v)
+
+
+def test_groupby_foreign_absorb_does_not_clobber_user_column():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(g=str, _pw_fx0=int), [("a", 1), ("a", 2)]
+    )
+    flags = t.select(extra=t._pw_fx0 * 100)
+    r = t.groupby(t.g).reduce(
+        t.g,
+        own=pw.reducers.sum(t._pw_fx0),
+        foreign=pw.reducers.sum(flags.extra),
+    )
+    assert _rows_plain(r) == [("a", 3, 300)]

@@ -499,3 +499,18 @@ def test_udf_pep604_optional_return_type_coerces():
     )
     ((v,),) = _rows_plain(t.select(v=f(t.a)))
     assert v == 6.0 and isinstance(v, float)
+
+
+def test_fully_async_udf_return_type_coerces():
+    @pw.udf(executor=pw.udfs.fully_async_executor())
+    async def g(x: int) -> float:
+        return x + 1  # int body, declared float
+
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    ((v,),) = _rows_plain(t.select(v=g(t.a)).await_futures())
+    assert v == 2.0 and isinstance(v, float)
