@@ -446,7 +446,7 @@ def _generation_facts() -> dict:
         proc = subprocess.run(
             [sys.executable, script],
             capture_output=True,
-            timeout=1500,
+            timeout=900,
             text=True,
         )
         line = proc.stdout.strip().splitlines()[-1]

@@ -673,8 +673,20 @@ class FlattenNode(Node):
                 continue
             if seq is None:
                 continue
-            if isinstance(seq, str):
-                elements: Any = list(seq)
+            from pathway_tpu.engine.value import Json
+
+            if isinstance(seq, Json):
+                # only Json ARRAYS flatten; a dict would iterate raw str
+                # keys under a Json-typed column (reference treats
+                # non-array Json as an error row)
+                if not isinstance(seq.value, list):
+                    self.log_error(
+                        f"flatten: Json value is not an array: {seq!r}"
+                    )
+                    continue
+                elements: Any = [Json(v) for v in seq.value]
+            elif isinstance(seq, str):
+                elements = list(seq)
             else:
                 try:
                     elements = list(seq)

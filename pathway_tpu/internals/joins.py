@@ -41,6 +41,26 @@ from pathway_tpu.internals.schema import ColumnSchema, schema_from_columns
 from pathway_tpu.internals.universe import Universe
 
 
+def split_equality_condition(cond, left, right):
+    """A desugared join condition must be `left_expr == right_expr`;
+    returns (left_side, right_side) regardless of written order. Shared
+    by JoinResult and the temporal joins so validation cannot drift."""
+    if not (isinstance(cond, BinaryOpExpression) and cond._op == "=="):
+        raise TypeError(
+            "join conditions must be equalities like t1.a == t2.b"
+        )
+    a, b = cond._left, cond._right
+    a_tables = collect_tables(a, set())
+    b_tables = collect_tables(b, set())
+    if a_tables <= {left} and b_tables <= {right}:
+        return a, b
+    if a_tables <= {right} and b_tables <= {left}:
+        return b, a
+    raise ValueError(
+        "each join condition side must reference only one table"
+    )
+
+
 class JoinMode(enum.Enum):
     INNER = "inner"
     LEFT = "left"
@@ -79,26 +99,9 @@ class JoinResult:
         self._on_right: List[ColumnExpression] = []
         for cond in on:
             cond = self._apply_remap(desugar(cond, mapping))
-            if not (
-                isinstance(cond, BinaryOpExpression) and cond._op == "=="
-            ):
-                raise TypeError(
-                    "join conditions must be equalities like "
-                    "t1.a == t2.b"
-                )
-            a, b = cond._left, cond._right
-            a_tables = collect_tables(a, set())
-            b_tables = collect_tables(b, set())
-            if a_tables <= {left} and b_tables <= {right}:
-                self._on_left.append(a)
-                self._on_right.append(b)
-            elif a_tables <= {right} and b_tables <= {left}:
-                self._on_left.append(b)
-                self._on_right.append(a)
-            else:
-                raise ValueError(
-                    "each join condition side must reference only one table"
-                )
+            a, b = split_equality_condition(cond, left, right)
+            self._on_left.append(a)
+            self._on_right.append(b)
         # id= parameter: result rows keyed by one side's id
         self._id_mode = "both"
         if id_expr is not None:

@@ -984,6 +984,10 @@ class Table:
                     dtype = out
                 elif core is dt.STR:
                     dtype = dt.STR
+                elif core is dt.JSON:
+                    # a Json array flattens to Json elements (reference:
+                    # test_json.py test_json_flatten)
+                    dtype = dt.JSON
                 elif isinstance(core, dt.ArrayDType) or core is dt.ANY:
                     dtype = dt.ANY
                 else:

@@ -32,6 +32,12 @@ class Interval:
 
 
 def interval(lower_bound, upper_bound) -> Interval:
+    if upper_bound < lower_bound:
+        # reference: temporal/utils raises on an empty interval spec
+        raise ValueError(
+            f"interval(): lower_bound {lower_bound!r} exceeds "
+            f"upper_bound {upper_bound!r}"
+        )
     return Interval(lower_bound, upper_bound)
 
 

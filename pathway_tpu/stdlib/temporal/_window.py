@@ -129,6 +129,36 @@ class WindowedTable:
         return grouped.reduce(*new_args, **new_kwargs)
 
 
+def _check_time_window_types(table: Table, time_e, window) -> None:
+    """Numeric time columns need numeric durations; datetime columns need
+    timedeltas (reference: temporal/utils.py check_joint_types — mismatch
+    is a BUILD-time TypeError, not silent Error rows)."""
+    import datetime as _dt_mod
+
+    try:
+        time_dtype = table.eval_type(time_e)
+    except Exception:  # noqa: BLE001 — untyped expressions skip the gate
+        return
+    durations = [
+        getattr(window, attr, None)
+        for attr in ("duration", "hop", "max_gap", "lower_bound", "upper_bound")
+    ]
+    durations = [d for d in durations if d is not None and not callable(d)]
+    core = dt.unoptionalize(time_dtype)
+    for d in durations:
+        is_delta = isinstance(d, _dt_mod.timedelta)
+        if core in (dt.INT, dt.FLOAT) and is_delta:
+            raise TypeError(
+                f"window duration {d!r} is a timedelta but the time "
+                f"column is {core}; use a number"
+            )
+        if core in (dt.DATE_TIME_NAIVE, dt.DATE_TIME_UTC) and not is_delta:
+            raise TypeError(
+                f"window duration {d!r} is a number but the time column "
+                f"is {core}; use a datetime.timedelta"
+            )
+
+
 def _remap_by_name(expr, target: Table):
     """Rebind column references onto `target` by column name (columns
     survive flatten/with_columns under their names)."""
@@ -272,6 +302,7 @@ def windowby(
     mapping = {thisclass.this: table}
     time_e = desugar(time_expr, mapping)
     instance_e = desugar(instance, mapping) if instance is not None else None
+    _check_time_window_types(table, time_e, window)
 
     if isinstance(window, (TumblingWindow, SlidingWindow)):
         assign = window.assign
@@ -285,8 +316,13 @@ def windowby(
             "_pw_window_end": flat._pw_window.get(1),
         }
         if instance_e is not None:
-            # instance columns survive flatten under their original name
-            cols["_pw_instance"] = desugar(instance, {thisclass.this: flat})
+            # instance columns survive flatten under their original name;
+            # remap BOTH pw.this and concrete-table references onto the
+            # flattened row set (a concrete t.g ref would otherwise dangle
+            # on the pre-flatten universe and read None)
+            cols["_pw_instance"] = _remap_by_name(
+                desugar(instance, {thisclass.this: flat}), flat
+            )
         flat2 = flat.with_columns(**cols)
         if behavior is not None:
             flat2 = _apply_behavior(
@@ -467,10 +503,15 @@ def _intervals_over_windowby(
         for name, c in cols.items()
     }
     out_cols["_pw_window"] = ColumnSchema(name="_pw_window", dtype=dt.ANY)
+    # the reference exposes the interval's at-point as
+    # `_pw_window_location` (stdlib/temporal/_window.py intervals_over)
+    out_cols["_pw_window_location"] = ColumnSchema(
+        name="_pw_window_location", dtype=dt.ANY
+    )
     flat = Table(
         schema=schema_from_columns(out_cols), universe=Universe(), build=build
     )
-    return WindowedTable(flat, ["_pw_window"], table)
+    return WindowedTable(flat, ["_pw_window", "_pw_window_location"], table)
 
 
 class IntervalsOverNode(Node):
@@ -550,11 +591,12 @@ class IntervalsOverNode(Node):
                 ]
                 if members:
                     for k, row in members:
-                        new_rows[ref_scalar(ak, k)] = (*row, (av,))
+                        new_rows[ref_scalar(ak, k)] = (*row, (av,), av)
                 elif self.is_outer:
                     new_rows[ref_scalar(ak, None)] = (
                         *(None,) * self.data_width,
                         (av,),
+                        av,
                     )
             self.cache.diff(ak, new_rows, out)
         self.emit(time, out)

@@ -12,12 +12,20 @@ from pathway_tpu.internals.desugaring import desugar
 from pathway_tpu.internals.expression import ApplyExpression
 from pathway_tpu.internals.joins import JoinMode, JoinResult
 from pathway_tpu.internals.table import Table
-from pathway_tpu.stdlib.temporal._window import SlidingWindow, TumblingWindow, Window
+from pathway_tpu.stdlib.temporal._window import (
+    SessionWindow,
+    SlidingWindow,
+    TumblingWindow,
+    Window,
+)
 
 
 def _with_windows(table: Table, time_expr, window: Window, prefix: str) -> Table:
+    from pathway_tpu.stdlib.temporal._window import _check_time_window_types
+
     mapping = {thisclass.this: table}
     time_e = desugar(time_expr, mapping)
+    _check_time_window_types(table, time_e, window)
     if not isinstance(window, (TumblingWindow, SlidingWindow)):
         raise TypeError("window_join supports tumbling/sliding windows")
     assign = window.assign
@@ -86,14 +94,86 @@ def window_join(
     """
     if isinstance(how, str):
         how = JoinMode[how.upper()]
-    left_flat = _with_windows(self, self_time, window, "_pw_l")
-    right_flat = _with_windows(other, other_time, window, "_pw_r")
+    if isinstance(window, SessionWindow):
+        left_flat, right_flat = _session_sides(
+            self, other, self_time, other_time, window, on
+        )
+    else:
+        left_flat = _with_windows(self, self_time, window, "_pw_l")
+        right_flat = _with_windows(other, other_time, window, "_pw_r")
     conds = [left_flat["_pw_lwindow"] == right_flat["_pw_rwindow"]]
-    mapping = {thisclass.left: left_flat, thisclass.right: right_flat}
     for cond in on:
         conds.append(_remap_sides(cond, self, other, left_flat, right_flat))
     jr = JoinResult(left_flat, right_flat, tuple(conds), mode=how)
     return WindowJoinResult(left_flat, right_flat, jr, self, other)
+
+
+def _session_sides(left, right, left_time, right_time, window, on):
+    """Session windows for a join are computed over the UNION of both
+    sides' times (per join-key instance): rows whose session ids match
+    then pair in the ordinary equi-join (reference:
+    stdlib/temporal/_window_join.py session handling)."""
+    from pathway_tpu.internals.expression import MakeTupleExpression
+    from pathway_tpu.internals.joins import split_equality_condition
+    from pathway_tpu.internals.reducers import reducers
+    from pathway_tpu.stdlib.temporal._window import windowby
+
+    lt_e = desugar(left_time, {thisclass.this: left})
+    rt_e = desugar(right_time, {thisclass.this: right})
+    lons, rons = [], []
+    for cond in on:
+        c = desugar(
+            cond,
+            {
+                thisclass.left: left,
+                thisclass.right: right,
+                thisclass.this: left,
+            },
+        )
+        a, b = split_equality_condition(c, left, right)
+        lons.append(a)
+        rons.append(b)
+
+    def union_side(tab, t_e, key_exprs):
+        cols = {"_pw_t": t_e}
+        if key_exprs:
+            cols["_pw_i"] = MakeTupleExpression(*key_exprs)
+        return tab.select(**cols)
+
+    union = union_side(left, lt_e, lons).concat_reindex(
+        union_side(right, rt_e, rons)
+    )
+    win = windowby(
+        union,
+        union._pw_t,
+        window=window,
+        instance=union._pw_i if lons else None,
+    )
+    sess = win._flat  # one row per union row, with session start/end
+    gb = [sess._pw_t] + ([sess._pw_instance] if lons else [])
+    key_map = sess.groupby(*gb).reduce(
+        *gb,
+        _pw_s=reducers.any(sess._pw_window_start),
+        _pw_e=reducers.any(sess._pw_window_end),
+    )
+
+    def flat_side(tab, t_e, key_exprs, prefix):
+        conds = [t_e == key_map._pw_t]
+        if key_exprs:
+            conds.append(MakeTupleExpression(*key_exprs) == key_map._pw_instance)
+        return tab.join(key_map, *conds).select(
+            *[tab[c] for c in tab.column_names()],
+            **{
+                f"{prefix}window": MakeTupleExpression(
+                    key_map._pw_s, key_map._pw_e
+                )
+            },
+        )
+
+    return (
+        flat_side(left, lt_e, lons, "_pw_l"),
+        flat_side(right, rt_e, rons, "_pw_r"),
+    )
 
 
 def _remap_sides(cond, left, right, left_flat, right_flat):

@@ -1,0 +1,307 @@
+"""Graph algorithms + operator-edge matrices adapted from the
+reference's `tests/test_graphs.py` (1,324 LoC) and `tests/test_operators.py`
+(1,476 LoC; reference: python/pathway/tests/) — the same behaviors
+through pathway_tpu's API (VERDICT r4 item 1).
+"""
+
+import datetime as dt
+import operator
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import run_tables
+
+
+def _rows(table):
+    (cap,) = run_tables(table)
+    return sorted(cap.state.rows.values(), key=repr)
+
+
+def _rows_plain(table):
+    (cap,) = run_tables(table)
+    return sorted(cap.state.rows.values())
+
+
+def T(md):
+    return pw.debug.table_from_markdown(md)
+
+
+# ---------------------------------------------------------------------------
+# pagerank (reference: test_graphs.py test_page_rank1/2 + edge cases)
+# ---------------------------------------------------------------------------
+
+
+def _edges(md):
+    e = T(md)
+    return e.select(
+        u=e.pointer_from(pw.this.a), v=e.pointer_from(pw.this.b)
+    )
+
+
+def test_page_rank_symmetric_cycle():
+    from pathway_tpu.stdlib.graphs.pagerank import pagerank
+
+    E = _edges(
+        """
+        a | b
+        x | y
+        y | z
+        z | x
+        """
+    )
+    ranks = [r for (r,) in _rows_plain(pagerank(E, steps=5))]
+    # perfect symmetry: all three ranks equal
+    assert len(ranks) == 3 and len(set(ranks)) == 1
+
+
+def test_page_rank_sink_heavy_node_ranks_highest():
+    from pathway_tpu.stdlib.graphs.pagerank import pagerank
+
+    E = _edges(
+        """
+        a | b
+        x | hub
+        y | hub
+        z | hub
+        hub | x
+        """
+    )
+    r = pagerank(E, steps=10)
+    (cap,) = run_tables(r)
+    ranks = {k: v[0] for k, v in cap.state.rows.items()}
+    probe = T(
+        """
+        a
+        hub
+        y
+        """
+    )
+    keyed = probe.select(a=probe.a, p=probe.pointer_from(pw.this.a))
+    (cap2,) = run_tables(keyed)
+    by_name = {row[0]: row[1] for row in cap2.state.rows.values()}
+    # the hub (in-degree 3) must outrank a pure source like y
+    assert ranks[by_name["hub"]] > ranks[by_name["y"]]
+
+
+def test_page_rank_single_node_no_edges():
+    from pathway_tpu.stdlib.graphs.pagerank import pagerank
+
+    e = T(
+        """
+        a | b
+        x | x
+        """
+    )
+    E = e.select(
+        u=e.pointer_from(pw.this.a), v=e.pointer_from(pw.this.b)
+    )
+    assert len(_rows_plain(pagerank(E, steps=3))) == 1
+
+
+def test_bellman_ford_multi_hop_paths():
+    from pathway_tpu.stdlib.graphs.bellman_ford import bellman_ford
+
+    verts = T(
+        """
+        name | is_source
+        a    | True
+        b    | False
+        c    | False
+        d    | False
+        """
+    ).with_id_from(pw.this.name)
+    e = T(
+        """
+        u | v | w
+        a | b | 1.0
+        b | c | 1.0
+        a | c | 5.0
+        """
+    )
+    E = e.select(
+        u=verts.pointer_from(e.u),
+        v=verts.pointer_from(e.v),
+        dist=e.w,
+    )
+    r = bellman_ford(verts, E)
+    dists = sorted(d for (d,) in _rows_plain(r))
+    # a=0, b=1, c=min(2, 5)=2, d unreachable (inf)
+    assert dists[:3] == [0.0, 1.0, 2.0]
+    assert dists[3] == float("inf")
+
+
+def test_louvain_separates_two_cliques():
+    from pathway_tpu.stdlib.graphs.louvain import louvain_communities
+
+    rows = []
+    for grp, names in (("1", "abc"), ("2", "xyz")):
+        for i in names:
+            for j in names:
+                if i < j:
+                    rows.append((i, j))
+    rows.append(("a", "x"))  # one weak inter-clique edge
+    e = pw.debug.table_from_rows(
+        pw.schema_from_types(a=str, b=str), rows
+    )
+    E = e.select(
+        u=e.pointer_from(pw.this.a),
+        v=e.pointer_from(pw.this.b),
+    )
+    out = louvain_communities(E)
+    (cap,) = run_tables(out)
+    # communities: vertices of each clique share a label; the two
+    # cliques get different labels
+    labels = {}
+    for key, row in cap.state.rows.items():
+        labels.setdefault(row[-1], set()).add(key)
+    sizes = sorted(len(v) for v in labels.values())
+    assert sizes == [3, 3]
+
+
+# ---------------------------------------------------------------------------
+# operator edges (reference: test_operators.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("op", [operator.pow, operator.lshift, operator.rshift])
+def test_int_pow_shift(op):
+    pairs = [(2, 3), (5, 1)]
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int, b=int), pairs
+    )
+    r = t.select(a=t.a, v=op(t.a, t.b))
+    got = {a: v for a, v in _rows_plain(r)}
+    for a, b in pairs:
+        assert got[a] == op(a, b)
+
+
+def test_float_mod_matches_python():
+    pairs = [(7.5, 2.0), (-7.5, 2.0)]
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=float, b=float), pairs
+    )
+    r = t.select(a=t.a, v=t.a % t.b)
+    got = {a: v for a, v in _rows_plain(r)}
+    for a, b in pairs:
+        assert got[a] == a % b
+
+
+def test_pointer_equality_and_order():
+    t = T(
+        """
+        k
+        a
+        b
+        """
+    )
+    p = t.select(
+        x=t.pointer_from(t.k),
+        y=t.pointer_from(t.k),
+    )
+    r = p.select(eq=p.x == p.y, le=p.x <= p.y)
+    assert _rows_plain(r) == [(True, True), (True, True)]
+
+
+def test_duration_arithmetic():
+    d1 = dt.timedelta(hours=2)
+    d2 = dt.timedelta(minutes=30)
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=dt.timedelta, b=dt.timedelta), [(d1, d2)]
+    )
+    r = t.select(
+        s=t.a + t.b,
+        m=t.a - t.b,
+        x2=t.a * 2,
+        ratio=t.a / t.b,
+    )
+    ((s, m, x2, ratio),) = _rows_plain(r)
+    assert s == d1 + d2
+    assert m == d1 - d2
+    assert x2 == d1 * 2
+    assert ratio == d1 / d2
+
+
+def test_duration_div_zero_is_error():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=dt.timedelta, b=int),
+        [(dt.timedelta(hours=1), 0)],
+    )
+    r = t.select(v=t.a / t.b)
+    ((v,),) = _rows(r)
+    assert repr(v) == "Error"
+
+
+def test_datetime_sub_gives_duration():
+    a = dt.datetime(2024, 1, 2, 12)
+    b = dt.datetime(2024, 1, 1, 0)
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=dt.datetime, y=dt.datetime), [(a, b)]
+    )
+    r = t.select(d=t.x - t.y)
+    assert _rows_plain(r) == [(a - b,)]
+
+
+def test_datetime_plus_duration_roundtrip():
+    a = dt.datetime(2024, 1, 1)
+    step = dt.timedelta(days=3, hours=4)
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=dt.datetime), [(a,)]
+    )
+    r = t.select(fwd=t.x + step, back=(t.x + step) - step)
+    assert _rows_plain(r) == [(a + step, a)]
+
+
+@pytest.mark.parametrize("dtype", [int, float])
+def test_matrix_multiplication_2d(dtype):
+    m1 = np.arange(6).reshape(2, 3).astype(dtype)
+    m2 = np.arange(12).reshape(3, 4).astype(dtype)
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=np.ndarray, b=np.ndarray), [(m1, m2)]
+    )
+    r = t.select(m=t.a @ t.b)
+    ((m,),) = _rows_plain(r)
+    assert np.allclose(np.asarray(m), m1 @ m2)
+
+
+def test_matrix_multiplication_2d_by_1d():
+    m = np.arange(6).reshape(2, 3).astype(float)
+    v = np.array([1.0, 2.0, 3.0])
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=np.ndarray, b=np.ndarray), [(m, v)]
+    )
+    r = t.select(m=t.a @ t.b)
+    ((out,),) = _rows_plain(r)
+    assert np.allclose(np.asarray(out), m @ v)
+
+
+def test_ndarray_elementwise_ops():
+    a = np.array([1.0, 2.0])
+    b = np.array([10.0, 20.0])
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=np.ndarray, b=np.ndarray), [(a, b)]
+    )
+    r = t.select(s=t.a + t.b, p=t.a * t.b)
+    ((s, p),) = _rows_plain(r)
+    assert np.allclose(np.asarray(s), a + b)
+    assert np.allclose(np.asarray(p), a * b)
+
+
+def test_string_comparison_ordering():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=str, b=str),
+        [("apple", "banana"), ("pear", "pear")],
+    )
+    r = t.select(a=t.a, lt=t.a < t.b, ge=t.a >= t.b)
+    got = {a: (lt, ge) for a, lt, ge in _rows_plain(r)}
+    assert got["apple"] == (True, False)
+    assert got["pear"] == (False, True)
+
+
+def test_bool_comparison_false_lt_true():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=bool, b=bool), [(False, True)]
+    )
+    r = t.select(lt=t.a < t.b)
+    assert _rows_plain(r) == [(True,)]

@@ -1,0 +1,242 @@
+"""Interval-join matrix adapted from the reference's
+`tests/temporal/test_interval_joins.py` (reference:
+python/pathway/tests/temporal/) plus a randomized oracle cross-check —
+the same behaviors through pathway_tpu's API (VERDICT r4 item 1).
+"""
+
+import random
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import run_tables
+
+
+def _rows(table):
+    (cap,) = run_tables(table)
+    return sorted(cap.state.rows.values(), key=repr)
+
+
+def _rows_plain(table):
+    (cap,) = run_tables(table)
+    return sorted(cap.state.rows.values())
+
+
+def T(md):
+    return pw.debug.table_from_markdown(md)
+
+
+def _sides():
+    left = T(
+        """
+        t | a
+        0 | L0
+        4 | L4
+        9 | L9
+        """
+    )
+    right = T(
+        """
+        t | b
+        1 | R1
+        5 | R5
+        20 | R20
+        """
+    )
+    return left, right
+
+
+def _oracle(lrows, rrows, lo, hi, how="inner"):
+    pairs = []
+    matched_l, matched_r = set(), set()
+    for i, (lt, a) in enumerate(lrows):
+        for j, (rt, b) in enumerate(rrows):
+            if lt + lo <= rt <= lt + hi:
+                pairs.append((a, b))
+                matched_l.add(i)
+                matched_r.add(j)
+    if how in ("left", "outer"):
+        for i, (lt, a) in enumerate(lrows):
+            if i not in matched_l:
+                pairs.append((a, None))
+    if how in ("right", "outer"):
+        for j, (rt, b) in enumerate(rrows):
+            if j not in matched_r:
+                pairs.append((None, b))
+    return sorted(pairs, key=repr)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+def test_interval_join_modes_match_oracle(how):
+    left, right = _sides()
+    method = {
+        "inner": left.interval_join,
+        "left": left.interval_join_left,
+        "right": left.interval_join_right,
+        "outer": left.interval_join_outer,
+    }[how]
+    r = method(
+        right, left.t, right.t, pw.temporal.interval(-2, 2)
+    ).select(left.a, right.b)
+    expected = _oracle(
+        [(0, "L0"), (4, "L4"), (9, "L9")],
+        [(1, "R1"), (5, "R5"), (20, "R20")],
+        -2,
+        2,
+        how,
+    )
+    assert _rows(r) == expected
+
+
+def test_interval_join_empty_interval_point_match():
+    left = T(
+        """
+        t | a
+        3 | x
+        """
+    )
+    right = T(
+        """
+        t | b
+        3 | p
+        4 | q
+        """
+    )
+    r = left.interval_join(
+        right, left.t, right.t, pw.temporal.interval(0, 0)
+    ).select(left.a, right.b)
+    assert _rows_plain(r) == [("x", "p")]
+
+
+def test_interval_join_non_symmetric_bounds():
+    left = T(
+        """
+        t | a
+        5 | x
+        """
+    )
+    right = T(
+        """
+        t | b
+        3 | early
+        6 | late
+        9 | far
+        """
+    )
+    r = left.interval_join(
+        right, left.t, right.t, pw.temporal.interval(-2, 1)
+    ).select(right.b)
+    assert sorted(b for (b,) in _rows_plain(r)) == ["early", "late"]
+
+
+def test_interval_join_inverted_bounds_raise():
+    left, right = _sides()
+    with pytest.raises(Exception):
+        left.interval_join(
+            right, left.t, right.t, pw.temporal.interval(2, -2)
+        ).select(left.a)
+
+
+def test_interval_join_sharded_keys():
+    left = T(
+        """
+        k | t | a
+        1 | 0 | x
+        2 | 0 | y
+        """
+    )
+    right = T(
+        """
+        k | t | b
+        1 | 1 | p
+        2 | 1 | q
+        """
+    )
+    r = left.interval_join(
+        right,
+        left.t,
+        right.t,
+        pw.temporal.interval(-2, 2),
+        left.k == right.k,
+    ).select(left.a, right.b)
+    assert set(_rows_plain(r)) == {("x", "p"), ("y", "q")}
+
+
+def test_interval_join_float_times():
+    left = pw.debug.table_from_rows(
+        pw.schema_from_types(t=float, a=str), [(0.5, "x")]
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(t=float, b=str),
+        [(0.9, "near"), (3.0, "far")],
+    )
+    r = left.interval_join(
+        right, left.t, right.t, pw.temporal.interval(-1.0, 1.0)
+    ).select(right.b)
+    assert _rows_plain(r) == [("near",)]
+
+
+def test_interval_join_select_expressions():
+    left, right = _sides()
+    r = left.interval_join(
+        right, left.t, right.t, pw.temporal.interval(-2, 2)
+    ).select(
+        gap=right.t - left.t,
+        tag=left.a + "/" + right.b,
+    )
+    assert set(_rows_plain(r)) == {(1, "L0/R1"), (1, "L4/R5")}
+
+
+def test_interval_join_then_groupby():
+    left, right = _sides()
+    r = (
+        left.interval_join(
+            right, left.t, right.t, pw.temporal.interval(-5, 5)
+        )
+        .select(left.a, right.b)
+        .groupby(pw.this.a)
+        .reduce(pw.this.a, n=pw.reducers.count())
+    )
+    got = dict(_rows_plain(r))
+    assert got["L0"] == 2 and got["L4"] == 2 and got["L9"] == 1
+
+
+def test_interval_join_randomized_oracle():
+    rng = random.Random(31)
+    lrows = [(rng.randrange(0, 30), f"L{i}") for i in range(25)]
+    rrows = [(rng.randrange(0, 30), f"R{i}") for i in range(25)]
+    lo, hi = -3, 2
+    left = pw.debug.table_from_rows(
+        pw.schema_from_types(t=int, a=str), lrows
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(t=int, b=str), rrows
+    )
+    r = left.interval_join_outer(
+        right, left.t, right.t, pw.temporal.interval(lo, hi)
+    ).select(left.a, right.b)
+    assert _rows(r) == _oracle(lrows, rrows, lo, hi, "outer")
+
+
+def test_interpolate_linear_between_points():
+    t = T(
+        """
+        t | v
+        0 | 0.0
+        4 |
+        8 | 8.0
+        """
+    )
+    r = t.interpolate(pw.this.t, pw.this.v)
+    got = sorted(_rows_plain(r))
+    assert (4, 4.0) in got
+
+
+def test_interval_join_preserves_no_extra_columns():
+    """The join result exposes exactly the selected columns (reference:
+    test_interval_joins.py test_no_columns_added)."""
+    left, right = _sides()
+    r = left.interval_join(
+        right, left.t, right.t, pw.temporal.interval(-2, 2)
+    ).select(left.a)
+    assert r.column_names() == ["a"]

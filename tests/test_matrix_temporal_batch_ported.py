@@ -1,0 +1,506 @@
+"""Temporal batch-mode matrices adapted from the reference's
+`tests/temporal/test_asof_joins.py`, `test_window_joins.py`, and
+`test_windows.py` (reference: python/pathway/tests/temporal/) — the same
+behaviors through pathway_tpu's API (VERDICT r4 item 1).
+"""
+
+import datetime as dt
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import run_tables
+
+
+def _rows(table):
+    (cap,) = run_tables(table)
+    return sorted(cap.state.rows.values(), key=repr)
+
+
+def _rows_plain(table):
+    (cap,) = run_tables(table)
+    return sorted(cap.state.rows.values())
+
+
+def T(md):
+    return pw.debug.table_from_markdown(md)
+
+
+# ---------------------------------------------------------------------------
+# asof joins (reference: temporal/test_asof_joins.py)
+# ---------------------------------------------------------------------------
+
+
+def _quotes_trades():
+    trades = T(
+        """
+        t  | amount
+        1  | 10
+        5  | 20
+        9  | 30
+        """
+    )
+    quotes = T(
+        """
+        t  | price
+        0  | 100
+        4  | 104
+        8  | 108
+        """
+    )
+    return trades, quotes
+
+
+def test_asof_join_left_backward_default():
+    trades, quotes = _quotes_trades()
+    r = trades.asof_join_left(quotes, trades.t, quotes.t).select(
+        trades.amount, quotes.price
+    )
+    # backward: each trade matches the latest quote at-or-before it
+    assert set(_rows(r)) == {(10, 100), (20, 104), (30, 108)}
+
+
+def test_asof_join_left_no_earlier_match_pads():
+    trades = T(
+        """
+        t | amount
+        0 | 5
+        """
+    )
+    quotes = T(
+        """
+        t | price
+        3 | 100
+        """
+    )
+    r = trades.asof_join_left(quotes, trades.t, quotes.t).select(
+        trades.amount, quotes.price
+    )
+    assert _rows(r) == [(5, None)]
+
+
+def test_asof_join_forward_direction():
+    trades, quotes = _quotes_trades()
+    r = trades.asof_join_left(
+        quotes, trades.t, quotes.t, direction="forward"
+    ).select(trades.amount, quotes.price)
+    # forward: the earliest quote at-or-after each trade
+    assert set(_rows(r)) == {(10, 104), (20, 108), (30, None)}
+
+
+def test_asof_join_nearest_direction():
+    trades, quotes = _quotes_trades()
+    r = trades.asof_join_left(
+        quotes, trades.t, quotes.t, direction="nearest"
+    ).select(trades.t, quotes.price)
+    # t=1: |1-0|=1 vs |1-4|=3 -> 100; t=5: |5-4|=1 vs |5-8|=3 -> 104;
+    # t=9: |9-8|=1 -> 108
+    assert set(_rows(r)) == {(1, 100), (5, 104), (9, 108)}
+
+
+def test_asof_join_with_grouping_keys():
+    trades = T(
+        """
+        sym | t | amount
+        A   | 2 | 10
+        B   | 2 | 99
+        """
+    )
+    quotes = T(
+        """
+        sym | t | price
+        A   | 1 | 100
+        B   | 1 | 500
+        """
+    )
+    r = trades.asof_join_left(
+        quotes, trades.t, quotes.t, trades.sym == quotes.sym
+    ).select(trades.sym, trades.amount, quotes.price)
+    assert set(_rows(r)) == {("A", 10, 100), ("B", 99, 500)}
+
+
+def test_asof_join_datetimes():
+    base = dt.datetime(2024, 1, 1)
+    trades = pw.debug.table_from_rows(
+        pw.schema_from_types(t=dt.datetime, amount=int),
+        [(base + dt.timedelta(minutes=5), 10)],
+    )
+    quotes = pw.debug.table_from_rows(
+        pw.schema_from_types(t=dt.datetime, price=int),
+        [(base, 100), (base + dt.timedelta(minutes=10), 200)],
+    )
+    r = trades.asof_join_left(quotes, trades.t, quotes.t).select(
+        trades.amount, quotes.price
+    )
+    assert _rows(r) == [(10, 100)]
+
+
+def test_asof_now_join_serves_current_state():
+    queries = T(
+        """
+        k | q
+        1 | x
+        """
+    )
+    data = T(
+        """
+        k | v
+        1 | 100
+        """
+    )
+    r = queries.asof_now_join(data, queries.k == data.k).select(
+        queries.q, data.v
+    )
+    assert _rows_plain(r) == [("x", 100)]
+
+
+# ---------------------------------------------------------------------------
+# window joins (reference: temporal/test_window_joins.py)
+# ---------------------------------------------------------------------------
+
+
+def test_window_join_tumbling_inner():
+    left = T(
+        """
+        t | a
+        1 | x
+        6 | y
+        """
+    )
+    right = T(
+        """
+        t | b
+        2 | p
+        11 | q
+        """
+    )
+    r = left.window_join(
+        right,
+        left.t,
+        right.t,
+        pw.temporal.tumbling(duration=5),
+    ).select(left.a, right.b)
+    # [0,5) pairs (x,p); [5,10) and [10,15) have one side only
+    assert _rows_plain(r) == [("x", "p")]
+
+
+@pytest.mark.parametrize("how", ["left", "outer"])
+def test_window_join_outer_pads(how):
+    left = T(
+        """
+        t | a
+        1 | x
+        6 | y
+        """
+    )
+    right = T(
+        """
+        t | b
+        2 | p
+        """
+    )
+    method = getattr(left, f"window_join_{how}")
+    r = method(
+        right, left.t, right.t, pw.temporal.tumbling(duration=5)
+    ).select(left.a, right.b)
+    got = set(_rows(r))
+    assert ("x", "p") in got
+    assert ("y", None) in got
+
+
+def test_window_join_sliding_multi_window_pairs():
+    left = T(
+        """
+        t | a
+        2 | x
+        """
+    )
+    right = T(
+        """
+        t | b
+        3 | p
+        """
+    )
+    r = left.window_join(
+        right,
+        left.t,
+        right.t,
+        pw.temporal.sliding(duration=4, hop=2),
+    ).select(left.a, right.b)
+    # windows [0,4) and [2,6) both contain t=2 and t=3
+    assert _rows_plain(r) == [("x", "p"), ("x", "p")]
+
+
+def test_window_join_with_shard_key():
+    left = T(
+        """
+        k | t | a
+        1 | 1 | x
+        2 | 1 | y
+        """
+    )
+    right = T(
+        """
+        k | t | b
+        1 | 2 | p
+        2 | 2 | q
+        """
+    )
+    r = left.window_join(
+        right,
+        left.t,
+        right.t,
+        pw.temporal.tumbling(duration=5),
+        left.k == right.k,
+    ).select(left.a, right.b)
+    assert set(_rows_plain(r)) == {("x", "p"), ("y", "q")}
+
+
+def test_session_window_join():
+    left = T(
+        """
+        t  | a
+        1  | x
+        10 | y
+        """
+    )
+    right = T(
+        """
+        t  | b
+        2  | p
+        11 | q
+        """
+    )
+    r = left.window_join(
+        right,
+        left.t,
+        right.t,
+        pw.temporal.session(max_gap=3),
+    ).select(left.a, right.b)
+    assert set(_rows_plain(r)) == {("x", "p"), ("y", "q")}
+
+
+# ---------------------------------------------------------------------------
+# windowby batch depth (reference: temporal/test_windows.py)
+# ---------------------------------------------------------------------------
+
+
+def test_tumbling_origin_shifts_boundaries():
+    t = T(
+        """
+        t | v
+        1 | 1
+        6 | 2
+        """
+    )
+    r = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=5, origin=1)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    # windows [1,6) and [6,11)
+    assert set(_rows_plain(r)) == {(1, 1), (6, 2)}
+
+
+def test_sliding_larger_hop_skips_rows():
+    t = T(
+        """
+        t | v
+        0 | 1
+        3 | 2
+        5 | 4
+        """
+    )
+    r = t.windowby(
+        t.t, window=pw.temporal.sliding(duration=2, hop=5)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    # windows [0,2) and [5,7): the t=3 row falls in NO window
+    assert set(_rows_plain(r)) == {(0, 1), (5, 4)}
+
+
+def test_tumbling_floats():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(t=float, v=int),
+        [(0.5, 1), (1.4, 2), (2.7, 3)],
+    )
+    r = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=1.0)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    assert set(_rows_plain(r)) == {(0.0, 1), (1.0, 2), (2.0, 3)}
+
+
+def test_windows_with_datetimes():
+    base = dt.datetime(2024, 3, 1)
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(t=dt.datetime, v=int),
+        [
+            (base + dt.timedelta(minutes=1), 1),
+            (base + dt.timedelta(minutes=7), 2),
+        ],
+    )
+    r = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=dt.timedelta(minutes=5)),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    got = dict(_rows_plain(r))
+    assert got[base] == 1
+    assert got[base + dt.timedelta(minutes=5)] == 2
+
+
+def test_windowby_instance_keeps_shards_apart():
+    t = T(
+        """
+        g | t | v
+        a | 1 | 1
+        b | 1 | 10
+        a | 2 | 2
+        """
+    )
+    r = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=5),
+        instance=t.g,
+    ).reduce(
+        g=pw.this._pw_instance,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    assert set(_rows_plain(r)) == {("a", 3), ("b", 10)}
+
+
+def test_session_windows_merge_condition():
+    t = T(
+        """
+        t  | v
+        1  | 1
+        3  | 2
+        10 | 4
+        """
+    )
+    r = t.windowby(
+        t.t, window=pw.temporal.session(max_gap=4)
+    ).reduce(s=pw.reducers.sum(pw.this.v))
+    assert sorted(x for (x,) in _rows_plain(r)) == [3, 4]
+
+
+def test_sliding_argmin_argmax_through_windows():
+    t = T(
+        """
+        t | k | v
+        1 | p | 5
+        2 | q | 1
+        """
+    )
+    r = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=5)
+    ).reduce(
+        lo_k=pw.reducers.argmin(pw.this.v, pw.this.k),
+        hi_k=pw.reducers.argmax(pw.this.v, pw.this.k),
+        lo=pw.reducers.min(pw.this.v),
+        hi=pw.reducers.max(pw.this.v),
+    )
+    # argmin/argmax point at (window-local) rows; resolve via the
+    # windowed table itself is internal, so assert the VALUE extrema and
+    # that tie-free pointers differ
+    ((lo_k, hi_k, lo, hi),) = _rows_plain(r)
+    assert (lo, hi) == (1, 5)
+    assert lo_k != hi_k
+
+
+def test_intervals_over_sorted_neighborhood():
+    t = T(
+        """
+        t | v
+        1 | 1
+        3 | 2
+        5 | 4
+        9 | 8
+        """
+    )
+    probes = T(
+        """
+        at
+        3
+        9
+        """
+    )
+    r = pw.temporal.windowby(
+        t,
+        t.t,
+        window=pw.temporal.intervals_over(
+            at=probes.at, lower_bound=-2, upper_bound=2
+        ),
+    ).reduce(
+        at=pw.this._pw_window_location,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    # at=3 covers t in [1,5] -> 1+2+4; at=9 covers [7,11] -> 8
+    assert set(_rows_plain(r)) == {(3, 7), (9, 8)}
+
+
+def test_windowby_incorrect_duration_type_raises():
+    t = T(
+        """
+        t | v
+        1 | 1
+        """
+    )
+    with pytest.raises(Exception):
+        t.windowby(
+            t.t,
+            window=pw.temporal.tumbling(
+                duration=dt.timedelta(minutes=5)
+            ),
+        ).reduce(s=pw.reducers.sum(pw.this.v))
+        _rows_plain(
+            t.windowby(
+                t.t,
+                window=pw.temporal.tumbling(
+                    duration=dt.timedelta(minutes=5)
+                ),
+            ).reduce(s=pw.reducers.sum(pw.this.v))
+        )
+
+
+def test_window_join_mismatched_duration_type_raises():
+    left = T(
+        """
+        t | a
+        1 | x
+        """
+    )
+    right = T(
+        """
+        t | b
+        2 | p
+        """
+    )
+    with pytest.raises(TypeError, match="duration"):
+        left.window_join(
+            right,
+            left.t,
+            right.t,
+            pw.temporal.tumbling(duration=dt.timedelta(seconds=5)),
+        )
+
+
+def test_flatten_json_dict_is_error_not_str_rows():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(data=pw.Json),
+        [(pw.Json({"x": 1}),), (pw.Json([7]),)],
+    )
+    r = t.flatten(t.data)
+    rows = [v for (v,) in _rows(r)]
+    # the dict row is an error (logged), only the array row flattens —
+    # and its element is Json-typed, not a raw str
+    assert len(rows) == 1
+    assert isinstance(rows[0], pw.Json) and rows[0].value == 7
