@@ -83,14 +83,72 @@ def build_csv_reader(lines, csv_settings):
     )
 
 
+def schema_defaults(schema) -> Dict[str, Any]:
+    """{column: default_value} for columns declaring one — computed ONCE
+    per parse, not per row (reference: test_io.py test_csv_default_values
+    / test_json_default_values)."""
+    return {
+        name: schema[name].default_value
+        for name in schema.keys()
+        if getattr(schema[name], "has_default_value", False)
+    }
+
+
+def json_row(
+    obj: dict, schema, names, field_paths, defaults
+) -> Dict[str, Any]:
+    """One parsed JSON document -> one row: schema projection, field-path
+    extraction, then default filling. The SINGLE implementation shared by
+    the fs and s3 connectors. A field path that resolves to nothing
+    leaves the column ABSENT so its schema default (if any) applies."""
+    row = {
+        k: coerce_json_value(v, schema[k].dtype)
+        for k, v in obj.items()
+        if k in names
+    }
+    if field_paths:
+        for col, path in field_paths.items():
+            if col not in names:
+                continue
+            val = _json_pointer(obj, path)
+            if val is None:
+                row.pop(col, None)
+            else:
+                row[col] = coerce_json_value(val, schema[col].dtype)
+    for k, dflt in defaults.items():
+        if k not in row:
+            row[k] = dflt
+    return row
+
+
+def _json_pointer(obj, path: str):
+    """Minimal JSON-pointer resolution for json_field_paths ("/a/b")."""
+    cur = obj
+    for part in path.strip("/").split("/"):
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        elif isinstance(cur, list):
+            try:
+                cur = cur[int(part)]
+            except (ValueError, IndexError):
+                return None
+        else:
+            return None
+        if cur is None:
+            return None
+    return cur
+
+
 def parse_object(
-    payload: bytes, format: str, schema, csv_settings=None
+    payload: bytes, format: str, schema, csv_settings=None,
+    json_field_paths=None,
 ) -> Iterator[Dict[str, Any]]:
     """Parse one object's bytes into rows.
 
     formats: binary (one row, raw bytes), plaintext (row per line),
     plaintext_by_object (one row, whole text), json/jsonlines (row per JSON
-    line), csv (header row + DictReader).
+    line; ``json_field_paths`` maps columns to JSON pointers inside each
+    document), csv (header row + DictReader).
     """
     if format == "binary":
         yield {"data": payload}
@@ -105,27 +163,30 @@ def parse_object(
         return
     if format in ("json", "jsonlines"):
         names = set(schema.keys())
+        defaults = schema_defaults(schema)
         for line in payload.decode(errors="replace").splitlines():
             line = line.strip()
             if not line:
                 continue
-            obj = json.loads(line)
-            yield {
-                k: coerce_json_value(v, schema[k].dtype)
-                for k, v in obj.items()
-                if k in names
-            }
+            yield json_row(
+                json.loads(line), schema, names, json_field_paths, defaults
+            )
         return
     if format == "csv":
         names = set(schema.keys())
+        defaults = schema_defaults(schema)
         reader = build_csv_reader(
             io_mod.StringIO(payload.decode(errors="replace")), csv_settings
         )
         for rec in reader:
-            yield {
+            row = {
                 k: parse_csv_value(v, schema[k].dtype)
                 for k, v in rec.items()
                 if k in names
             }
+            for k, dflt in defaults.items():
+                if k not in row:
+                    row[k] = dflt
+            yield row
         return
     raise ValueError(f"unknown format {format!r}")

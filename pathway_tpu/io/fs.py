@@ -79,6 +79,7 @@ class _FsSubject(ConnectorSubjectBase):
         batch_per_file: bool = False,
         csv_settings: "CsvParserSettings | None" = None,
         partitioned: bool = False,
+        json_field_paths=None,
     ):
         super().__init__()
         self.path = path
@@ -91,6 +92,11 @@ class _FsSubject(ConnectorSubjectBase):
         self.batch_per_file = batch_per_file
         self.csv_settings = csv_settings
         self.partitioned = partitioned
+        self.json_field_paths = dict(json_field_paths or {})
+        from pathway_tpu.io._formats import schema_defaults
+
+        # schema defaults fill columns the payload does not carry
+        self._defaults = schema_defaults(schema)
         self._seen: Dict[str, float] = {}
 
     def _owns(self, f: str) -> bool:
@@ -200,6 +206,9 @@ class _FsSubject(ConnectorSubjectBase):
                         for k, v in rec.items()
                         if k in names
                     }
+                    for k, dflt in self._defaults.items():
+                        if k not in row:
+                            row[k] = dflt
                     row.update(meta)
                     chunk.append(row)
                     if len(chunk) >= 65536:
@@ -228,6 +237,23 @@ class _FsSubject(ConnectorSubjectBase):
     def _emit_json_objs(self, objs, names, meta, plain, flat_chunk=False):
         schema = self.schema
         coerce = _coerce_json_value
+        if self.json_field_paths:
+            # field-path extraction: the shared row builder (defaults-only
+            # schemas stay on the fast paths below — missing keys fall
+            # through to the dict-row path, which default-fills)
+            from pathway_tpu.io._formats import json_row
+
+            rows = []
+            for obj in objs:
+                row = json_row(
+                    obj, schema, names, self.json_field_paths,
+                    self._defaults,
+                )
+                row.update(meta)
+                rows.append(row)
+            if rows:
+                self.next_batch(rows)
+            return
         if plain and not meta and flat_chunk:
             # fastest path: schema-ordered tuples, no row dicts at all
             # (flat_chunk proves no value anywhere in the chunk is nested)
@@ -263,24 +289,33 @@ class _FsSubject(ConnectorSubjectBase):
                     rows_append(
                         {k: v for k, v in obj.items() if k in names}
                     )
+            if self._defaults:
+                for row in rows:
+                    for k, dflt in self._defaults.items():
+                        if k not in row:
+                            row[k] = dflt
             if meta:
                 for row in rows:
                     row.update(meta)
             self.next_batch(rows)
         else:
-            self.next_batch(
-                [
-                    {
-                        **{
-                            k: coerce(v, schema[k].dtype)
-                            for k, v in obj.items()
-                            if k in names
-                        },
-                        **meta,
-                    }
-                    for obj in objs
-                ]
-            )
+            rows = [
+                {
+                    k: coerce(v, schema[k].dtype)
+                    for k, v in obj.items()
+                    if k in names
+                }
+                for obj in objs
+            ]
+            if self._defaults:
+                for row in rows:
+                    for k, dflt in self._defaults.items():
+                        if k not in row:
+                            row[k] = dflt
+            if meta:
+                for row in rows:
+                    row.update(meta)
+            self.next_batch(rows)
 
     def run(self) -> None:
         while True:
@@ -369,6 +404,7 @@ def read(
             batch_per_file=batch_per_file,
             csv_settings=csv_settings,
             partitioned=partitioned,
+            json_field_paths=json_field_paths,
         )
 
     return connector_table(

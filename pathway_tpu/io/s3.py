@@ -112,7 +112,7 @@ class _Boto3Client(S3Client):
 
 
 class _S3Subject(ConnectorSubjectBase):
-    def __init__(self, client_factory, prefix, format, schema, mode, with_metadata, refresh_interval=1.0, csv_settings=None):
+    def __init__(self, client_factory, prefix, format, schema, mode, with_metadata, refresh_interval=1.0, csv_settings=None, json_field_paths=None):
         super().__init__()
         self.client_factory = client_factory
         self.prefix = prefix
@@ -122,6 +122,7 @@ class _S3Subject(ConnectorSubjectBase):
         self.with_metadata = with_metadata
         self.refresh_interval = refresh_interval
         self.csv_settings = csv_settings
+        self.json_field_paths = json_field_paths
         self._seen: Dict[str, str] = {}
 
     def _emit_object(self, key: str, payload: bytes) -> None:
@@ -135,7 +136,9 @@ class _S3Subject(ConnectorSubjectBase):
                 )
             }
         for row in parse_object(
-            payload, self.format, self.schema, csv_settings=self.csv_settings
+            payload, self.format, self.schema,
+            csv_settings=self.csv_settings,
+            json_field_paths=self.json_field_paths,
         ):
             self.next(**row, **meta)
 
@@ -172,6 +175,7 @@ def read(
     name: str | None = None,
     refresh_interval: float = 1.0,
     csv_settings=None,
+    json_field_paths=None,
     _client_factory=None,
     **kwargs,
 ):
@@ -212,6 +216,7 @@ def read(
             with_metadata,
             refresh_interval=refresh_interval,
             csv_settings=csv_settings,
+            json_field_paths=json_field_paths,
         )
 
     return connector_table(out_schema, factory, mode=mode, name=name)

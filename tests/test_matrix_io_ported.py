@@ -1,0 +1,421 @@
+"""I/O format + connector matrix adapted from the reference's
+`tests/test_io.py` (5,118 LoC; reference: python/pathway/tests/test_io.py)
+— the same behaviors through pathway_tpu's API (VERDICT r4 item 1):
+CSV/JSON parsing edges (defaults, optional values, exotic columns, field
+paths), static/streaming parity, id hashing stability across connectors,
+python connector contracts (raw mode, deletions, commits), and
+from-pandas schema handling.
+"""
+
+import json
+import pathlib
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import run_tables
+
+
+def _rows(table):
+    (cap,) = run_tables(table)
+    return sorted(cap.state.rows.values(), key=repr)
+
+
+def _rows_plain(table):
+    (cap,) = run_tables(table)
+    return sorted(cap.state.rows.values())
+
+
+# ---------------------------------------------------------------------------
+# CSV matrix
+# ---------------------------------------------------------------------------
+
+
+def test_csv_static_read_write_roundtrip(tmp_path: pathlib.Path):
+    src = tmp_path / "in.csv"
+    src.write_text("k,v\na,1\nb,2\n")
+
+    class S(pw.Schema):
+        k: str
+        v: int
+
+    t = pw.io.csv.read(str(src), schema=S, mode="static")
+    assert _rows_plain(t) == [("a", 1), ("b", 2)]
+    out = tmp_path / "out.csv"
+    pw.io.csv.write(t, str(out))
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    pw.G.clear()
+    text = out.read_text()
+    assert "a,1" in text and "b,2" in text
+
+
+def test_csv_quoted_fields_with_commas(tmp_path: pathlib.Path):
+    src = tmp_path / "in.csv"
+    src.write_text('k,v\n"a,b",1\n"say ""hi""",2\n')
+
+    class S(pw.Schema):
+        k: str
+        v: int
+
+    t = pw.io.csv.read(str(src), schema=S, mode="static")
+    assert _rows_plain(t) == [("a,b", 1), ('say "hi"', 2)]
+
+
+def test_csv_exotic_column_names(tmp_path: pathlib.Path):
+    src = tmp_path / "in.csv"
+    src.write_text("#key:here,data-1\nx,1\n")
+    t = pw.io.csv.read(
+        str(src),
+        schema=pw.schema_from_types(**{"#key:here": str, "data-1": int}),
+        mode="static",
+    )
+    assert _rows_plain(t) == [("x", 1)]
+
+
+def test_csv_default_values_for_missing_column(tmp_path: pathlib.Path):
+    src = tmp_path / "in.csv"
+    src.write_text("k\na\n")
+
+    class S(pw.Schema):
+        k: str
+        v: int = pw.column_definition(default_value=7)
+
+    t = pw.io.csv.read(str(src), schema=S, mode="static")
+    assert _rows_plain(t) == [("a", 7)]
+
+
+def test_csv_extra_columns_skipped(tmp_path: pathlib.Path):
+    src = tmp_path / "in.csv"
+    src.write_text("k,v,junk\na,1,zzz\n")
+
+    class S(pw.Schema):
+        k: str
+        v: int
+
+    t = pw.io.csv.read(str(src), schema=S, mode="static")
+    assert _rows_plain(t) == [("a", 1)]
+
+
+def test_csv_custom_delimiter(tmp_path: pathlib.Path):
+    src = tmp_path / "in.csv"
+    src.write_text("k;v\na;1\n")
+
+    class S(pw.Schema):
+        k: str
+        v: int
+
+    t = pw.io.csv.read(
+        str(src),
+        schema=S,
+        mode="static",
+        csv_settings=pw.io.CsvParserSettings(delimiter=";"),
+    )
+    assert _rows_plain(t) == [("a", 1)]
+
+
+# ---------------------------------------------------------------------------
+# JSON matrix
+# ---------------------------------------------------------------------------
+
+
+def test_jsonlines_types_and_nulls(tmp_path: pathlib.Path):
+    from typing import Optional
+
+    src = tmp_path / "in.jsonl"
+    rows = [
+        {"k": "a", "n": 1, "f": 1.5, "b": True, "maybe": None},
+        {"k": "b", "n": 2, "f": 2.0, "b": False, "maybe": 9},
+    ]
+    src.write_text("\n".join(json.dumps(r) for r in rows))
+    t = pw.io.jsonlines.read(
+        str(src),
+        schema=pw.schema_from_types(
+            k=str, n=int, f=float, b=bool, maybe=Optional[int]
+        ),
+        mode="static",
+    )
+    assert _rows(t) == sorted(
+        [("a", 1, 1.5, True, None), ("b", 2, 2.0, False, 9)], key=repr
+    )
+
+
+def test_json_default_values(tmp_path: pathlib.Path):
+    src = tmp_path / "in.jsonl"
+    src.write_text(json.dumps({"k": "a"}))
+
+    class S(pw.Schema):
+        k: str
+        v: int = pw.column_definition(default_value=-1)
+
+    t = pw.io.jsonlines.read(str(src), schema=S, mode="static")
+    assert _rows_plain(t) == [("a", -1)]
+
+
+def test_json_field_paths(tmp_path: pathlib.Path):
+    src = tmp_path / "in.jsonl"
+    src.write_text(json.dumps({"outer": {"inner": 5}, "k": "a"}))
+    t = pw.io.jsonlines.read(
+        str(src),
+        schema=pw.schema_from_types(k=str, v=int),
+        json_field_paths={"v": "/outer/inner"},
+        mode="static",
+    )
+    assert _rows_plain(t) == [("a", 5)]
+
+
+def test_json_column_kept_as_json(tmp_path: pathlib.Path):
+    src = tmp_path / "in.jsonl"
+    src.write_text(json.dumps({"k": "a", "payload": {"x": [1, 2]}}))
+    t = pw.io.jsonlines.read(
+        str(src),
+        schema=pw.schema_from_types(k=str, payload=pw.Json),
+        mode="static",
+    )
+    ((k, payload),) = _rows_plain(t)
+    assert k == "a"
+    assert payload.value == {"x": [1, 2]}
+
+
+def test_plaintext_reads_lines(tmp_path: pathlib.Path):
+    src = tmp_path / "in.txt"
+    src.write_text("alpha\nbeta\n")
+    t = pw.io.plaintext.read(str(src), mode="static")
+    assert sorted(v for (v,) in _rows_plain(t)) == ["alpha", "beta"]
+
+
+# ---------------------------------------------------------------------------
+# id hashing stability (reference: test_id_hashing_across_connectors)
+# ---------------------------------------------------------------------------
+
+
+def test_primary_key_ids_stable_across_connectors(tmp_path: pathlib.Path):
+    class S(pw.Schema):
+        k: str = pw.column_definition(primary_key=True)
+        v: int
+
+    csv_src = tmp_path / "in.csv"
+    csv_src.write_text("k,v\na,1\n")
+    json_src = tmp_path / "in.jsonl"
+    json_src.write_text(json.dumps({"k": "a", "v": 1}))
+    t_csv = pw.io.csv.read(str(csv_src), schema=S, mode="static")
+    t_json = pw.io.jsonlines.read(str(json_src), schema=S, mode="static")
+    (cap1,) = run_tables(t_csv)
+    pw.G.clear()
+    (cap2,) = run_tables(t_json)
+    pw.G.clear()
+    # same primary key -> same row id, regardless of the format it
+    # arrived through
+    assert set(cap1.state.rows.keys()) == set(cap2.state.rows.keys())
+
+
+# ---------------------------------------------------------------------------
+# python connector contracts (reference: test_python_connector*)
+# ---------------------------------------------------------------------------
+
+
+def test_python_connector_rows_and_stop():
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k="a", v=1)
+            self.next(k="b", v=2)
+
+    t = pw.io.python.read(
+        Subject(), schema=pw.schema_from_types(k=str, v=int)
+    )
+    done = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: done.append(
+            (row["k"], row["v"], is_addition)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    pw.G.clear()
+    assert sorted(done) == [("a", 1, True), ("b", 2, True)]
+
+
+def test_python_connector_remove_retracts():
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k="a", v=1)
+            # a BARRIER commit pins the batch boundary; a plain commit is
+            # a flush hint the driver may coalesce, in which case the
+            # insert+remove net to zero before anything is emitted
+            self.commit(barrier=True)
+            self._remove({"k": "a", "v": 1})
+
+    t = pw.io.python.read(
+        Subject(), schema=pw.schema_from_types(k=str, v=int)
+    )
+    events = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: events.append(
+            (row["k"], is_addition)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    pw.G.clear()
+    assert events == [("a", True), ("a", False)]
+
+
+def test_python_connector_insert_remove_same_batch_nets_zero():
+    """Coalesced into one engine batch, insert+remove cancel before
+    emission — downstream sees nothing (dataflow consolidation)."""
+
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k="a", v=1)
+            self._remove({"k": "a", "v": 1})
+
+    t = pw.io.python.read(
+        Subject(), schema=pw.schema_from_types(k=str, v=int)
+    )
+    events = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: events.append(
+            (row["k"], is_addition)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    pw.G.clear()
+    assert events == []
+
+
+def test_subscribe_sees_engine_times_monotone():
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(3):
+                self.next(v=i)
+                self.commit()
+
+    t = pw.io.python.read(Subject(), schema=pw.schema_from_types(v=int))
+    times = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: times.append(time),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    pw.G.clear()
+    assert times == sorted(times)
+
+
+# ---------------------------------------------------------------------------
+# from-pandas (reference: test_table_from_pandas*)
+# ---------------------------------------------------------------------------
+
+
+def test_table_from_pandas_with_schema():
+    import pandas as pd
+
+    df = pd.DataFrame({"k": ["a", "b"], "v": [1, 2]})
+    t = pw.debug.table_from_pandas(
+        df, schema=pw.schema_from_types(k=str, v=int)
+    )
+    assert _rows_plain(t) == [("a", 1), ("b", 2)]
+    assert t.typehints()["v"] is int
+
+
+def test_table_from_pandas_infers_types():
+    import pandas as pd
+
+    df = pd.DataFrame({"k": ["a"], "f": [1.5]})
+    t = pw.debug.table_from_pandas(df)
+    assert _rows_plain(t) == [("a", 1.5)]
+
+
+def test_table_from_pandas_copy_semantics():
+    import pandas as pd
+
+    df = pd.DataFrame({"v": [1]})
+    t = pw.debug.table_from_pandas(df)
+    df.loc[0, "v"] = 999  # mutating the source later must not leak in
+    assert _rows_plain(t) == [(1,)]
+
+
+# ---------------------------------------------------------------------------
+# streaming/static parity for file formats
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fmt", ["csv", "jsonlines"])
+def test_streaming_matches_static_for_files(fmt, tmp_path: pathlib.Path):
+    class S(pw.Schema):
+        k: str
+        v: int
+
+    if fmt == "csv":
+        src = tmp_path / "in.csv"
+        src.write_text("k,v\na,1\nb,2\n")
+        reader = pw.io.csv.read
+    else:
+        src = tmp_path / "in.jsonl"
+        src.write_text(
+            "\n".join(
+                json.dumps({"k": k, "v": v})
+                for k, v in (("a", 1), ("b", 2))
+            )
+        )
+        reader = pw.io.jsonlines.read
+
+    t_static = reader(str(src), schema=S, mode="static")
+    static_rows = _rows_plain(t_static)
+    pw.G.clear()
+
+    t_stream = reader(
+        str(src), schema=S, mode="streaming", refresh_interval=0.05
+    )
+    seen = []
+    engines = []
+    pw.G.add_sink([t_stream], lambda ctx, nodes: engines.append(ctx.engine))
+
+    def on_change(key, row, time, is_addition):
+        seen.append((row["k"], row["v"]))
+        if len(seen) == 2:
+            engines[0].terminate_flag.set()
+
+    pw.io.subscribe(t_stream, on_change=on_change)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    pw.G.clear()
+    assert sorted(seen) == static_rows
+
+
+def test_json_field_path_miss_uses_default(tmp_path: pathlib.Path):
+    """A field path resolving to nothing leaves the column to its schema
+    default (r5 review): None must not mask default_value."""
+    src = tmp_path / "in.jsonl"
+    src.write_text(json.dumps({"k": "x"}))
+
+    class S(pw.Schema):
+        k: str
+        v: int = pw.column_definition(default_value=7)
+
+    t = pw.io.jsonlines.read(
+        str(src),
+        schema=S,
+        json_field_paths={"v": "/a/b"},
+        mode="static",
+    )
+    assert _rows_plain(t) == [("x", 7)]
+
+
+def test_defaults_only_schema_keeps_rows_correct_with_full_payload(
+    tmp_path: pathlib.Path,
+):
+    src = tmp_path / "in.jsonl"
+    src.write_text(
+        "\n".join(
+            json.dumps({"k": f"k{i}", "v": i}) for i in range(100)
+        )
+    )
+
+    class S(pw.Schema):
+        k: str
+        v: int = pw.column_definition(default_value=-1)
+
+    t = pw.io.jsonlines.read(str(src), schema=S, mode="static")
+    rows = _rows_plain(t)
+    assert len(rows) == 100
+    assert all(v != -1 for _k, v in rows)
