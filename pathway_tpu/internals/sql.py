@@ -3,10 +3,12 @@ python/pathway/internals/sql/processing.py; sqlglot there, a self-contained
 recursive-descent translator here).
 
 Supported: SELECT projections/expressions with aliases, WHERE, GROUP BY +
-HAVING, aggregate functions (SUM/COUNT/MIN/MAX/AVG), INNER/LEFT JOIN ... ON,
-UNION ALL, WITH-chains (CTEs, reference: processing.py:172), subqueries in
-FROM and `WHERE col IN (SELECT ...)` (reference: processing.py:305), and
-window functions ROW_NUMBER/RANK/DENSE_RANK/SUM/COUNT/MIN/MAX/AVG with
+HAVING, aggregate functions (SUM/COUNT/MIN/MAX/AVG), INNER/LEFT/RIGHT
+JOIN ... ON / USING (merged columns), UNION [ALL] / INTERSECT / EXCEPT
+(positional alignment, SQL set semantics), searched and simple CASE,
+WITH-chains (CTEs, reference: processing.py:172), subqueries in FROM and
+`WHERE col IN (SELECT ...)` (reference: processing.py:305), and window
+functions ROW_NUMBER/RANK/DENSE_RANK/SUM/COUNT/MIN/MAX/AVG with
 `OVER (PARTITION BY ... [ORDER BY ... [DESC]])`. Example::
 
     result = pw.sql("SELECT k, SUM(v) AS total FROM t GROUP BY k", t=t)
@@ -38,7 +40,7 @@ _KEYWORDS = {
     "inner", "left", "right", "outer", "on", "and", "or", "not", "union",
     "all", "order", "asc", "desc", "limit", "is", "null", "case", "when",
     "then", "else", "end", "like", "in", "distinct", "with", "over",
-    "partition",
+    "partition", "intersect", "except", "using",
 }
 
 _WINDOW_FUNCS = {
@@ -121,12 +123,71 @@ class _SqlTranslator:
         return self.select_union(tk)
 
     def select_union(self, tk: _Tokens) -> Table:
-        result = self.select_statement(tk)
-        while tk.accept("kw", "union"):
-            tk.accept("kw", "all")
-            other = self.select_statement(tk)
-            result = result.concat_reindex(other)
+        """UNION/EXCEPT level (left-associative); INTERSECT binds tighter
+        (SQL standard precedence). Consecutive distinct-UNIONs dedup once
+        at the end of the run, not per term."""
+        result = self.select_intersect(tk)
+        owes_distinct = False
+        while True:
+            if tk.accept("kw", "union"):
+                all_ = tk.accept("kw", "all")
+                other = self._positional_rename(
+                    result, self.select_intersect(tk)
+                )
+                if all_ and owes_distinct:
+                    result = self._distinct(result)
+                    owes_distinct = False
+                result = result.concat_reindex(other)
+                if not all_:
+                    owes_distinct = True
+            elif tk.accept("kw", "except"):
+                if owes_distinct:
+                    result = self._distinct(result)
+                    owes_distinct = False
+                other = self._positional_rename(
+                    result, self.select_intersect(tk)
+                )
+                result = self._distinct(result).difference(
+                    self._distinct(other)
+                )
+            else:
+                break
+        if owes_distinct:
+            result = self._distinct(result)
         return result
+
+    def select_intersect(self, tk: _Tokens) -> Table:
+        result = self.select_statement(tk)
+        while tk.accept("kw", "intersect"):
+            other = self._positional_rename(
+                result, self.select_statement(tk)
+            )
+            # distinct both sides; groupby keys derive from the row
+            # VALUES, so equal rows share ids across tables and the
+            # universe intersect is exactly set-intersection
+            result = self._distinct(result).intersect(
+                self._distinct(other)
+            )
+        return result
+
+    @staticmethod
+    def _positional_rename(first: Table, other: Table) -> Table:
+        """UNION/INTERSECT/EXCEPT align columns by POSITION (SQL
+        semantics); the combined result uses the first select's names."""
+        rn, on = first.column_names(), other.column_names()
+        if len(rn) != len(on):
+            raise ValueError(
+                f"set operation arity mismatch: {len(rn)} vs {len(on)} "
+                "columns"
+            )
+        if rn == on:
+            return other
+        return other.select(**{a: other[b] for a, b in zip(rn, on)})
+
+    @staticmethod
+    def _distinct(table: Table) -> Table:
+        cols = [table[c] for c in table.column_names()]
+        return table.groupby(*cols).reduce(*cols)
 
     def select_statement(self, tk: _Tokens) -> Table:
         tk.expect("kw", "select")
@@ -191,13 +252,30 @@ class _SqlTranslator:
             else:
                 break
             other, other_name = self._from_item(tk)
-            tk.expect("kw", "on")
-            join_scope = dict(scope)
-            join_scope[other_name] = {c: c for c in other.column_names()}
-            cond = self._resolve_joined(
-                self.expr(tk), scope, table, other_name, other
-            )
-            jr = table.join(other, cond, how=how)
+            using_cols: List[str] = []
+            if tk.accept("kw", "using"):
+                tk.expect("op", "(")
+                while True:
+                    using_cols.append(tk.expect("ident"))
+                    if not tk.accept("op", ","):
+                        break
+                tk.expect("op", ")")
+                conds = [
+                    table[self._scope_lookup(scope, c)] == other[c]
+                    for c in using_cols
+                ]
+            else:
+                tk.expect("kw", "on")
+                join_scope = dict(scope)
+                join_scope[other_name] = {
+                    c: c for c in other.column_names()
+                }
+                conds = [
+                    self._resolve_joined(
+                        self.expr(tk), scope, table, other_name, other
+                    )
+                ]
+            jr = table.join(other, *conds, how=how)
             # materialize the join; collision columns from the right side
             # get a disambiguated name tracked through the scope map
             cols: Dict[str, Any] = {}
@@ -209,6 +287,16 @@ class _SqlTranslator:
                         taken.add(combined_name)
             other_mapping: Dict[str, str] = {}
             for c in other.column_names():
+                if c in using_cols:
+                    # USING merges the join column with COALESCE
+                    # semantics: unmatched right rows (right/outer
+                    # joins) contribute their own key value
+                    merged = self._scope_lookup(scope, c)
+                    from pathway_tpu.internals.api import coalesce
+
+                    cols[merged] = coalesce(table[merged], other[c])
+                    other_mapping[c] = merged
+                    continue
                 out_name = c if c not in taken else f"_{other_name}_{c}"
                 while out_name in taken:
                     out_name = "_" + out_name
@@ -218,6 +306,14 @@ class _SqlTranslator:
             table = jr.select(**cols)
             scope[other_name] = other_mapping
         return table, scope
+
+    @staticmethod
+    def _scope_lookup(scope, col: str) -> str:
+        """The combined-table column name a bare identifier refers to."""
+        for mapping in scope.values():
+            if col in mapping:
+                return mapping[col]
+        raise KeyError(f"unknown column {col!r} in USING clause")
 
     def _from_item(self, tk: _Tokens) -> Tuple[Table, str]:
         """A named table or a parenthesized subquery (reference:
@@ -357,9 +453,16 @@ class _SqlTranslator:
             tk.expect("op", ")")
             return inner
         if tok == ("kw", "case"):
+            # simple CASE (CASE expr WHEN v ...) desugars to the searched
+            # form with equality conditions
+            base = None
+            if tk.peek() != ("kw", "when"):
+                base = self.expr(tk)
             branches = []
             while tk.accept("kw", "when"):
                 cond = self.expr(tk)
+                if base is not None:
+                    cond = ("binop", "==", base, cond)
                 tk.expect("kw", "then")
                 branches.append((cond, self.expr(tk)))
             default = ("const", None)

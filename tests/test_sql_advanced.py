@@ -452,3 +452,148 @@ def test_window_functions_match_pandas_oracle():
             )
         )
         assert got == expect, (trial, got[:5], expect[:5])
+
+
+# -- set operations / USING / simple CASE (r5; reference: test_sql.py) -----
+
+
+def test_union_all_aligns_by_position():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        """
+    )
+    res = pw.sql("SELECT a FROM t UNION ALL SELECT b FROM t", t=t)
+    assert _rows(res) == [(1,), (2,), (3,), (4,)]
+
+
+def test_union_distinct_dedupes():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 1
+        2 | 3
+        """
+    )
+    res = pw.sql("SELECT a FROM t UNION SELECT b FROM t", t=t)
+    assert _rows(res) == [(1,), (2,), (3,)]
+
+
+def test_intersect_and_except():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        2 | 9
+        """
+    )
+    res = pw.sql("SELECT a FROM t INTERSECT SELECT b FROM t", t=t)
+    assert _rows(res) == [(2,)]
+    res2 = pw.sql("SELECT a FROM t EXCEPT SELECT b FROM t", t=t)
+    assert _rows(res2) == [(1,), (3,)]
+
+
+def test_join_using_merges_column():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        """
+    )
+    res = pw.sql(
+        "SELECT t1.a, t1.b, t2.b AS b2 "
+        "FROM t t1 JOIN t t2 USING (a)",
+        t=t,
+    )
+    assert _rows(res) == [(1, 2, 2), (3, 4, 4)]
+
+
+def test_simple_case_expression():
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+    res = pw.sql(
+        "SELECT a, CASE a WHEN 1 THEN 'one' ELSE 'other' END AS w FROM t",
+        t=t,
+    )
+    assert _rows(res) == [(1, "one"), (2, "other")]
+
+
+def test_union_arity_mismatch_raises():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    with pytest.raises(ValueError, match="arity"):
+        pw.sql("SELECT a, b FROM t UNION ALL SELECT a FROM t", t=t)
+
+
+def test_right_join_using_coalesces_key():
+    left = pw.debug.table_from_markdown(
+        """
+        k | a
+        1 | 100
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        k | b
+        1 | 10
+        5 | 50
+        """
+    )
+    res = pw.sql(
+        "SELECT k, b FROM l RIGHT JOIN r USING (k)", l=left, r=right
+    )
+    assert _rows(res) == sorted([(1, 10), (5, 50)], key=repr)
+
+
+def test_intersect_binds_tighter_than_union():
+    a = pw.debug.table_from_markdown(
+        """
+        x
+        1
+        """
+    )
+    b = pw.debug.table_from_markdown(
+        """
+        x
+        2
+        """
+    )
+    c = pw.debug.table_from_markdown(
+        """
+        x
+        2
+        """
+    )
+    res = pw.sql(
+        "SELECT x FROM a UNION SELECT x FROM b INTERSECT SELECT x FROM c",
+        a=a, b=b, c=c,
+    )
+    # standard precedence: A UNION (B INTERSECT C) = {1, 2}
+    assert _rows(res) == [(1,), (2,)]
+
+
+def test_chained_union_distinct_single_pass():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b | c
+        1 | 1 | 2
+        """
+    )
+    res = pw.sql(
+        "SELECT a FROM t UNION SELECT b FROM t UNION SELECT c FROM t",
+        t=t,
+    )
+    assert _rows(res) == [(1,), (2,)]
