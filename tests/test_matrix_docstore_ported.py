@@ -1,0 +1,186 @@
+"""DocumentStore filtering matrix adapted from the reference's
+`xpacks/llm/tests/test_document_store.py` / `test_vector_store.py`
+(reference: python/pathway/xpacks/llm/tests/) — glob and metadata
+filtering through retrieval, hybrid-index filtering, and docstore
+schema tolerance (VERDICT r4 item 1).
+
+Uses the fake low-dimension embedder so the matrix runs CPU-only.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import run_tables
+from pathway_tpu.engine.value import Json
+
+
+class FakeEmbedder(pw.UDF):
+    """Deterministic 8-dim embedding; batched like the real one."""
+
+    def __init__(self):
+        super().__init__(return_type=np.ndarray, deterministic=True)
+
+        def embed(texts):
+            out = []
+            for t in texts:
+                rng = np.random.default_rng(abs(hash(t)) % (2**32))
+                v = rng.normal(size=8)
+                out.append(v / np.linalg.norm(v))
+            return out
+
+        self.func = embed
+        self.max_batch_size = 256
+
+    def get_embedding_dimension(self) -> int:
+        return 8
+
+
+def _docs_with_metadata(rows):
+    """rows: [(text, path)]"""
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(data=str, _metadata=pw.Json),
+        [(text, Json({"path": path})) for text, path in rows],
+    )
+
+
+def _store(docs, factory=None):
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+        BruteForceKnnFactory,
+    )
+    from pathway_tpu.xpacks.llm.document_store import DocumentStore
+
+    emb = FakeEmbedder()
+    factory = factory or BruteForceKnnFactory(
+        dimensions=8, embedder=emb, reserved_space=64
+    )
+    return DocumentStore(docs, retriever_factory=factory)
+
+
+def _retrieve(store, query, k=4, metadata_filter=None, glob=None):
+    queries = pw.debug.table_from_rows(
+        store.RetrieveQuerySchema,
+        [(query, k, metadata_filter, glob)],
+    )
+    result = store.retrieve_query(queries)
+    (cap,) = run_tables(result)
+    ((res,),) = cap.state.rows.values()
+    return [d["text"] for d in res.value]
+
+
+_CORPUS = [
+    ("apple pie recipe", "docs/food/pie.txt"),
+    ("banana bread recipe", "docs/food/bread.txt"),
+    ("rocket engine manual", "docs/tech/rocket.txt"),
+]
+
+
+@pytest.mark.parametrize(
+    "glob,expected_subset",
+    [
+        ("docs/food/*", {"apple pie recipe", "banana bread recipe"}),
+        ("docs/tech/*", {"rocket engine manual"}),
+        ("**/*.txt", None),  # everything
+        ("docs/nothing/*", set()),
+    ],
+)
+def test_glob_filtering_limits_candidates(glob, expected_subset):
+    store = _store(_docs_with_metadata(_CORPUS))
+    got = set(_retrieve(store, "recipe", k=4, glob=glob))
+    pw.G.clear()
+    if expected_subset is None:
+        assert got == {t for t, _p in _CORPUS}
+    else:
+        assert got == expected_subset
+
+
+@pytest.mark.parametrize(
+    "metadata_filter,expected",
+    [
+        (
+            "contains(path, `food`)",
+            {"apple pie recipe", "banana bread recipe"},
+        ),
+        ("path == `docs/tech/rocket.txt`", {"rocket engine manual"}),
+    ],
+)
+def test_metadata_jmespath_filtering(metadata_filter, expected):
+    store = _store(_docs_with_metadata(_CORPUS))
+    got = set(
+        _retrieve(store, "anything", k=4, metadata_filter=metadata_filter)
+    )
+    pw.G.clear()
+    assert got == expected
+
+
+def test_metadata_and_glob_compose():
+    store = _store(_docs_with_metadata(_CORPUS))
+    got = _retrieve(
+        store,
+        "recipe",
+        k=4,
+        metadata_filter="contains(path, `recipe`) || contains(path, `pie`)",
+        glob="docs/food/*",
+    )
+    pw.G.clear()
+    assert set(got) <= {"apple pie recipe", "banana bread recipe"}
+
+
+def test_hybrid_index_glob_filtering():
+    from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25Factory
+    from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndexFactory
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+        BruteForceKnnFactory,
+    )
+
+    emb = FakeEmbedder()
+    hybrid = HybridIndexFactory(
+        [
+            BruteForceKnnFactory(
+                dimensions=8, embedder=emb, reserved_space=64
+            ),
+            TantivyBM25Factory(),
+        ]
+    )
+    store = _store(_docs_with_metadata(_CORPUS), factory=hybrid)
+    got = set(_retrieve(store, "recipe", k=4, glob="docs/food/*"))
+    pw.G.clear()
+    assert got == {"apple pie recipe", "banana bread recipe"}
+
+
+def test_docstore_on_table_without_metadata():
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(data=str), [("plain doc",)]
+    )
+    store = _store(docs)
+    got = _retrieve(store, "plain", k=1)
+    pw.G.clear()
+    assert got == ["plain doc"]
+
+
+def test_docstore_inputs_listing():
+    store = _store(_docs_with_metadata(_CORPUS))
+    queries = pw.debug.table_from_rows(
+        store.InputsQuerySchema, [(None, None)]
+    )
+    result = store.inputs_query(queries)
+    (cap,) = run_tables(result)
+    ((res,),) = cap.state.rows.values()
+    paths = {d["path"] for d in res.value}
+    pw.G.clear()
+    assert paths == {p for _t, p in _CORPUS}
+
+
+def test_retrieve_scores_are_monotone():
+    store = _store(_docs_with_metadata(_CORPUS))
+    queries = pw.debug.table_from_rows(
+        store.RetrieveQuerySchema, [("apple pie recipe", 3, None, None)]
+    )
+    result = store.retrieve_query(queries)
+    (cap,) = run_tables(result)
+    ((res,),) = cap.state.rows.values()
+    scores = [d["dist"] for d in res.value]
+    pw.G.clear()
+    assert scores == sorted(scores)  # nearest first
