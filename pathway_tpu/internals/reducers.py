@@ -680,7 +680,15 @@ def _make_compute_hll(precision: int):
 def count_distinct_approximate(*args, precision: int = 12):
     """HyperLogLog estimate of the number of distinct values (reference:
     reducers.py count_distinct_approximate:837; 2^precision buckets,
-    precision in [4, 18])."""
+    precision in [4, 18]).
+
+    Retraction cost: HLL registers are not subtractable, so ANY
+    retraction in a group drops the sketch and recomputes it over the
+    group's surviving rows — O(group size) per retracting batch. This is
+    strictly more capable than the reference (which restricts the
+    reducer to append-only streams) but makes retractions in very large
+    groups expensive; for retraction-heavy workloads over big groups use
+    exact ``count_distinct`` or pre-aggregate."""
     if not 4 <= precision <= 18:
         raise ValueError(
             "count_distinct_approximate: precision must be between 4 and 18"
