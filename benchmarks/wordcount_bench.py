@@ -18,6 +18,8 @@ import sys
 import tempfile
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def generate_input(directory: str, n_rows: int, vocab: int = 10_000) -> None:
     rng = random.Random(7)
