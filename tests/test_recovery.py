@@ -483,3 +483,61 @@ def test_gdrive_restart_serves_from_object_cache(tmp_path):
     assert sorted(got2) == [b"content-f1", b"content-f2"]
     # second run: all bytes from the cache
     assert downloads["n"] == 2
+
+
+# -- persistence over an object-store backend (r5: parity with the
+# reference's S3/Azure persistence backends through the whole engine) ----
+
+
+def test_persistence_resume_over_fake_s3_backend():
+    """Input snapshots + resume with the persistence backend living in an
+    object store (reference: persistence backends/s3.rs) — full engine
+    path, injectable client."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from _fakes import FakeObjectClient
+
+    import pathway_tpu as pw
+    from pathway_tpu.persistence import Backend, Config, ObjectStoreBackend
+
+    client = FakeObjectClient()
+
+    def run_once(rows):
+        class Subject(pw.io.python.ConnectorSubject):
+            def run(self):
+                for k, v in rows:
+                    self.next(k=k, v=v)
+                    self.commit()
+
+        t = pw.io.python.read(
+            Subject(),
+            schema=pw.schema_from_types(k=str, v=int),
+            name="src1",
+        )
+        agg = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+        got = []
+        pw.io.subscribe(
+            agg,
+            on_change=lambda key, row, time, is_addition: got.append(
+                (row["k"], row["s"], is_addition)
+            ),
+        )
+        backend = Backend(ObjectStoreBackend(client, "persist/run"))
+        pw.run(
+            monitoring_level=pw.MonitoringLevel.NONE,
+            persistence_config=Config(backend=backend),
+        )
+        pw.G.clear()
+        return got
+
+    first = run_once([("a", 1), ("a", 2)])
+    assert ("a", 3, True) in first
+    # resume: the replayed history must not double-count, and new rows
+    # fold onto the restored state
+    second = run_once([("a", 4)])
+    final = [e for e in second if e[2]][-1]
+    assert final == ("a", 7, True)
+    # the log really lives in the object store
+    assert any(k.startswith("persist/run") for k in client.objects)
