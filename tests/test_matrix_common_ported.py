@@ -1268,3 +1268,83 @@ def test_groupby_foreign_absorb_does_not_clobber_user_column():
         foreign=pw.reducers.sum(flags.extra),
     )
     assert _rows_plain(r) == [("a", 3, 300)]
+
+
+# -- value-model round trip (reference: test_api.py test_value_type_via_
+# python — every engine value type survives table -> udf -> capture) ------
+
+
+@pytest.mark.parametrize(
+    "value,typ",
+    [
+        (None, type(None)),
+        (True, bool),
+        (42, int),
+        (-(2**62), int),
+        (2**70, int),  # arbitrary precision
+        (1.5, float),
+        (float("inf"), float),
+        ("text", str),
+        ("", str),
+        (b"\x00\xff", bytes),
+        ((1, "a", None), tuple),
+        ((), tuple),
+        (np.int64(7), np.int64),
+        (np.float32(2.5), np.float32),
+    ],
+    ids=lambda v: repr(v)[:20],
+)
+def test_value_round_trips_through_engine(value, typ):
+    import datetime
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(v=typ if typ is not type(None) else object)
+        if typ is not type(None)
+        else pw.schema_from_types(v=object),
+        [(value,)],
+    )
+
+    @pw.udf
+    def ident(x):
+        return x
+
+    r = t.select(v=ident(t.v))
+    ((got,),) = _rows(r)
+    if isinstance(value, float) and value != value:
+        assert got != got
+    elif isinstance(value, (np.generic,)):
+        assert got == value
+    else:
+        assert got == value and type(got) is type(value)
+
+
+@pytest.mark.parametrize(
+    "value",
+    [
+        __import__("datetime").datetime(2024, 5, 1, 12, 30),
+        __import__("datetime").datetime(
+            2024, 5, 1, tzinfo=__import__("datetime").timezone.utc
+        ),
+        __import__("datetime").timedelta(days=2, seconds=5),
+        np.array([1.0, 2.0]),
+        pw.Json({"k": [1, None]}),
+    ],
+    ids=["naive_dt", "utc_dt", "timedelta", "ndarray", "json"],
+)
+def test_rich_value_round_trips_through_engine(value):
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(v=type(value)), [(value,)]
+    )
+
+    @pw.udf
+    def ident(x):
+        return x
+
+    r = t.select(v=ident(t.v))
+    ((got,),) = _rows(r)
+    if isinstance(value, np.ndarray):
+        assert np.array_equal(np.asarray(got), value)
+    elif isinstance(value, pw.Json):
+        assert got.value == value.value
+    else:
+        assert got == value
