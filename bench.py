@@ -37,6 +37,10 @@ N_DOCS = 16384
 N_FILES = 8
 N_QUERIES = 32
 K = 6
+METRIC = (
+    "docs/sec embedded+indexed, framework path "
+    "(fs connector -> DocumentStore -> fused TPU KNN)"
+)
 BASELINE_DOCS_PER_SEC = 10_000.0
 
 _WORDS = (
@@ -333,7 +337,49 @@ def _rtt_floor_ms() -> float:
     return float(np.median(rtts))
 
 
+def _device_healthy(timeout_s: float = 120.0) -> str | None:
+    """Probe the device in a SUBPROCESS with a hard timeout: behind the
+    tunnel a dead backend hangs even trivial dispatches indefinitely, and
+    an in-process hang cannot be interrupted. Returns an error string
+    when the device is unusable."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax, jax.numpy as jnp, numpy as np;"
+        "print(float(np.asarray(jax.jit(lambda a: (a@a).sum())"
+        "(jnp.ones((64,64))))))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+        )
+        if proc.returncode != 0:
+            return f"device probe failed: {proc.stderr[-300:]}"
+        return None
+    except subprocess.TimeoutExpired:
+        return f"device probe hung for {timeout_s}s (tunnel down?)"
+
+
 def main() -> None:
+    err = _device_healthy()
+    if err is not None:
+        # a parseable artifact beats a driver-side timeout with nothing
+        print(
+            json.dumps(
+                {
+                    "metric": METRIC,
+                    "value": None,
+                    "unit": "docs/s",
+                    "vs_baseline": None,
+                    "error": err,
+                }
+            )
+        )
+        return
     rng = random.Random(7)
     docs = make_docs(N_DOCS, rng)
     with tempfile.TemporaryDirectory() as tmp:
@@ -377,10 +423,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": (
-                    "docs/sec embedded+indexed, framework path "
-                    "(fs connector -> DocumentStore -> fused TPU KNN)"
-                ),
+                "metric": METRIC,
                 "value": round(docs_per_sec, 1),
                 "unit": "docs/s",
                 "vs_baseline": round(docs_per_sec / BASELINE_DOCS_PER_SEC, 3),

@@ -9,6 +9,7 @@ unwrap, groupby matrix, join matrix, update_cells/rows, universe algebra,
 misc (to_pandas / streams / append-only).
 """
 
+import datetime
 import operator
 
 import numpy as np
@@ -1284,6 +1285,7 @@ def test_groupby_foreign_absorb_does_not_clobber_user_column():
         (2**70, int),  # arbitrary precision
         (1.5, float),
         (float("inf"), float),
+        (float("nan"), float),
         ("text", str),
         ("", str),
         (b"\x00\xff", bytes),
@@ -1295,12 +1297,8 @@ def test_groupby_foreign_absorb_does_not_clobber_user_column():
     ids=lambda v: repr(v)[:20],
 )
 def test_value_round_trips_through_engine(value, typ):
-    import datetime
-
     t = pw.debug.table_from_rows(
-        pw.schema_from_types(v=typ if typ is not type(None) else object)
-        if typ is not type(None)
-        else pw.schema_from_types(v=object),
+        pw.schema_from_types(v=object if typ is type(None) else typ),
         [(value,)],
     )
 
@@ -1321,11 +1319,9 @@ def test_value_round_trips_through_engine(value, typ):
 @pytest.mark.parametrize(
     "value",
     [
-        __import__("datetime").datetime(2024, 5, 1, 12, 30),
-        __import__("datetime").datetime(
-            2024, 5, 1, tzinfo=__import__("datetime").timezone.utc
-        ),
-        __import__("datetime").timedelta(days=2, seconds=5),
+        datetime.datetime(2024, 5, 1, 12, 30),
+        datetime.datetime(2024, 5, 1, tzinfo=datetime.timezone.utc),
+        datetime.timedelta(days=2, seconds=5),
         np.array([1.0, 2.0]),
         pw.Json({"k": [1, None]}),
     ],
