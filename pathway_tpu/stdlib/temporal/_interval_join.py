@@ -158,11 +158,21 @@ class IntervalJoinResult(JoinResult):
         interval_: Interval,
         on: tuple,
         mode: JoinMode,
+        remap=None,
     ):
-        super().__init__(left, right, on, mode=mode)
-        mapping = {thisclass.left: left, thisclass.right: right, thisclass.this: left}
-        self._left_time = desugar(left_time_expr, mapping)
-        self._right_time = desugar(right_time_expr, mapping)
+        super().__init__(left, right, on, mode=mode, remap=remap)
+        # each side's time expression resolves pw.this against ITS OWN
+        # table (reference semantics)
+        self._left_time = desugar(
+            left_time_expr,
+            {thisclass.left: left, thisclass.right: right,
+             thisclass.this: left},
+        )
+        self._right_time = desugar(
+            right_time_expr,
+            {thisclass.left: left, thisclass.right: right,
+             thisclass.this: right},
+        )
         self._interval = interval_
 
     def _join_node(self, ctx):
@@ -226,30 +236,69 @@ def interval_join(
     """
     if isinstance(how, str):
         how = JoinMode[how.upper()]
+    remap = None
+    if behavior is not None:
+        # behaviors gate the join's INPUT sides (reference: interval
+        # joins apply cutoff/forgetting on each side's time column).
+        # User expressions keep referencing the ORIGINAL tables; the
+        # JoinResult remap machinery rebinds them onto the gated copies.
+        from pathway_tpu.stdlib.temporal._window import (
+            _apply_behavior_on_time,
+            _remap_by_name,
+        )
+
+        lt = desugar(
+            self_time,
+            {thisclass.left: self, thisclass.right: other,
+             thisclass.this: self},
+        )
+        rt = desugar(
+            other_time,
+            {thisclass.left: self, thisclass.right: other,
+             thisclass.this: other},
+        )
+        new_left = _apply_behavior_on_time(self, lt, behavior)
+        new_right = _apply_behavior_on_time(other, rt, behavior)
+        # right entries first: on a SELF-join (self is other) the left
+        # side wins the collision, matching the no-behavior resolver's
+        # left-first precedence
+        remap = {}
+        for c in other.column_names():
+            remap[(id(other), c)] = new_right[c]
+        for c in self.column_names():
+            remap[(id(self), c)] = new_left[c]
+        self_time = _remap_by_name(lt, new_left)
+        other_time = _remap_by_name(rt, new_right)
+        self, other = new_left, new_right
     return IntervalJoinResult(
-        self, other, self_time, other_time, interval, on, how
+        self, other, self_time, other_time, interval, on, how,
+        remap=remap,
     )
 
 
 def interval_join_inner(self, other, self_time, other_time, interval, *on, **kw):
     return interval_join(
-        self, other, self_time, other_time, interval, *on, how=JoinMode.INNER
+        self, other, self_time, other_time, interval, *on,
+        how=JoinMode.INNER, **kw,
     )
 
 
 def interval_join_left(self, other, self_time, other_time, interval, *on, **kw):
     return interval_join(
-        self, other, self_time, other_time, interval, *on, how=JoinMode.LEFT
+        self, other, self_time, other_time, interval, *on,
+        how=JoinMode.LEFT, **kw,
     )
 
 
 def interval_join_right(self, other, self_time, other_time, interval, *on, **kw):
     return interval_join(
-        self, other, self_time, other_time, interval, *on, how=JoinMode.RIGHT
+        self, other, self_time, other_time, interval, *on,
+        how=JoinMode.RIGHT, **kw,
     )
 
 
 def interval_join_outer(self, other, self_time, other_time, interval, *on, **kw):
     return interval_join(
-        self, other, self_time, other_time, interval, *on, how=JoinMode.OUTER
+        self, other, self_time, other_time, interval, *on,
+        how=JoinMode.OUTER, **kw,
     )

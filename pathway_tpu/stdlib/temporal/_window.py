@@ -213,58 +213,81 @@ def _wrap_temporal(table: Table, node_cls, threshold_expr, time_expr, **kw) -> T
     return Table(schema=table._schema, universe=Universe(), build=build)
 
 
-def _apply_behavior(flat2: Table, time_on_flat, behavior) -> Table:
-    """Wrap the flattened window-assignment table with buffer/freeze/forget
-    per the behavior (reference: temporal_behavior.py applied in _window.py
-    _apply; engine ops time_column.rs)."""
-    from pathway_tpu.engine.temporal_nodes import BufferNode, ForgetNode, FreezeNode
+def _behavior_plan(behavior, start_of, end_of):
+    """[(node_cls, threshold_of)] for a behavior. `start_of`/`end_of`
+    map the current table to the buffer/cutoff anchor expressions —
+    window bounds for windowby, the raw time column for join inputs —
+    so BOTH appliers share one branch structure (reference:
+    temporal_behavior.py; engine ops time_column.rs)."""
+    from pathway_tpu.engine.temporal_nodes import (
+        BufferNode,
+        ForgetNode,
+        FreezeNode,
+    )
     from pathway_tpu.stdlib.temporal.temporal_behavior import (
         CommonBehavior,
         ExactlyOnceBehavior,
     )
 
-    out = flat2
+    plan = []
+    if isinstance(behavior, ExactlyOnceBehavior):
+        shift = behavior.shift
 
-    def wrap(node_cls, threshold_of, **kw):
-        nonlocal out
+        def threshold(t):
+            end = end_of(t)
+            return end + shift if shift else end
+
+        plan.append((FreezeNode, threshold))
+        plan.append((BufferNode, threshold))
+    elif isinstance(behavior, CommonBehavior):
+        if behavior.delay is not None:
+            plan.append(
+                (BufferNode, lambda t: start_of(t) + behavior.delay)
+            )
+        if behavior.cutoff is not None:
+            plan.append(
+                (FreezeNode, lambda t: end_of(t) + behavior.cutoff)
+            )
+            if not behavior.keep_results:
+                plan.append(
+                    (ForgetNode, lambda t: end_of(t) + behavior.cutoff)
+                )
+    return plan
+
+
+def _apply_plan(table: Table, time_expr, plan) -> Table:
+    out = table
+    for node_cls, threshold_of in plan:
         # expressions must rebind onto the current (possibly already
         # wrapped) table — columns keep their names through the chain
         out = _wrap_temporal(
             out,
             node_cls,
             threshold_of(out),
-            _remap_by_name(time_on_flat, out),
-            **kw,
+            _remap_by_name(time_expr, out),
         )
-
-    if isinstance(behavior, ExactlyOnceBehavior):
-        shift = behavior.shift
-
-        def threshold(t):
-            end = t["_pw_window_end"]
-            return end + shift if shift is not None else end
-
-        wrap(FreezeNode, threshold)
-        wrap(BufferNode, threshold)
-        return out
-    if isinstance(behavior, CommonBehavior):
-        if behavior.delay is not None:
-            wrap(
-                BufferNode,
-                lambda t: t["_pw_window_start"] + behavior.delay,
-            )
-        if behavior.cutoff is not None:
-            wrap(
-                FreezeNode,
-                lambda t: t["_pw_window_end"] + behavior.cutoff,
-            )
-            if not behavior.keep_results:
-                wrap(
-                    ForgetNode,
-                    lambda t: t["_pw_window_end"] + behavior.cutoff,
-                )
-        return out
     return out
+
+
+def _apply_behavior(flat2: Table, time_on_flat, behavior) -> Table:
+    """Wrap the flattened window-assignment table with buffer/freeze/forget
+    per the behavior, anchored on the window bounds columns."""
+    plan = _behavior_plan(
+        behavior,
+        start_of=lambda t: t["_pw_window_start"],
+        end_of=lambda t: t["_pw_window_end"],
+    )
+    return _apply_plan(flat2, time_on_flat, plan)
+
+
+def _apply_behavior_on_time(table: Table, time_expr, behavior) -> Table:
+    """Behavior gating keyed on a plain TIME column (interval/asof join
+    inputs): delay buffers rows until time+delay, cutoff freezes/forgets
+    rows behind time+cutoff. Same plan as _apply_behavior with the time
+    column as both anchor bounds."""
+    anchor = lambda t: _remap_by_name(time_expr, t)  # noqa: E731
+    plan = _behavior_plan(behavior, start_of=anchor, end_of=anchor)
+    return _apply_plan(table, time_expr, plan)
 
 
 def windowby(
