@@ -82,9 +82,28 @@ class AsyncTransformerNode(Node):
                         f"async transformer: {type(result).__name__}: {result}"
                     )
                     row = (*(ERROR for _ in self.output_names), False)
+                elif not isinstance(result, dict) or set(result) != set(
+                    self.output_names
+                ):
+                    # keys-only schema validation: extra or missing
+                    # columns fail the row (reference:
+                    # test_async_transformer.py test_fails_on_too_many_
+                    # columns / not_enough_columns). Value DTYPES are not
+                    # checked here.
+                    got = (
+                        sorted(result, key=repr)
+                        if isinstance(result, dict)
+                        else type(result).__name__
+                    )
+                    self.log_error(
+                        "async transformer: result does not match the "
+                        f"output schema: got {got}, "
+                        f"expected {sorted(self.output_names)}"
+                    )
+                    row = (*(ERROR for _ in self.output_names), False)
                 else:
                     row = (
-                        *(result.get(n) for n in self.output_names),
+                        *(result[n] for n in self.output_names),
                         True,
                     )
                 prev = self.emitted.get(key)
