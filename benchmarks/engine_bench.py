@@ -127,6 +127,122 @@ def bench_wordcount(n_rows=5_000_000, vocab=10_000, batch=200_000):
     return rps
 
 
+def _node_seconds(log_path, node_types):
+    """Sum per-node wall time from a PATHWAY_NODE_TIMING_LOG dump for
+    the given node class names — isolates the operator under test from
+    source/capture/exchange overhead shared by both paths."""
+    secs = 0.0
+    with open(log_path) as fh:
+        for line in fh:
+            ent = json.loads(line)
+            if ent.get("type") in node_types:
+                secs += ent["total_s"]
+    return secs
+
+
+def _ab_columnar(build_fn, module, flag_name, node_types):
+    """Run `build_fn`'s pipeline twice — classic vs columnar build-time
+    selection — returning {path: node-isolated seconds}."""
+    import tempfile
+
+    out = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        for label, enabled in (("classic", False), ("columnar", True)):
+            log = _os.path.join(tmp, f"{label}.jsonl")
+            saved_env = _os.environ.get("PATHWAY_NODE_TIMING_LOG")
+            _os.environ["PATHWAY_NODE_TIMING_LOG"] = log
+            saved = getattr(module, flag_name)
+            setattr(module, flag_name, enabled)
+            try:
+                run_tables(build_fn(), record_stream=True)
+            finally:
+                setattr(module, flag_name, saved)
+                if saved_env is None:
+                    del _os.environ["PATHWAY_NODE_TIMING_LOG"]
+                else:
+                    _os.environ["PATHWAY_NODE_TIMING_LOG"] = saved_env
+            out[label] = _node_seconds(log, node_types[label])
+    return out
+
+
+def bench_join_columnar(n_left=100_000, n_right=1_000):
+    """Inner-join microbench, classic JoinNode vs columnar VectorJoinNode
+    (engine/vector_join.py).  Shape: small build side arrives first, then
+    one 100k-row probe-side batch — the delta-mode fused C pass (code
+    lookup + match expansion + bucket update) is the measured kernel."""
+    from pathway_tpu.engine import vector_join
+
+    lschema = schema_from_types(k=int, a=int)
+    rschema = schema_from_types(k=int, b=int)
+    right_events = [
+        (2, (ref_scalar("r", i), (i, i * 10), 1)) for i in range(n_right)
+    ]
+    left_events = [
+        (4, (ref_scalar("l", i), (i % n_right, i), 1)) for i in range(n_left)
+    ]
+
+    def build():
+        left = table_from_events(lschema, list(left_events))
+        right = table_from_events(rschema, list(right_events))
+        return left.join(right, left.k == right.k).select(
+            pw.left.a, pw.right.b
+        )
+
+    secs = _ab_columnar(
+        build,
+        vector_join,
+        "VECTOR_JOIN_ENABLED",
+        {"classic": ("JoinNode",), "columnar": ("VectorJoinNode",)},
+    )
+    n = n_left + n_right
+    ratio = secs["classic"] / secs["columnar"]
+    print(json.dumps({
+        "metric": "join_columnar_rows_per_sec",
+        "value": round(n / secs["columnar"]),
+        "unit": "rows/s through the join node (100k-row inner join)",
+        "classic_rows_per_sec": round(n / secs["classic"]),
+        "classic_s": round(secs["classic"], 4),
+        "columnar_s": round(secs["columnar"], 4),
+        "columnar_vs_classic": round(ratio, 2),
+    }))
+    return ratio
+
+
+def bench_flatten_columnar(n_rows=100_000, width=4):
+    """List-flatten microbench, classic FlattenNode vs columnar
+    VectorFlattenNode (engine/vector_flatten.py): vectorized derived-key
+    mixer + fused triple assembly vs per-element Python."""
+    from pathway_tpu.engine import vector_flatten
+
+    schema = schema_from_types(i=int, vs=list)
+    events = [
+        (2, (ref_scalar("b", i), (i, [i, i + 1, i + 2, i + 3][:width]), 1))
+        for i in range(n_rows)
+    ]
+
+    def build():
+        t = table_from_events(schema, list(events))
+        return t.flatten(pw.this.vs)
+
+    secs = _ab_columnar(
+        build,
+        vector_flatten,
+        "VECTOR_FLATTEN_ENABLED",
+        {"classic": ("FlattenNode",), "columnar": ("VectorFlattenNode",)},
+    )
+    ratio = secs["classic"] / secs["columnar"]
+    print(json.dumps({
+        "metric": "flatten_columnar_rows_per_sec",
+        "value": round(n_rows / secs["columnar"]),
+        "unit": f"parent rows/s through the flatten node (x{width} lists)",
+        "classic_rows_per_sec": round(n_rows / secs["classic"]),
+        "classic_s": round(secs["classic"], 4),
+        "columnar_s": round(secs["columnar"], 4),
+        "columnar_vs_classic": round(ratio, 2),
+    }))
+    return ratio
+
+
 def bench_wordcount_multiworker(n_rows=2_000_000, workers=(1, 2, 4)):
     """Same wordcount through the full multi-process data-parallel path:
     N workers, replicated fs json source (each keeps its key shard), TCP
@@ -330,6 +446,11 @@ if __name__ == "__main__":
         bench_wordcount_multiworker()
     elif "--tick-overhead" in _sys.argv:
         bench_tick_overhead()
+    elif "--columnar" in _sys.argv:
+        bench_join_columnar()
+        bench_flatten_columnar()
     else:
         bench_group_update_flatness()
         bench_wordcount()
+        bench_join_columnar()
+        bench_flatten_columnar()

@@ -63,6 +63,7 @@ class JoinNode(Node):
     """
 
     name = "join"
+    path = "classic"
     snapshot_attrs = ('left_index', 'right_index', 'cache')
 
     def __init__(
@@ -193,6 +194,8 @@ class JoinNode(Node):
         right_deltas = self.take(1)
         if not left_deltas and not right_deltas:
             return
+        self.rows_processed += len(left_deltas) + len(right_deltas)
+        self.batches_processed += 1
         if self._delta_mode:
             self._process_delta(left_deltas, right_deltas, time)
             return
@@ -284,6 +287,7 @@ class ReduceNode(Node):
     """
 
     name = "reduce"
+    path = "classic"
     snapshot_attrs = ('groups', 'cache', '_seq')
 
     def __init__(
@@ -323,6 +327,8 @@ class ReduceNode(Node):
         deltas = self.take(0)
         if not deltas:
             return
+        self.rows_processed += len(deltas)
+        self.batches_processed += 1
         keys = [d[0] for d in deltas]
         rows = ([d[1] for d in deltas],)
         gks = self.group_fn(keys, rows)
@@ -638,6 +644,7 @@ class FlattenNode(Node):
     cheaper than a cryptographic hash on the bulk-ingest path."""
 
     name = "flatten"
+    path = "classic"
 
     # odd 128-bit mix constants (golden-ratio style)
     _MIX = 0x9E3779B97F4A7C15F39CC0605CEDC835
@@ -665,6 +672,8 @@ class FlattenNode(Node):
         deltas = self.take(0)
         if not deltas:
             return
+        self.rows_processed += len(deltas)
+        self.batches_processed += 1
         out: List[Delta] = []
         for key, values, diff in deltas:
             seq = values[self.flat_idx]

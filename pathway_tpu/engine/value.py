@@ -271,6 +271,390 @@ def _fast_pointer_builder():
     return _fast_pointers
 
 
+_fast_pairs = None
+_fast_pairs_checked = False
+
+
+def _fast_pair_builder():
+    """Native bulk `ref_scalar(lk, rk)` (blake2b-128 over the serialized
+    key pair), verified once against the python derivation before use."""
+    global _fast_pairs, _fast_pairs_checked
+    if _fast_pairs_checked:
+        return _fast_pairs
+    _fast_pairs_checked = True
+    try:
+        from pathway_tpu import native
+
+        ext = native.load_wire_ext()
+        if ext is None or not hasattr(ext, "make_pair_pointers"):
+            return None
+        lk = Pointer(0xDEADBEEF00112233445566778899AABB)
+        rk = Pointer(0x0102030405060708090A0B0C0D0E0F10)
+        (made,) = ext.make_pair_pointers(
+            lk.value.to_bytes(16, "little"), rk.value.to_bytes(16, "little")
+        )
+        ref = ref_scalar(lk, rk)
+        if (
+            type(made) is Pointer
+            and made == ref
+            and hash(made) == hash(ref)
+            and made.value == ref.value
+            and made._origin is None
+        ):
+            _fast_pairs = ext.make_pair_pointers
+    except Exception:  # noqa: BLE001 — python derivation always works
+        _fast_pairs = None
+    return _fast_pairs
+
+
+def pair_keys_batch(lvals: bytes, rvals: bytes) -> list:
+    """`[ref_scalar(lk, rk) for lk, rk in pairs]` from concatenated
+    16-byte little-endian key values — the columnar join's output-key
+    kernel. Native when available; a tight hashlib loop otherwise (still
+    several times cheaper than generic ref_scalar per pair)."""
+    fast = _fast_pair_builder()
+    if fast is not None:
+        return fast(lvals, rvals)
+    from hashlib import blake2b
+
+    n = len(lvals) // 16
+    out = []
+    append = out.append
+    for i in range(n):
+        o = i * 16
+        msg = b"\x06" + lvals[o : o + 16] + b"\x06" + rvals[o : o + 16]
+        append(
+            Pointer(
+                int.from_bytes(
+                    blake2b(msg, digest_size=16).digest(), "little"
+                )
+            )
+        )
+    return out
+
+
+_fast_u128 = None
+_fast_u128_checked = False
+
+
+def _fast_u128_builder():
+    """Native bulk Pointer constructor over varying 128-bit values
+    (make_seq_pointers covers only a constant high limb), verified once."""
+    global _fast_u128, _fast_u128_checked
+    if _fast_u128_checked:
+        return _fast_u128
+    _fast_u128_checked = True
+    try:
+        from pathway_tpu import native
+
+        ext = native.load_wire_ext()
+        if ext is None or not hasattr(ext, "make_pointers_u128"):
+            return None
+        probe = 0xFEDCBA9876543210FEDCBA9876543210
+        (made,) = ext.make_pointers_u128(probe.to_bytes(16, "little"))
+        ref = Pointer(probe)
+        if (
+            type(made) is Pointer
+            and made == ref
+            and hash(made) == hash(ref)
+            and made.value == ref.value
+            and made._origin is None
+        ):
+            _fast_u128 = ext.make_pointers_u128
+    except Exception:  # noqa: BLE001
+        _fast_u128 = None
+    return _fast_u128
+
+
+def pointers_u128_batch(vals: bytes) -> list:
+    """`[Pointer(v) for v in 16-byte-LE records]` — bulk materialization
+    of precomputed 128-bit key values (flatten's vectorized derive)."""
+    fast = _fast_u128_builder()
+    if fast is not None:
+        return fast(vals)
+    return [
+        Pointer(int.from_bytes(vals[o : o + 16], "little"))
+        for o in range(0, len(vals), 16)
+    ]
+
+
+_fast_join_triples = None
+_fast_join_triples_checked = False
+
+
+def _fast_join_triples_builder():
+    """Native fused join-output kernel — pair key hash, output row tuple
+    and delta triple in one C pass over the match columns. Verified once
+    against the python derivation before use."""
+    global _fast_join_triples, _fast_join_triples_checked
+    if _fast_join_triples_checked:
+        return _fast_join_triples
+    _fast_join_triples_checked = True
+    try:
+        from pathway_tpu import native
+
+        ext = native.load_wire_ext()
+        if ext is None or not hasattr(ext, "make_join_triples"):
+            return None
+        lk = Pointer(0xDEADBEEF00112233445566778899AABB)
+        rk = Pointer(0x0102030405060708090A0B0C0D0E0F10)
+        (made,) = ext.make_join_triples([lk], [rk], [(1, "x")], [(2.5,)], [1])
+        ref_key = ref_scalar(lk, rk)
+        key, row, diff = made
+        if (
+            type(key) is Pointer
+            and key == ref_key
+            and hash(key) == hash(ref_key)
+            and key.value == ref_key.value
+            and key._origin is None
+            and row == (lk, rk, 1, "x", 2.5)
+            and diff == 1
+        ):
+            _fast_join_triples = ext.make_join_triples
+    except Exception:  # noqa: BLE001 — python derivation always works
+        _fast_join_triples = None
+    return _fast_join_triples
+
+
+def join_triples_batch(lks: list, rks: list, lrows: list, rrows: list, diffs: list) -> list:
+    """`[(ref_scalar(lk, rk), (lk, rk, *lrow, *rrow), d), ...]` over five
+    parallel match columns — the columnar join's entire output assembly in
+    one call (native when available)."""
+    fast = _fast_join_triples_builder()
+    if fast is not None:
+        return fast(lks, rks, lrows, rrows, diffs)
+    return [
+        (ref_scalar(a, b), (a, b) + ar + br, d)
+        for a, b, ar, br, d in zip(lks, rks, lrows, rrows, diffs)
+    ]
+
+
+_fast_pair_list = None
+_fast_pair_list_checked = False
+
+
+def _fast_pair_list_builder():
+    global _fast_pair_list, _fast_pair_list_checked
+    if _fast_pair_list_checked:
+        return _fast_pair_list
+    _fast_pair_list_checked = True
+    try:
+        from pathway_tpu import native
+
+        ext = native.load_wire_ext()
+        if ext is None or not hasattr(ext, "make_pair_pointers_list"):
+            return None
+        lk = Pointer(0xDEADBEEF00112233445566778899AABB)
+        rk = Pointer(0x0102030405060708090A0B0C0D0E0F10)
+        (made,) = ext.make_pair_pointers_list([lk], [rk])
+        ref = ref_scalar(lk, rk)
+        if (
+            type(made) is Pointer
+            and made == ref
+            and hash(made) == hash(ref)
+            and made.value == ref.value
+            and made._origin is None
+        ):
+            _fast_pair_list = ext.make_pair_pointers_list
+    except Exception:  # noqa: BLE001
+        _fast_pair_list = None
+    return _fast_pair_list
+
+
+def pair_keys_from_pointers(lks: list, rks: list) -> list:
+    """`[ref_scalar(lk, rk) for ...]` from two Pointer lists (native reads
+    the value slots directly; python fallback is exact by construction)."""
+    fast = _fast_pair_list_builder()
+    if fast is not None:
+        return fast(lks, rks)
+    return [ref_scalar(a, b) for a, b in zip(lks, rks)]
+
+
+_fast_u128_triples = None
+_fast_u128_triples_checked = False
+
+
+def _fast_u128_triples_builder():
+    global _fast_u128_triples, _fast_u128_triples_checked
+    if _fast_u128_triples_checked:
+        return _fast_u128_triples
+    _fast_u128_triples_checked = True
+    try:
+        from pathway_tpu import native
+
+        ext = native.load_wire_ext()
+        if ext is None or not hasattr(ext, "make_triples_u128"):
+            return None
+        probe = 0xFEDCBA9876543210FEDCBA9876543210
+        (made,) = ext.make_triples_u128(
+            probe.to_bytes(16, "little"), [("r",)], [-1]
+        )
+        key, row, diff = made
+        ref = Pointer(probe)
+        if (
+            type(key) is Pointer
+            and key == ref
+            and hash(key) == hash(ref)
+            and key.value == ref.value
+            and key._origin is None
+            and row == ("r",)
+            and diff == -1
+        ):
+            _fast_u128_triples = ext.make_triples_u128
+    except Exception:  # noqa: BLE001
+        _fast_u128_triples = None
+    return _fast_u128_triples
+
+
+def triples_u128_batch(vals: bytes, rows: list, diffs: list) -> list:
+    """`[(Pointer(v_i), rows[i], diffs[i]), ...]` from 16-byte-LE key
+    records — the flatten path's bulk output assembly."""
+    fast = _fast_u128_triples_builder()
+    if fast is not None:
+        return fast(vals, rows, diffs)
+    return [
+        (Pointer(int.from_bytes(vals[o : o + 16], "little")), rows[i], diffs[i])
+        for i, o in enumerate(range(0, len(vals), 16))
+    ]
+
+
+_fast_flatten_triples = None
+_fast_flatten_triples_checked = False
+
+
+def _fast_flatten_triples_builder():
+    global _fast_flatten_triples, _fast_flatten_triples_checked
+    if _fast_flatten_triples_checked:
+        return _fast_flatten_triples
+    _fast_flatten_triples_checked = True
+    try:
+        from pathway_tpu import native
+
+        ext = native.load_wire_ext()
+        if ext is None or not hasattr(ext, "flatten_triples"):
+            return None
+        v1 = 0xFEDCBA9876543210FEDCBA9876543210
+        v2 = 0x00000000000000000000000000000007
+        buf = v1.to_bytes(16, "little") + v2.to_bytes(16, "little")
+        made = ext.flatten_triples(
+            buf, [(1, "seq", 2.5)], [2], ["a", "b"], 1, [-1]
+        )
+        k1, k2 = Pointer(v1), Pointer(v2)
+        if (
+            len(made) == 2
+            and type(made[0][0]) is Pointer
+            and made[0][0] == k1
+            and hash(made[0][0]) == hash(k1)
+            and made[0][0].value == v1
+            and made[0][0]._origin is None
+            and made[0][1] == (1, "a", 2.5)
+            and made[0][2] == -1
+            and made[1][0] == k2
+            and made[1][1] == (1, "b", 2.5)
+            and made[1][2] == -1
+        ):
+            _fast_flatten_triples = ext.flatten_triples
+    except Exception:  # noqa: BLE001
+        _fast_flatten_triples = None
+    return _fast_flatten_triples
+
+
+def flatten_triples_batch(
+    vals: bytes, parents: list, counts: list, elems: list, flat_idx: int, diffs: list
+) -> list:
+    """Fused flatten output assembly: per element, the derived-key
+    Pointer (from 16-byte-LE `vals`), the parent row with the sequence
+    column replaced by the element, and the delta triple."""
+    fast = _fast_flatten_triples_builder()
+    if fast is not None:
+        return fast(vals, parents, counts, elems, flat_idx, diffs)
+    out = []
+    pos = 0
+    for row, m, diff in zip(parents, counts, diffs):
+        pre, post = row[:flat_idx], row[flat_idx + 1 :]
+        for j in range(m):
+            key = Pointer(int.from_bytes(vals[pos * 16 : pos * 16 + 16], "little"))
+            out.append((key, pre + (elems[pos],) + post, diff))
+            pos += 1
+    return out
+
+
+_fast_delta_side = None
+_fast_delta_side_checked = False
+
+
+def _fast_delta_side_probe(fn) -> bool:
+    """Exercise every kernel branch (code alloc, match + triple build,
+    Error skip, retraction) against the python-derived expectation."""
+    jv_code: dict = {}
+    left_rows: list = []
+    right_rows: list = []
+    lk = Pointer(0xDEADBEEF00112233445566778899AABB)
+    rk = Pointer(0x0102030405060708090A0B0C0D0E0F10)
+    rk2 = Pointer(0x00000000000000000000000000000042)
+    out: list = []
+    res = fn(jv_code, ["a"], [(lk, (1, "x"), 1)], left_rows, right_rows, 1, Error, out)
+    if res != (0, 0) or out or jv_code != {"a": 0}:
+        return False
+    if left_rows != [{lk: (1, "x")}] or right_rows != [{}]:
+        return False
+    res = fn(
+        jv_code,
+        ["a", Error("boom"), "a"],
+        [(rk, (2.5,), 1), (rk2, (9,), 1), (rk2, (3.5,), 1)],
+        left_rows,
+        right_rows,
+        0,
+        Error,
+        out,
+    )
+    if res != (0, 1) or len(out) != 2:
+        return False
+    ref_key = ref_scalar(lk, rk)
+    key, row, diff = out[0]
+    if not (
+        type(key) is Pointer
+        and key == ref_key
+        and hash(key) == hash(ref_key)
+        and key.value == ref_key.value
+        and key._origin is None
+        and row == (lk, rk, 1, "x", 2.5)
+        and diff == 1
+    ):
+        return False
+    if out[1][1] != (lk, rk2, 1, "x", 3.5):
+        return False
+    if right_rows != [{rk: (2.5,), rk2: (3.5,)}]:
+        return False
+    out2: list = []
+    res = fn(jv_code, ["a"], [(rk, (2.5,), -1)], left_rows, right_rows, 0, Error, out2)
+    if res != (1, 0) or len(out2) != 1 or out2[0][2] != -1:
+        return False
+    return right_rows == [{rk2: (3.5,)}]
+
+
+def join_delta_side_native():
+    """The columnar join's fused delta-mode pass (or None): one C loop
+    doing jv->code lookup, match expansion with triple construction and
+    own-bucket updates in stream order. The pure-python equivalent lives
+    in `vector_join.VectorJoinNode._delta_side_vec`."""
+    global _fast_delta_side, _fast_delta_side_checked
+    if _fast_delta_side_checked:
+        return _fast_delta_side
+    _fast_delta_side_checked = True
+    try:
+        from pathway_tpu import native
+
+        ext = native.load_wire_ext()
+        if ext is None or not hasattr(ext, "join_delta_side"):
+            return None
+        if _fast_delta_side_probe(ext.join_delta_side):
+            _fast_delta_side = ext.join_delta_side
+    except Exception:  # noqa: BLE001 — python path always works
+        _fast_delta_side = None
+    return _fast_delta_side
+
+
 def seq_key_seed(*name_parts: Any) -> int:
     """Per-source seed for seq_key (one blake2b at source setup)."""
     return hash_values(*name_parts)

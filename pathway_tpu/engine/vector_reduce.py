@@ -28,6 +28,7 @@ cannot tell them apart.
 
 from __future__ import annotations
 
+import heapq
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -36,7 +37,7 @@ from pathway_tpu.engine.engine import Engine, Node
 from pathway_tpu.engine.stream import Delta, values_equal_tuple
 from pathway_tpu.engine.value import ERROR, Error, Pointer
 
-VECTOR_REDUCERS = {"count", "sum", "min", "max"}
+VECTOR_REDUCERS = {"count", "sum", "min", "max", "avg", "any"}
 
 _INT64_MAX = np.iinfo(np.int64).max
 
@@ -46,11 +47,12 @@ class _VecCount:
 
     kind = "count"
     needs_col = False
+    needs_seq = False
 
     def state_init(self):
         return None
 
-    def apply_batch(self, state, codes, n_groups, col, signs):
+    def apply_batch(self, state, codes, n_groups, col, signs, keys, time, seqs):
         pass
 
     def result(self, state, node, g):
@@ -65,30 +67,61 @@ class _VecSum:
     declared column dtype and the batch's natural numpy dtype: an
     int column whose values exceed int64 range lands in uint64/float64
     under `np.asarray` (silent wrap / precision loss), so anything that
-    does not convert to a clean matching kind takes the object lane."""
+    does not convert to a clean matching kind takes the object lane.
+
+    Optional columns (`optional=True`) track a per-group None
+    multiplicity via a validity mask and split the numeric lane over the
+    valid rows.  While a group holds a live None its result is ERROR —
+    the classic `_SumAcc` raises on None, permanently demoting the group
+    to full recomputation whose `sum(vals)` then raises per batch (the
+    classic node logs the interpreter's TypeError text; this node logs a
+    stable one-line equivalent).  Once every None is retracted both
+    paths return the numeric total again."""
 
     kind = "sum"
     needs_col = True
+    needs_seq = False
+    track_n = False  # avg: also count numeric live contributions
 
-    def __init__(self, arg_kind: str = "i"):
+    def __init__(self, arg_kind: str = "i", optional: bool = False):
         # declared dtype kind: 'i' (int/bool) or 'f' (float)
         self.arg_kind = arg_kind
+        self.optional = optional
 
     def state_init(self):
-        # tot: per-group Python numbers; err: per-group Error multiplicity
-        return {"tot": [], "err": []}
+        # tot: per-group Python numbers; err: per-group Error
+        # multiplicity; nones: per-group None multiplicity; n: per-group
+        # numeric live count (maintained only when track_n)
+        return {"tot": [], "err": [], "nones": [], "n": []}
 
-    def apply_batch(self, state, codes, n_groups, col, signs):
+    def apply_batch(self, state, codes, n_groups, col, signs, keys, time, seqs):
         tot, err = state["tot"], state["err"]
+        nones, nnum = state["nones"], state["n"]
         while len(tot) < n_groups:
             tot.append(0)
             err.append(0)
+            nones.append(0)
+            nnum.append(0)
         n = len(col)
+        if self.optional and n:
+            valid = np.fromiter((v is not None for v in col), np.bool_, n)
+            if not valid.all():
+                inv = ~valid
+                contrib = np.bincount(
+                    codes[inv], weights=signs[inv], minlength=n_groups
+                )
+                for g in np.nonzero(contrib)[0]:
+                    nones[g] += int(contrib[g])
+                codes = codes[valid]
+                signs = signs[valid]
+                col = [v for v, ok in zip(col, valid) if ok]
+                n = len(col)
         try:
             arr0 = np.asarray(col)
             kind = arr0.dtype.kind
         except (TypeError, ValueError):
             kind = "O"
+        fast = False
         if self.arg_kind == "i" and kind in ("b", "i"):
             # int lane — kind 'u' (values >= 2^63) and 'f' (mixed
             # magnitudes promoted by asarray) would wrap or lose
@@ -102,7 +135,7 @@ class _VecSum:
                 )
                 for g in np.nonzero(contrib)[0]:
                     tot[g] = tot[g] + int(contrib[g])
-                return
+                fast = True
         elif self.arg_kind == "f" and kind in ("b", "i", "f"):
             contrib = np.bincount(
                 codes,
@@ -111,26 +144,116 @@ class _VecSum:
             )
             for g in np.nonzero(contrib)[0]:
                 tot[g] = tot[g] + float(contrib[g])
+            fast = True
+        if fast:
+            if self.track_n and n:
+                nc = np.bincount(codes, weights=signs, minlength=n_groups)
+                for g in np.nonzero(nc)[0]:
+                    nnum[g] += int(nc[g])
             return
         # object lane: big ints / Error values (non-numerics cannot reach
         # here — the build-time dtype gate admits only numeric columns)
+        track_n = self.track_n
         for i in range(n):
             v = col[i]
             g = codes[i]
-            s = signs[i]
+            # int(): a numpy sign leaking into the running totals would
+            # promote results to numpy scalars (emit contract is plain)
+            s = int(signs[i])
             if isinstance(v, Error):
                 err[g] += s
-            elif s > 0:
+                continue
+            if s > 0:
                 tot[g] = tot[g] + v
             else:
                 tot[g] = tot[g] - v
+            if track_n:
+                nnum[g] += s
 
     def result(self, state, node, g):
         err = state["err"]
         if g < len(err) and err[g]:
             return ERROR
+        nones = state["nones"]
+        if g < len(nones) and nones[g]:
+            # classic parity: the demoted group's recompute raises
+            # TypeError on the live None every batch (logged + ERROR)
+            node.log_error(f"reducer {self.kind}: non-numeric input (None)")
+            return ERROR
         tot = state["tot"]
         return tot[g] if g < len(tot) else 0
+
+
+class _VecAvg(_VecSum):
+    """avg: the sum machinery plus a per-group numeric live count;
+    result is total/n (`_AvgAcc` parity: Errors and live Nones yield
+    ERROR, an empty group yields None)."""
+
+    kind = "avg"
+    track_n = True
+
+    def result(self, state, node, g):
+        r = _VecSum.result(self, state, node, g)
+        if r is ERROR:
+            return ERROR
+        nnum = state["n"]
+        n = nnum[g] if g < len(nnum) else 0
+        if n == 0:
+            return None
+        return r / n
+
+
+class _VecAny:
+    """any: arrival-order extremum — the live row with the smallest
+    (time, seq), exactly the classic `_OrderAcc(latest=False)`: a lazy
+    heap of ((t, s), gen, value, row_key) per group plus a row_key->gen
+    live dict with threshold compaction.  Value-agnostic: Error values
+    are stored and returned like any other (classic parity), and heap
+    order never compares values ((t, s) is unique per insert)."""
+
+    kind = "any"
+    needs_col = True
+    needs_seq = True
+
+    def state_init(self):
+        return {"heaps": [], "live": [], "gen": []}
+
+    def apply_batch(self, state, codes, n_groups, col, signs, keys, time, seqs):
+        heaps, lives, gens = state["heaps"], state["live"], state["gen"]
+        while len(heaps) < n_groups:
+            heaps.append([])
+            lives.append({})
+            gens.append(0)
+        push = heapq.heappush
+        for i in range(len(col)):
+            g = codes[i]
+            key = keys[i]
+            if signs[i] > 0:
+                gens[g] += 1
+                lives[g][key] = gens[g]
+                push(heaps[g], ((time, seqs[i]), gens[g], col[i], key))
+            else:
+                live = lives[g]
+                live.pop(key, None)
+                heap = heaps[g]
+                if len(heap) > 2 * len(live) + 16:
+                    live_get = live.get
+                    heaps[g] = [nd for nd in heap if live_get(nd[3]) == nd[1]]
+                    heapq.heapify(heaps[g])
+
+    def result(self, state, node, g):
+        heaps, lives = state["heaps"], state["live"]
+        if g >= len(heaps):
+            return None
+        heap = heaps[g]
+        live_get = lives[g].get
+        while heap:
+            _k, gen, v, row_key = heap[0]
+            if live_get(row_key) != gen:
+                heapq.heappop(heap)
+                continue
+            return v
+        return None
 
 
 class _VecExtremum:
@@ -138,6 +261,7 @@ class _VecExtremum:
     rescan on retraction of the extremum (O(distinct values), rare)."""
 
     needs_col = True
+    needs_seq = False
 
     def __init__(self, mode: str):
         self.mode = mode
@@ -146,7 +270,7 @@ class _VecExtremum:
     def state_init(self):
         return {"bags": [], "cur": [], "dirty": set(), "err": []}
 
-    def apply_batch(self, state, codes, n_groups, col, signs):
+    def apply_batch(self, state, codes, n_groups, col, signs, keys, time, seqs):
         bags, cur, dirty, err = (
             state["bags"], state["cur"], state["dirty"], state["err"],
         )
@@ -188,11 +312,15 @@ class _VecExtremum:
         return state["cur"][g]
 
 
-def make_vector_reducer(name: str, arg_kind: str = "i"):
+def make_vector_reducer(name: str, arg_kind: str = "i", optional: bool = False):
     if name == "count":
         return _VecCount()
     if name == "sum":
-        return _VecSum(arg_kind)
+        return _VecSum(arg_kind, optional)
+    if name == "avg":
+        return _VecAvg(arg_kind, optional)
+    if name == "any":
+        return _VecAny()
     if name in ("min", "max"):
         return _VecExtremum(name)
     return None
@@ -205,9 +333,10 @@ class VectorReduceNode(Node):
     the classic node's ignore-absent-retraction behavior."""
 
     name = "reduce"
+    path = "columnar"
     snapshot_attrs = (
         "gid", "gkeys", "gvals_list", "code_cache", "live", "_live_log",
-        "nlive_list", "red_states", "emitted",
+        "nlive_list", "red_states", "emitted", "_seq",
     )
 
     def __init__(
@@ -221,6 +350,7 @@ class VectorReduceNode(Node):
         gval_width: int,
         group_col_progs: Optional[List[Callable]] = None,
         arg_kinds: Optional[List[str]] = None,
+        arg_optionals: Optional[List[bool]] = None,
     ):
         from pathway_tpu.engine.exchange import exchange_by_value
 
@@ -238,10 +368,16 @@ class VectorReduceNode(Node):
         # (one dict get per row); None falls back to group_fn pairs
         self.group_col_progs = group_col_progs
         kinds = arg_kinds or ["i"] * len(reducers)
+        opts = arg_optionals or [False] * len(reducers)
         self.vecs = [
-            make_vector_reducer(r.name, k) for r, k in zip(reducers, kinds)
+            make_vector_reducer(r.name, k, o)
+            for r, k, o in zip(reducers, kinds, opts)
         ]
         assert all(v is not None for v in self.vecs)
+        # arrival-order reducers (`any`) need the classic node's global
+        # insert sequence; only pay for it when one is present
+        self._needs_seq = any(v.needs_seq for v in self.vecs)
+        self._seq = 0
         self.gid: Dict[Pointer, int] = {}
         self.gkeys: List[Pointer] = []
         self.gvals_list: List[tuple] = []
@@ -432,6 +568,8 @@ class VectorReduceNode(Node):
         if not deltas:
             return
         n = len(deltas)
+        self.rows_processed += n
+        self.batches_processed += 1
         keys = [d[0] for d in deltas]
         rows = ([d[1] for d in deltas],)
 
@@ -452,13 +590,32 @@ class VectorReduceNode(Node):
         net = np.bincount(codes, weights=signs, minlength=n_groups)
         self.nlive_list[:n_groups] += net.astype(np.int64)
 
+        kept_keys = None
+        seqs = None
+        if self._needs_seq:
+            kept_keys = (
+                keys if kept_idx is None else [keys[i] for i in kept_idx]
+            )
+            # classic-node parity: one global counter, bumped once per
+            # kept insert row in batch order (retractions carry no seq)
+            seqs = np.zeros(len(codes), dtype=np.int64)
+            sq = self._seq
+            for i in range(len(codes)):
+                if signs[i] > 0:
+                    sq += 1
+                    seqs[i] = sq
+            self._seq = sq
+
         for r_idx, vec in enumerate(self.vecs):
             if not vec.needs_col:
                 continue
             col = self.arg_col_fns[r_idx](keys, rows)
             if kept_idx is not None:
                 col = [col[i] for i in kept_idx]
-            vec.apply_batch(self.red_states[r_idx], codes, n_groups, col, signs)
+            vec.apply_batch(
+                self.red_states[r_idx], codes, n_groups, col, signs,
+                kept_keys, time, seqs,
+            )
 
         affected = np.nonzero(occur)[0].tolist()
         out: List[Delta] = []

@@ -210,28 +210,38 @@ class GroupedTable:
                 break
 
         # static gate for the columnar reduce path (engine/vector_reduce.py):
-        # vector reducers only, bare non-optional numeric argument columns,
-        # deterministic args (retractions recompute them from the
-        # retraction row), default grouping keys, no ordering dependence
+        # vector reducers only, deterministic args (retractions recompute
+        # them from the retraction row), default grouping keys, no ordering
+        # dependence.  Argument dtypes: numeric for the lane reducers —
+        # Optionalized numeric admitted only for sum/avg, which carry None
+        # multiplicities columnar-ly; min/max stay classic on optional
+        # columns (the classic accumulator's None-death is path-dependent).
+        # `any` never compares values, so it takes any argument dtype.
         use_vector = sort_by is None and id_expr is None
         if use_vector:
             from pathway_tpu.engine.vector_reduce import VECTOR_REDUCERS
             from pathway_tpu.internals.table import _expr_deterministic
 
             for red in reducers:
-                if red._reducer.name not in VECTOR_REDUCERS:
+                name = red._reducer.name
+                if name not in VECTOR_REDUCERS:
                     use_vector = False
                     break
                 if not all(_expr_deterministic(a) for a in red._args):
                     use_vector = False
                     break
-                if red._args:
+                if red._args and name != "any":
                     try:
                         adt = self._infer_on_source(red._args[0])
                     except Exception:  # noqa: BLE001
                         use_vector = False
                         break
-                    if adt not in (dt.INT, dt.FLOAT, dt.BOOL):
+                    opt = isinstance(adt, dt.Optionalized)
+                    base = dt.unoptionalize(adt) if opt else adt
+                    if base not in (dt.INT, dt.FLOAT, dt.BOOL):
+                        use_vector = False
+                        break
+                    if opt and name not in ("sum", "avg"):
                         use_vector = False
                         break
 
@@ -307,15 +317,21 @@ class GroupedTable:
 
                 arg_col_fns = []
                 arg_kinds = []
+                arg_optionals = []
                 for red in reducers:
                     if red._args:
                         prog = _compile_on(ctx, [source], red._args[0])
                         arg_col_fns.append(prog)
                         adt = self._infer_on_source(red._args[0])
+                        opt = isinstance(adt, dt.Optionalized)
+                        if opt:
+                            adt = dt.unoptionalize(adt)
                         arg_kinds.append("f" if adt == dt.FLOAT else "i")
+                        arg_optionals.append(opt)
                     else:
                         arg_col_fns.append(None)
                         arg_kinds.append("i")
+                        arg_optionals.append(False)
                 return VectorReduceNode(
                     ctx.engine,
                     node,
@@ -324,6 +340,7 @@ class GroupedTable:
                     arg_col_fns,
                     gval_width=n_group,
                     arg_kinds=arg_kinds,
+                    arg_optionals=arg_optionals,
                     # fused raw-value -> group-code mapping works only for
                     # default-keyed grouping without instances, and (like
                     # key_cache) only when dict equality over the group

@@ -201,9 +201,44 @@ class JoinResult:
             thisclass.this: _JoinThisProxy(self),
         }
 
+    # join-value dtypes the columnar node may key its code dict on: scalar,
+    # hashable, and `_freeze`-stable (freezing is the identity for these, so
+    # skipping it in the vector node cannot change match semantics). Mirrors
+    # _CACHEABLE_GROUP_DTYPES in groupbys.py.
+    _HASHABLE_JOIN_DTYPES = (
+        dt.STR, dt.INT, dt.FLOAT, dt.BOOL, dt.BYTES, dt.POINTER,
+        dt.DATE_TIME_NAIVE, dt.DATE_TIME_UTC, dt.DURATION,
+    )
+
+    def _join_keys_hashable(self) -> bool:
+        """Static gate for the columnar join path: every condition
+        expression must have a hashable scalar dtype (Optionalized
+        allowed — None keys hash and compare exactly like the classic
+        buckets). Json/arrays/tuples/ANY fall back to the classic node."""
+        from pathway_tpu.internals.type_interpreter import infer_dtype
+
+        def resolve(ref: ColumnReference) -> dt.DType:
+            if isinstance(ref, IdReference):
+                return dt.POINTER
+            return ref._table._schema[ref.name].dtype
+
+        for expr in self._on_left + self._on_right:
+            try:
+                d = infer_dtype(expr, resolve)
+            except Exception:  # noqa: BLE001 — unknown dtype: stay classic
+                return False
+            if isinstance(d, dt.Optionalized):
+                d = dt.unoptionalize(d)
+            if d not in self._HASHABLE_JOIN_DTYPES:
+                return False
+        return True
+
     def _join_node(self, ctx):
-        """Build (or reuse) the engine JoinNode for this join."""
+        """Build (or reuse) the engine join node for this join; picks the
+        columnar VectorJoinNode when the join-key dtypes statically allow
+        it (mirroring how groupbys.py picks VectorReduceNode)."""
         from pathway_tpu.engine.operators import JoinNode
+        from pathway_tpu.engine import vector_join
         from pathway_tpu.internals.table import _compile_on
 
         cached = ctx.join_nodes.get(id(self))
@@ -221,7 +256,10 @@ class JoinResult:
         )
         from pathway_tpu.engine.exchange import exchange_by_key
 
-        node = JoinNode(
+        node_cls = JoinNode
+        if vector_join.vector_join_supported() and self._join_keys_hashable():
+            node_cls = vector_join.VectorJoinNode
+        node = node_cls(
             ctx.engine,
             left_node,
             right_node,

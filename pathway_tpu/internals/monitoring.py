@@ -41,6 +41,33 @@ class ProberStats:
         }
 
 
+def node_path_stats(engine) -> list[Dict[str, Any]]:
+    """Per-node execution-path counters for nodes that declare one.
+
+    Columnar nodes (VectorJoinNode, VectorFlattenNode, VectorReduceNode)
+    set ``path = "columnar"`` as a class attribute and bump
+    ``rows_processed`` / ``batches_processed`` per batch; classic nodes
+    leave ``path`` as None and are omitted.  This is how tests (and
+    operators) prove WHICH implementation the build-time gates actually
+    selected — graph shape alone does not show it."""
+    out = []
+    for idx, node in enumerate(engine.nodes):
+        path = getattr(node, "path", None)
+        if path is None:
+            continue
+        out.append(
+            {
+                "node": idx,
+                "name": node.name,
+                "type": type(node).__name__,
+                "path": path,
+                "rows_processed": node.rows_processed,
+                "batches_processed": node.batches_processed,
+            }
+        )
+    return out
+
+
 class StatsMonitor:
     """Console dashboard over engine stats (reference: monitoring.py
     StatsMonitor:186 — rich Live table)."""
@@ -76,6 +103,11 @@ class StatsMonitor:
             table.add_row(
                 f"source {name}",
                 f"rows={cs['rows_read']} pending={cs['pending']}",
+            )
+        for ps in node_path_stats(self.engine):
+            table.add_row(
+                f"{ps['name']}#{ps['node']} [{ps['path']}]",
+                f"rows={ps['rows_processed']} batches={ps['batches_processed']}",
             )
         return table
 
@@ -123,6 +155,28 @@ class PrometheusServer:
             "# TYPE pathway_error_count counter",
             f"pathway_error_count {len(e.error_log)}",
         ]
+        path_stats = node_path_stats(e)
+        if path_stats:
+            lines.append("# TYPE pathway_node_rows_processed counter")
+            for ps in path_stats:
+                labels = (
+                    f'node="{ps["node"]}",name="{ps["name"]}",'
+                    f'path="{ps["path"]}"'
+                )
+                lines.append(
+                    f"pathway_node_rows_processed{{{labels}}} "
+                    f"{ps['rows_processed']}"
+                )
+            lines.append("# TYPE pathway_node_batches_processed counter")
+            for ps in path_stats:
+                labels = (
+                    f'node="{ps["node"]}",name="{ps["name"]}",'
+                    f'path="{ps["path"]}"'
+                )
+                lines.append(
+                    f"pathway_node_batches_processed{{{labels}}} "
+                    f"{ps['batches_processed']}"
+                )
         return "\n".join(lines) + "\n"
 
     def start(self) -> None:

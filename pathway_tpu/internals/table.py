@@ -961,13 +961,12 @@ class Table:
         self_ = self
 
         def build(ctx):
-            from pathway_tpu.engine.operators import FlattenNode
-
             from pathway_tpu.engine.exchange import exchange_by_key
+            from pathway_tpu.engine.vector_flatten import make_flatten_node
 
             # multi-worker: flattened keys hash (row, pos) — re-own them
             return exchange_by_key(
-                ctx.engine, FlattenNode(ctx.engine, ctx.node(self_), flat_idx)
+                ctx.engine, make_flatten_node(ctx.engine, ctx.node(self_), flat_idx)
             )
 
         schema_cols = {}

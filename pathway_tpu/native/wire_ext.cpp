@@ -953,6 +953,686 @@ Py_ssize_t slot_offset(PyTypeObject *tp, const char *name) {
   return m->offset;
 }
 
+// Shared slot layout for direct Pointer construction (resolved per call;
+// the probe on the Python side guards against layout drift).
+struct PointerSlots {
+  PyTypeObject *tp;
+  Py_ssize_t off_value;
+  Py_ssize_t off_origin;
+  Py_ssize_t off_h;
+
+  bool resolve() {
+    tp = reinterpret_cast<PyTypeObject *>(g_pointer_cls);
+    off_value = slot_offset(tp, "value");
+    off_origin = slot_offset(tp, "_origin");
+    off_h = slot_offset(tp, "_h");
+    if (off_value < 0 || off_origin < 0 || off_h < 0) {
+      PyErr_SetString(PyExc_TypeError, "Pointer slot layout not recognized");
+      return false;
+    }
+    return true;
+  }
+
+  // Build one Pointer from a 16-byte little-endian value; nullptr on error.
+  PyObject *build(const uint8_t raw[16]) const {
+    uint64_t lo, hi;
+    std::memcpy(&lo, raw, 8);
+    std::memcpy(&hi, raw + 8, 8);
+    PyObject *val = hi ? _PyLong_FromByteArray(raw, 16, 1, 0)
+                       : PyLong_FromUnsignedLongLong(lo);
+    if (!val) return nullptr;
+    Py_hash_t h;
+    if (static_cast<uint64_t>(_PyHASH_MODULUS) == ((1ULL << 61) - 1)) {
+      // hash(v) of a non-negative int is v mod (2^61 - 1) on 64-bit
+      // CPython; computing it from the raw limbs skips a Python call
+      // per Pointer (the loader's probe compares against hash()).
+      unsigned __int128 v =
+          (static_cast<unsigned __int128>(hi) << 64) | lo;
+      h = static_cast<Py_hash_t>(
+          static_cast<uint64_t>(v % ((1ULL << 61) - 1)));
+    } else {
+      h = PyObject_Hash(val);
+      if (h == -1 && PyErr_Occurred()) {
+        Py_DECREF(val);
+        return nullptr;
+      }
+    }
+    PyObject *h_obj = PyLong_FromSsize_t(h);
+    if (!h_obj) {
+      Py_DECREF(val);
+      return nullptr;
+    }
+    PyObject *obj = tp->tp_alloc(tp, 0);
+    if (!obj) {
+      Py_DECREF(val);
+      Py_DECREF(h_obj);
+      return nullptr;
+    }
+    *reinterpret_cast<PyObject **>(reinterpret_cast<char *>(obj) +
+                                   off_value) = val;  // steals
+    Py_INCREF(Py_None);
+    *reinterpret_cast<PyObject **>(reinterpret_cast<char *>(obj) +
+                                   off_origin) = Py_None;
+    *reinterpret_cast<PyObject **>(reinterpret_cast<char *>(obj) + off_h) =
+        h_obj;  // steals
+    return obj;
+  }
+};
+
+// -- blake2b-128 single block (RFC 7693) -------------------------------------
+//
+// Join output keys must be byte-identical to ref_scalar(lk, rk) — the
+// python side hashes b"\x06"+l16+b"\x06"+r16 through hashlib.blake2b with
+// digest_size=16. All such messages fit one compression block, so a
+// specialized unkeyed single-block compress suffices (verified against
+// hashlib by the Python loader before the path is enabled).
+
+const uint64_t B2B_IV[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+
+const uint8_t B2B_SIGMA[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+
+inline uint64_t rotr64(uint64_t x, int n) {
+  return (x >> n) | (x << (64 - n));
+}
+
+#define B2B_G(a, b, c, d, x, y)          \
+  do {                                   \
+    a = a + b + (x);                     \
+    d = rotr64(d ^ a, 32);               \
+    c = c + d;                           \
+    b = rotr64(b ^ c, 24);               \
+    a = a + b + (y);                     \
+    d = rotr64(d ^ a, 16);               \
+    c = c + d;                           \
+    b = rotr64(b ^ c, 63);               \
+  } while (0)
+
+// unkeyed blake2b, digest 16 bytes, message length <= 128 (one block)
+void blake2b128_single(const uint8_t *msg, size_t len, uint8_t out[16]) {
+  uint64_t m[16] = {0};
+  std::memcpy(m, msg, len);
+  uint64_t v[16];
+  for (int i = 0; i < 8; i++) v[i] = B2B_IV[i];
+  v[0] ^= 0x01010010ULL;  // digest_length=16, fanout=1, depth=1
+  uint64_t h0 = v[0], h1 = v[1];
+  for (int i = 0; i < 8; i++) v[i + 8] = B2B_IV[i];
+  v[12] ^= static_cast<uint64_t>(len);  // t0 = bytes compressed
+  v[14] = ~v[14];                       // final-block flag
+  for (int r = 0; r < 12; r++) {
+    const uint8_t *s = B2B_SIGMA[r];
+    B2B_G(v[0], v[4], v[8], v[12], m[s[0]], m[s[1]]);
+    B2B_G(v[1], v[5], v[9], v[13], m[s[2]], m[s[3]]);
+    B2B_G(v[2], v[6], v[10], v[14], m[s[4]], m[s[5]]);
+    B2B_G(v[3], v[7], v[11], v[15], m[s[6]], m[s[7]]);
+    B2B_G(v[0], v[5], v[10], v[15], m[s[8]], m[s[9]]);
+    B2B_G(v[1], v[6], v[11], v[12], m[s[10]], m[s[11]]);
+    B2B_G(v[2], v[7], v[8], v[13], m[s[12]], m[s[13]]);
+    B2B_G(v[3], v[4], v[9], v[14], m[s[14]], m[s[15]]);
+  }
+  h0 ^= v[0] ^ v[8];
+  h1 ^= v[1] ^ v[9];
+  std::memcpy(out, &h0, 8);
+  std::memcpy(out + 8, &h1, 8);
+}
+
+// make_pair_pointers(lvals: bytes n*16 LE, rvals: bytes n*16 LE) -> list
+//
+// The columnar join's output-key kernel: per row, blake2b-128 over the
+// 34-byte message \x06+l16+\x06+r16 (identical to ref_scalar(lk, rk))
+// and a direct-slot Pointer from the digest.
+PyObject *py_make_pair_pointers(PyObject *, PyObject *args) {
+  Py_buffer lvals, rvals;
+  if (!PyArg_ParseTuple(args, "y*y*", &lvals, &rvals)) return nullptr;
+  if (lvals.len % 16 != 0 || lvals.len != rvals.len) {
+    PyBuffer_Release(&lvals);
+    PyBuffer_Release(&rvals);
+    PyErr_SetString(PyExc_ValueError,
+                    "lvals/rvals must be equal-length 16-byte-aligned");
+    return nullptr;
+  }
+  PointerSlots slots;
+  if (!slots.resolve()) {
+    PyBuffer_Release(&lvals);
+    PyBuffer_Release(&rvals);
+    return nullptr;
+  }
+  Py_ssize_t n = lvals.len / 16;
+  const uint8_t *lp = static_cast<const uint8_t *>(lvals.buf);
+  const uint8_t *rp = static_cast<const uint8_t *>(rvals.buf);
+  PyObject *out = PyList_New(n);
+  if (!out) {
+    PyBuffer_Release(&lvals);
+    PyBuffer_Release(&rvals);
+    return nullptr;
+  }
+  uint8_t msg[34];
+  uint8_t dig[16];
+  msg[0] = 0x06;
+  msg[17] = 0x06;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    std::memcpy(msg + 1, lp + i * 16, 16);
+    std::memcpy(msg + 18, rp + i * 16, 16);
+    blake2b128_single(msg, 34, dig);
+    PyObject *obj = slots.build(dig);
+    if (!obj) {
+      PyBuffer_Release(&lvals);
+      PyBuffer_Release(&rvals);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i, obj);
+  }
+  PyBuffer_Release(&lvals);
+  PyBuffer_Release(&rvals);
+  return out;
+}
+
+// make_pointers_u128(vals: bytes n*16 LE) -> list
+//
+// Bulk Pointer construction from precomputed 128-bit values with VARYING
+// high limbs (make_seq_pointers covers only a constant hi) — the flatten
+// path derives element keys vectorized in numpy and materializes the
+// Pointer objects here.
+PyObject *py_make_pointers_u128(PyObject *, PyObject *arg) {
+  Py_buffer vals;
+  if (PyObject_GetBuffer(arg, &vals, PyBUF_SIMPLE) != 0) return nullptr;
+  if (vals.len % 16 != 0) {
+    PyBuffer_Release(&vals);
+    PyErr_SetString(PyExc_ValueError, "vals must be 16-byte-aligned bytes");
+    return nullptr;
+  }
+  PointerSlots slots;
+  if (!slots.resolve()) {
+    PyBuffer_Release(&vals);
+    return nullptr;
+  }
+  Py_ssize_t n = vals.len / 16;
+  const uint8_t *src = static_cast<const uint8_t *>(vals.buf);
+  PyObject *out = PyList_New(n);
+  if (!out) {
+    PyBuffer_Release(&vals);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *obj = slots.build(src + i * 16);
+    if (!obj) {
+      PyBuffer_Release(&vals);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i, obj);
+  }
+  PyBuffer_Release(&vals);
+  return out;
+}
+
+// Read a Pointer's 128-bit value slot as 16 little-endian bytes.
+inline bool ptr_value_le16(PyObject *obj, const PointerSlots &slots,
+                           uint8_t out[16]) {
+  if (Py_TYPE(obj) != slots.tp) {
+    PyErr_SetString(PyExc_TypeError, "expected Pointer");
+    return false;
+  }
+  PyObject *val = *reinterpret_cast<PyObject **>(
+      reinterpret_cast<char *>(obj) + slots.off_value);
+  if (!val || !PyLong_Check(val)) {
+    PyErr_SetString(PyExc_TypeError, "Pointer.value is not an int");
+    return false;
+  }
+  return _PyLong_AsByteArray(reinterpret_cast<PyLongObject *>(val), out, 16,
+                             1, 0) == 0;
+}
+
+// make_pair_pointers_list(lks: list[Pointer], rks: list[Pointer]) -> list
+//
+// ref_scalar(lk, rk) straight from the Pointer objects: the value slots
+// are read in C, so callers need no 16-byte-LE buffer bookkeeping.
+PyObject *py_make_pair_pointers_list(PyObject *, PyObject *args) {
+  PyObject *lks, *rks;
+  if (!PyArg_ParseTuple(args, "O!O!", &PyList_Type, &lks, &PyList_Type,
+                        &rks))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(lks);
+  if (PyList_GET_SIZE(rks) != n) {
+    PyErr_SetString(PyExc_ValueError, "lks/rks length mismatch");
+    return nullptr;
+  }
+  PointerSlots slots;
+  if (!slots.resolve()) return nullptr;
+  PyObject *out = PyList_New(n);
+  if (!out) return nullptr;
+  uint8_t msg[34];
+  uint8_t dig[16];
+  msg[0] = 0x06;
+  msg[17] = 0x06;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    if (!ptr_value_le16(PyList_GET_ITEM(lks, i), slots, msg + 1) ||
+        !ptr_value_le16(PyList_GET_ITEM(rks, i), slots, msg + 18)) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    blake2b128_single(msg, 34, dig);
+    PyObject *obj = slots.build(dig);
+    if (!obj) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i, obj);
+  }
+  return out;
+}
+
+// make_join_triples(lks, rks, lrows, rrows, diffs) -> list
+//
+// The columnar join's fused output kernel: one C pass per match
+// producing (ref_scalar(lk, rk), (lk, rk, *lrow, *rrow), diff) — the
+// blake2b pair key, the direct-slot Pointer, and the output row tuple,
+// replacing a Python zip/concat comprehension over five parallel lists.
+PyObject *py_make_join_triples(PyObject *, PyObject *args) {
+  PyObject *lks, *rks, *lrows, *rrows, *diffs;
+  if (!PyArg_ParseTuple(args, "O!O!O!O!O!", &PyList_Type, &lks, &PyList_Type,
+                        &rks, &PyList_Type, &lrows, &PyList_Type, &rrows,
+                        &PyList_Type, &diffs))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(lks);
+  if (PyList_GET_SIZE(rks) != n || PyList_GET_SIZE(lrows) != n ||
+      PyList_GET_SIZE(rrows) != n || PyList_GET_SIZE(diffs) != n) {
+    PyErr_SetString(PyExc_ValueError, "input list length mismatch");
+    return nullptr;
+  }
+  PointerSlots slots;
+  if (!slots.resolve()) return nullptr;
+  PyObject *out = PyList_New(n);
+  if (!out) return nullptr;
+  uint8_t msg[34];
+  uint8_t dig[16];
+  msg[0] = 0x06;
+  msg[17] = 0x06;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *lk = PyList_GET_ITEM(lks, i);
+    PyObject *rk = PyList_GET_ITEM(rks, i);
+    PyObject *lrow = PyList_GET_ITEM(lrows, i);
+    PyObject *rrow = PyList_GET_ITEM(rrows, i);
+    if (!PyTuple_Check(lrow) || !PyTuple_Check(rrow)) {
+      PyErr_SetString(PyExc_TypeError, "rows must be tuples");
+      Py_DECREF(out);
+      return nullptr;
+    }
+    if (!ptr_value_le16(lk, slots, msg + 1) ||
+        !ptr_value_le16(rk, slots, msg + 18)) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    blake2b128_single(msg, 34, dig);
+    PyObject *key = slots.build(dig);
+    if (!key) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    Py_ssize_t nl = PyTuple_GET_SIZE(lrow);
+    Py_ssize_t nr = PyTuple_GET_SIZE(rrow);
+    PyObject *row = PyTuple_New(2 + nl + nr);
+    if (!row) {
+      Py_DECREF(key);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    Py_INCREF(lk);
+    PyTuple_SET_ITEM(row, 0, lk);
+    Py_INCREF(rk);
+    PyTuple_SET_ITEM(row, 1, rk);
+    for (Py_ssize_t j = 0; j < nl; j++) {
+      PyObject *v = PyTuple_GET_ITEM(lrow, j);
+      Py_INCREF(v);
+      PyTuple_SET_ITEM(row, 2 + j, v);
+    }
+    for (Py_ssize_t j = 0; j < nr; j++) {
+      PyObject *v = PyTuple_GET_ITEM(rrow, j);
+      Py_INCREF(v);
+      PyTuple_SET_ITEM(row, 2 + nl + j, v);
+    }
+    PyObject *triple = PyTuple_New(3);
+    if (!triple) {
+      Py_DECREF(key);
+      Py_DECREF(row);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyTuple_SET_ITEM(triple, 0, key);  // steals
+    PyTuple_SET_ITEM(triple, 1, row);  // steals
+    PyObject *d = PyList_GET_ITEM(diffs, i);
+    Py_INCREF(d);
+    PyTuple_SET_ITEM(triple, 2, d);
+    PyList_SET_ITEM(out, i, triple);
+  }
+  return out;
+}
+
+// join_delta_side(jv_code, jvs, deltas, left_rows, right_rows,
+//                 left_side, error_cls, out) -> (saw_retract, n_errors)
+//
+// One C pass over a delta batch for the columnar join's delta mode:
+// join-value -> dense code lookup (allocating a fresh code + empty
+// buckets on both sides on a miss), match expansion against the other
+// side's bucket with fused (ref_scalar key, (lk, rk, *lrow, *rrow),
+// diff) triple construction appended to `out`, and own-bucket update
+// in stream order — the exact interleaving of the classic
+// JoinNode._delta_side. Error join values are counted and skipped;
+// the caller logs them.
+PyObject *py_join_delta_side(PyObject *, PyObject *args) {
+  PyObject *jv_code, *jvs, *deltas, *left_rows, *right_rows, *error_cls,
+      *out;
+  int left_side;
+  if (!PyArg_ParseTuple(args, "O!O!O!O!O!iOO!", &PyDict_Type, &jv_code,
+                        &PyList_Type, &jvs, &PyList_Type, &deltas,
+                        &PyList_Type, &left_rows, &PyList_Type, &right_rows,
+                        &left_side, &error_cls, &PyList_Type, &out))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(deltas);
+  if (PyList_GET_SIZE(jvs) != n) {
+    PyErr_SetString(PyExc_ValueError, "jvs/deltas length mismatch");
+    return nullptr;
+  }
+  PointerSlots slots;
+  if (!slots.resolve()) return nullptr;
+  uint8_t msg[34];
+  uint8_t dig[16];
+  msg[0] = 0x06;
+  msg[17] = 0x06;
+  uint8_t *own16 = left_side ? msg + 1 : msg + 18;
+  uint8_t *oth16 = left_side ? msg + 18 : msg + 1;
+  int saw_retract = 0;
+  long n_errors = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *delta = PyList_GET_ITEM(deltas, i);
+    if (!PyTuple_Check(delta) || PyTuple_GET_SIZE(delta) != 3) {
+      PyErr_SetString(PyExc_TypeError, "deltas must be (key, row, diff)");
+      return nullptr;
+    }
+    PyObject *key = PyTuple_GET_ITEM(delta, 0);
+    PyObject *row = PyTuple_GET_ITEM(delta, 1);
+    PyObject *diff = PyTuple_GET_ITEM(delta, 2);
+    if (!PyTuple_Check(row)) {
+      PyErr_SetString(PyExc_TypeError, "rows must be tuples");
+      return nullptr;
+    }
+    long d = PyLong_AsLong(diff);
+    if (d == -1 && PyErr_Occurred()) return nullptr;
+    PyObject *jv = PyList_GET_ITEM(jvs, i);
+    Py_ssize_t code;
+    PyObject *code_obj = PyDict_GetItemWithError(jv_code, jv);
+    if (code_obj) {
+      code = PyLong_AsSsize_t(code_obj);
+      if (code == -1 && PyErr_Occurred()) return nullptr;
+    } else {
+      if (PyErr_Occurred()) return nullptr;
+      int is_err = PyObject_IsInstance(jv, error_cls);
+      if (is_err < 0) return nullptr;
+      if (is_err) {
+        n_errors++;
+        continue;
+      }
+      code = PyList_GET_SIZE(left_rows);
+      for (int side = 0; side < 2; side++) {
+        PyObject *bucket = PyDict_New();
+        if (!bucket) return nullptr;
+        int rc = PyList_Append(side ? right_rows : left_rows, bucket);
+        Py_DECREF(bucket);
+        if (rc < 0) return nullptr;
+      }
+      code_obj = PyLong_FromSsize_t(code);
+      if (!code_obj) return nullptr;
+      int rc = PyDict_SetItem(jv_code, jv, code_obj);
+      Py_DECREF(code_obj);
+      if (rc < 0) return nullptr;
+    }
+    if (code < 0 || code >= PyList_GET_SIZE(left_rows) ||
+        code >= PyList_GET_SIZE(right_rows)) {
+      PyErr_SetString(PyExc_ValueError, "jv_code entry out of range");
+      return nullptr;
+    }
+    PyObject *own =
+        PyList_GET_ITEM(left_side ? left_rows : right_rows, code);
+    PyObject *other =
+        PyList_GET_ITEM(left_side ? right_rows : left_rows, code);
+    if (!PyDict_Check(own) || !PyDict_Check(other)) {
+      PyErr_SetString(PyExc_TypeError, "row buckets must be dicts");
+      return nullptr;
+    }
+    if (PyDict_GET_SIZE(other) > 0) {
+      if (!ptr_value_le16(key, slots, own16)) return nullptr;
+      Py_ssize_t pos = 0;
+      PyObject *okey, *orow;
+      while (PyDict_Next(other, &pos, &okey, &orow)) {
+        if (!PyTuple_Check(orow)) {
+          PyErr_SetString(PyExc_TypeError, "rows must be tuples");
+          return nullptr;
+        }
+        if (!ptr_value_le16(okey, slots, oth16)) return nullptr;
+        blake2b128_single(msg, 34, dig);
+        PyObject *pair = slots.build(dig);
+        if (!pair) return nullptr;
+        PyObject *lk = left_side ? key : okey;
+        PyObject *rk = left_side ? okey : key;
+        PyObject *lrow = left_side ? row : orow;
+        PyObject *rrow = left_side ? orow : row;
+        Py_ssize_t nl = PyTuple_GET_SIZE(lrow);
+        Py_ssize_t nr = PyTuple_GET_SIZE(rrow);
+        PyObject *orow_t = PyTuple_New(2 + nl + nr);
+        if (!orow_t) {
+          Py_DECREF(pair);
+          return nullptr;
+        }
+        Py_INCREF(lk);
+        PyTuple_SET_ITEM(orow_t, 0, lk);
+        Py_INCREF(rk);
+        PyTuple_SET_ITEM(orow_t, 1, rk);
+        for (Py_ssize_t j = 0; j < nl; j++) {
+          PyObject *v = PyTuple_GET_ITEM(lrow, j);
+          Py_INCREF(v);
+          PyTuple_SET_ITEM(orow_t, 2 + j, v);
+        }
+        for (Py_ssize_t j = 0; j < nr; j++) {
+          PyObject *v = PyTuple_GET_ITEM(rrow, j);
+          Py_INCREF(v);
+          PyTuple_SET_ITEM(orow_t, 2 + nl + j, v);
+        }
+        PyObject *triple = PyTuple_New(3);
+        if (!triple) {
+          Py_DECREF(pair);
+          Py_DECREF(orow_t);
+          return nullptr;
+        }
+        PyTuple_SET_ITEM(triple, 0, pair);    // steals
+        PyTuple_SET_ITEM(triple, 1, orow_t);  // steals
+        Py_INCREF(diff);
+        PyTuple_SET_ITEM(triple, 2, diff);
+        int rc = PyList_Append(out, triple);
+        Py_DECREF(triple);
+        if (rc < 0) return nullptr;
+      }
+    }
+    if (d > 0) {
+      if (PyDict_SetItem(own, key, row) < 0) return nullptr;
+    } else {
+      saw_retract = 1;
+      int has = PyDict_Contains(own, key);
+      if (has < 0) return nullptr;
+      if (has && PyDict_DelItem(own, key) < 0) return nullptr;
+    }
+  }
+  return Py_BuildValue("(il)", saw_retract, n_errors);
+}
+
+// make_triples_u128(vals: bytes n*16 LE, rows: list, diffs: list) -> list
+//
+// Bulk (Pointer(v_i), rows[i], diffs[i]) triples from precomputed
+// 128-bit key values — the flatten path's output assembly.
+PyObject *py_make_triples_u128(PyObject *, PyObject *args) {
+  Py_buffer vals;
+  PyObject *rows, *diffs;
+  if (!PyArg_ParseTuple(args, "y*O!O!", &vals, &PyList_Type, &rows,
+                        &PyList_Type, &diffs))
+    return nullptr;
+  if (vals.len % 16 != 0) {
+    PyBuffer_Release(&vals);
+    PyErr_SetString(PyExc_ValueError, "vals must be 16-byte-aligned bytes");
+    return nullptr;
+  }
+  Py_ssize_t n = vals.len / 16;
+  if (PyList_GET_SIZE(rows) != n || PyList_GET_SIZE(diffs) != n) {
+    PyBuffer_Release(&vals);
+    PyErr_SetString(PyExc_ValueError, "vals/rows/diffs length mismatch");
+    return nullptr;
+  }
+  PointerSlots slots;
+  if (!slots.resolve()) {
+    PyBuffer_Release(&vals);
+    return nullptr;
+  }
+  const uint8_t *src = static_cast<const uint8_t *>(vals.buf);
+  PyObject *out = PyList_New(n);
+  if (!out) {
+    PyBuffer_Release(&vals);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *key = slots.build(src + i * 16);
+    if (!key) {
+      PyBuffer_Release(&vals);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyObject *triple = PyTuple_New(3);
+    if (!triple) {
+      Py_DECREF(key);
+      PyBuffer_Release(&vals);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyTuple_SET_ITEM(triple, 0, key);
+    PyObject *r = PyList_GET_ITEM(rows, i);
+    Py_INCREF(r);
+    PyTuple_SET_ITEM(triple, 1, r);
+    PyObject *d = PyList_GET_ITEM(diffs, i);
+    Py_INCREF(d);
+    PyTuple_SET_ITEM(triple, 2, d);
+    PyList_SET_ITEM(out, i, triple);
+  }
+  PyBuffer_Release(&vals);
+  return out;
+}
+
+// flatten_triples(vals: bytes n*16 LE, parents: list[tuple],
+//                 counts: list[int], elems: list, flat_idx: int,
+//                 diffs: list) -> list
+//
+// The columnar flatten's fused output assembly: per element, build the
+// output row (the parent row with the sequence column replaced by the
+// element), the derived-key Pointer from the precomputed 128-bit value,
+// and the delta triple — one C pass instead of a python row
+// comprehension feeding make_triples_u128.
+PyObject *py_flatten_triples(PyObject *, PyObject *args) {
+  Py_buffer vals;
+  PyObject *parents, *counts, *elems, *diffs;
+  Py_ssize_t flat_idx;
+  if (!PyArg_ParseTuple(args, "y*O!O!O!nO!", &vals, &PyList_Type, &parents,
+                        &PyList_Type, &counts, &PyList_Type, &elems,
+                        &flat_idx, &PyList_Type, &diffs))
+    return nullptr;
+  Py_ssize_t np_ = PyList_GET_SIZE(parents);
+  Py_ssize_t total = PyList_GET_SIZE(elems);
+  if (PyList_GET_SIZE(counts) != np_ || PyList_GET_SIZE(diffs) != np_ ||
+      vals.len != total * 16) {
+    PyBuffer_Release(&vals);
+    PyErr_SetString(PyExc_ValueError,
+                    "parents/counts/diffs/elems/vals length mismatch");
+    return nullptr;
+  }
+  PointerSlots slots;
+  if (!slots.resolve()) {
+    PyBuffer_Release(&vals);
+    return nullptr;
+  }
+  const uint8_t *src = static_cast<const uint8_t *>(vals.buf);
+  PyObject *out = PyList_New(total);
+  if (!out) {
+    PyBuffer_Release(&vals);
+    return nullptr;
+  }
+  Py_ssize_t pos = 0;
+  for (Py_ssize_t i = 0; i < np_; i++) {
+    PyObject *row = PyList_GET_ITEM(parents, i);
+    PyObject *diff = PyList_GET_ITEM(diffs, i);
+    Py_ssize_t m = PyLong_AsSsize_t(PyList_GET_ITEM(counts, i));
+    if (m == -1 && PyErr_Occurred()) goto fail;
+    if (!PyTuple_Check(row) || flat_idx < 0 ||
+        flat_idx >= PyTuple_GET_SIZE(row)) {
+      PyErr_SetString(PyExc_TypeError,
+                      "parent rows must be tuples containing flat_idx");
+      goto fail;
+    }
+    if (pos + m > total) {
+      PyErr_SetString(PyExc_ValueError, "counts exceed element total");
+      goto fail;
+    }
+    {
+      Py_ssize_t w = PyTuple_GET_SIZE(row);
+      for (Py_ssize_t j = 0; j < m; j++, pos++) {
+        PyObject *new_row = PyTuple_New(w);
+        if (!new_row) goto fail;
+        for (Py_ssize_t c = 0; c < w; c++) {
+          PyObject *v = (c == flat_idx) ? PyList_GET_ITEM(elems, pos)
+                                        : PyTuple_GET_ITEM(row, c);
+          Py_INCREF(v);
+          PyTuple_SET_ITEM(new_row, c, v);
+        }
+        PyObject *key = slots.build(src + pos * 16);
+        if (!key) {
+          Py_DECREF(new_row);
+          goto fail;
+        }
+        PyObject *triple = PyTuple_New(3);
+        if (!triple) {
+          Py_DECREF(new_row);
+          Py_DECREF(key);
+          goto fail;
+        }
+        PyTuple_SET_ITEM(triple, 0, key);      // steals
+        PyTuple_SET_ITEM(triple, 1, new_row);  // steals
+        Py_INCREF(diff);
+        PyTuple_SET_ITEM(triple, 2, diff);
+        PyList_SET_ITEM(out, pos, triple);
+      }
+    }
+  }
+  if (pos != total) {
+    PyErr_SetString(PyExc_ValueError, "counts do not cover element total");
+    goto fail;
+  }
+  PyBuffer_Release(&vals);
+  return out;
+fail:
+  PyBuffer_Release(&vals);
+  Py_DECREF(out);
+  return nullptr;
+}
+
 // make_seq_pointers(hi64: int, lows: bytes of little-endian u64) -> list
 PyObject *py_make_seq_pointers(PyObject *, PyObject *args) {
   unsigned long long hi64;
@@ -1026,6 +1706,23 @@ fail:
 PyMethodDef methods[] = {
     {"make_seq_pointers", py_make_seq_pointers, METH_VARARGS,
      "bulk-construct Pointer objects from (hi64, u64-LE bytes)"},
+    {"make_pair_pointers", py_make_pair_pointers, METH_VARARGS,
+     "bulk ref_scalar(lk, rk): blake2b-128 over paired 16-byte LE key "
+     "values, returned as Pointer objects"},
+    {"make_pointers_u128", py_make_pointers_u128, METH_O,
+     "bulk-construct Pointer objects from 16-byte LE value records"},
+    {"make_pair_pointers_list", py_make_pair_pointers_list, METH_VARARGS,
+     "bulk ref_scalar(lk, rk) from two Pointer lists"},
+    {"make_join_triples", py_make_join_triples, METH_VARARGS,
+     "fused join output: (pair key, (lk, rk, *lrow, *rrow), diff) triples"},
+    {"make_triples_u128", py_make_triples_u128, METH_VARARGS,
+     "bulk (Pointer, row, diff) triples from 16-byte LE key values"},
+    {"flatten_triples", py_flatten_triples, METH_VARARGS,
+     "fused flatten output: derived-key Pointer, element row, diff "
+     "triples from per-parent rows + flat element list"},
+    {"join_delta_side", py_join_delta_side, METH_VARARGS,
+     "fused delta-mode join pass: code lookup, match expansion with "
+     "triple construction, and own-bucket update in one C loop"},
     {"register_types", py_register_types, METH_VARARGS,
      "register engine value classes and rare-type helpers"},
     {"encode_message", py_encode_message, METH_O,

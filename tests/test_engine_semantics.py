@@ -16,7 +16,23 @@ def _rows(table, engine=None):
     return sorted(cap.state.rows.values(), key=repr)
 
 
-def test_error_value_propagates_through_select_and_join():
+@pytest.fixture(params=["columnar", "classic"])
+def both_paths(request, monkeypatch):
+    """Parametrize a test over both execution paths: the columnar
+    build-time gates on (default) and forced off, so tier-1 exercises
+    the classic row-wise fallback nodes forever (the gates would
+    otherwise hide them on every eligible graph)."""
+    if request.param == "classic":
+        from pathway_tpu.engine import vector_reduce
+
+        monkeypatch.setenv("PATHWAY_DISABLE_VECTOR_JOIN", "1")
+        monkeypatch.setenv("PATHWAY_DISABLE_VECTOR_FLATTEN", "1")
+        # groupbys.py reads VECTOR_REDUCERS at build time
+        monkeypatch.setattr(vector_reduce, "VECTOR_REDUCERS", set())
+    return request.param
+
+
+def test_error_value_propagates_through_select_and_join(both_paths):
     eng = Engine()
     t = pw.debug.table_from_markdown(
         """
@@ -32,9 +48,17 @@ def test_error_value_propagates_through_select_and_join():
     assert rows["b"] == 10
     assert rows["a"] is pw.Error  # Error flows, does not crash the batch
     assert eng.error_log
+    # an Error in a PAYLOAD column rides through a join untouched
+    joined = doubled.join(t, doubled.k == t.k).select(
+        k=pw.left.k, r2=pw.left.r2, v=pw.right.v
+    )
+    (jcap,) = run_tables(joined)
+    jrows = {r[0]: r[1:] for r in jcap.state.rows.values()}
+    assert jrows["b"] == (10, 2)
+    assert jrows["a"][0] is pw.Error and jrows["a"][1] == 0
 
 
-def test_error_in_groupby_key_skips_row_with_log():
+def test_error_in_groupby_key_skips_row_with_log(both_paths):
     eng = Engine()
     t = pw.debug.table_from_markdown(
         """
@@ -47,6 +71,52 @@ def test_error_in_groupby_key_skips_row_with_log():
     (cap,) = run_tables(res, engine=eng)
     assert [r[0] for r in cap.state.rows.values()] == [5]
     assert any("groupby" in e.message.lower() for e in eng.error_log)
+
+
+def test_join_groupby_flatten_pipeline_both_paths(both_paths):
+    """One pipeline through all three gated operators; the result must
+    not depend on which execution path the build-time gates picked."""
+    from pathway_tpu.internals.monitoring import node_path_stats
+
+    eng = Engine()
+    orders = pw.debug.table_from_markdown(
+        """
+        cust | amount
+        a    | 3
+        b    | 5
+        a    | 4
+        c    | 1
+        """
+    )
+    tags = pw.debug.table_from_markdown(
+        """
+        cust | tag
+        a    | x
+        b    | y
+        """
+    )
+    joined = orders.join(tags, orders.cust == tags.cust).select(
+        pw.left.cust, pw.left.amount, pw.right.tag
+    )
+    per_cust = joined.groupby(pw.this.cust).reduce(
+        pw.this.cust,
+        total=pw.reducers.sum(pw.this.amount),
+        mean=pw.reducers.avg(pw.this.amount),
+        tag=pw.reducers.any(pw.this.tag),
+    )
+    chars = per_cust.flatten(pw.this.cust)
+    (pcap, fcap) = run_tables(per_cust, chars, engine=eng)
+    got = sorted(pcap.state.rows.values())
+    assert got == [("a", 7, 3.5, "x"), ("b", 5, 5.0, "y")]
+    assert sorted(r[0] for r in fcap.state.rows.values()) == ["a", "b"]
+    # the path counters prove which implementation actually ran
+    stats = {
+        s["name"]: s["path"]
+        for s in node_path_stats(eng)
+        if s["name"] in ("join", "reduce", "flatten")
+    }
+    want = "classic" if both_paths == "classic" else "columnar"
+    assert stats == {"join": want, "reduce": want, "flatten": want}
 
 
 def test_fill_error_recovers_rows():

@@ -1,0 +1,105 @@
+"""Build-time-selection smoke guards (`perf_smoke` marker, tier-1).
+
+The columnar nodes only pay off if the build-time gates actually pick
+them; a regression there is silent — everything still passes, just 5x
+slower.  These tests build small ELIGIBLE graphs and assert, via the
+per-node path counters (internals/monitoring.node_path_stats), that the
+columnar implementations were selected AND processed rows.  They are
+smoke tests by design: fast enough for tier-1, no timing assertions
+(the rows/s claims live in benchmarks/engine_bench.py).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_events
+from pathway_tpu.engine.engine import Engine
+from pathway_tpu.engine.value import ref_scalar
+from pathway_tpu.internals.monitoring import node_path_stats
+from pathway_tpu.internals.runner import run_tables
+from pathway_tpu.internals.schema import schema_from_types
+
+
+def _columnar_stats(engine):
+    return {
+        s["type"]: s
+        for s in node_path_stats(engine)
+        if s["path"] == "columnar"
+    }
+
+
+@pytest.mark.perf_smoke
+def test_columnar_join_and_reduce_selected_with_live_counters():
+    eng = Engine()
+    lschema = schema_from_types(k=int, a=int)
+    rschema = schema_from_types(k=int, b=int)
+    left = table_from_events(
+        lschema,
+        [(2, (ref_scalar("l", i), (i % 5, i), 1)) for i in range(40)],
+    )
+    right = table_from_events(
+        rschema,
+        [(2, (ref_scalar("r", i), (i, i * 10), 1)) for i in range(5)],
+    )
+    joined = left.join(right, left.k == right.k).select(
+        pw.left.k, pw.left.a, pw.right.b
+    )
+    per_key = joined.groupby(pw.this.k).reduce(
+        pw.this.k,
+        total=pw.reducers.sum(pw.this.a),
+        mean=pw.reducers.avg(pw.this.a),
+        c=pw.reducers.count(),
+    )
+    (cap,) = run_tables(per_key, engine=eng)
+    assert len(cap.state.rows) == 5
+
+    stats = _columnar_stats(eng)
+    assert "VectorJoinNode" in stats, node_path_stats(eng)
+    assert "VectorReduceNode" in stats, node_path_stats(eng)
+    assert stats["VectorJoinNode"]["rows_processed"] > 0
+    assert stats["VectorJoinNode"]["batches_processed"] > 0
+    assert stats["VectorReduceNode"]["rows_processed"] > 0
+    assert stats["VectorReduceNode"]["batches_processed"] > 0
+
+
+@pytest.mark.perf_smoke
+def test_columnar_flatten_selected_with_live_counters():
+    eng = Engine()
+    schema = schema_from_types(i=int, vs=list)
+    t = table_from_events(
+        schema,
+        [
+            (2, (ref_scalar("b", i), (i, [i, i + 1, i + 2]), 1))
+            for i in range(30)
+        ],
+    )
+    (cap,) = run_tables(t.flatten(pw.this.vs), engine=eng)
+    assert len(cap.state.rows) == 90
+
+    stats = _columnar_stats(eng)
+    assert "VectorFlattenNode" in stats, node_path_stats(eng)
+    assert stats["VectorFlattenNode"]["rows_processed"] == 30
+    assert stats["VectorFlattenNode"]["batches_processed"] > 0
+
+
+@pytest.mark.perf_smoke
+def test_ineligible_graphs_stay_classic():
+    """The gates must also say no: non-hashable join keys and
+    non-vector reducers fall back to classic nodes (path counters show
+    no columnar node)."""
+    eng = Engine()
+    schema = schema_from_types(k=pw.Json, v=int)
+    events = [
+        (2, (ref_scalar("j", i), (pw.Json({"k": i % 2}), i), 1))
+        for i in range(6)
+    ]
+    t = table_from_events(schema, events)
+    t2 = table_from_events(schema, list(events))
+    joined = t.join(t2, t.k == t2.k).select(a=pw.left.v, b=pw.right.v)
+    sorted_vals = t.groupby(t.v % 2).reduce(
+        vals=pw.reducers.sorted_tuple(t.v)
+    )
+    run_tables(joined, sorted_vals, engine=eng)
+    assert _columnar_stats(eng) == {}

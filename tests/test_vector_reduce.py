@@ -65,7 +65,10 @@ def test_classic_node_for_nonvector_reducers():
     assert not _is_vector(res)
 
 
-def test_classic_node_for_optional_dtype_args():
+def test_optional_dtype_gate_split():
+    """Optional numeric columns: sum/avg go columnar (they carry None
+    multiplicities), min/max must stay classic (the classic accumulator's
+    None-death is path-dependent)."""
     pw.G.clear()
     t = pw.debug.table_from_rows(
         pw.schema_from_types(k=str, v=pw.internals.dtype.Optionalized(
@@ -74,6 +77,15 @@ def test_classic_node_for_optional_dtype_args():
         [("a", 1), ("a", None)],
     )
     res = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+    assert _is_vector(res)
+    pw.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=pw.internals.dtype.Optionalized(
+            pw.internals.dtype.INT
+        )),
+        [("a", 1), ("a", None)],
+    )
+    res = t.groupby(t.k).reduce(t.k, m=pw.reducers.min(t.v))
     assert not _is_vector(res)
 
 
