@@ -402,6 +402,69 @@ def _host_only_numbers(timeout_s: float = 600.0) -> dict | None:
     return out or None
 
 
+def _observability_overhead() -> float | None:
+    """Cost of the always-on metrics layer on the pure-host engine loop:
+    min-of-N A/B of Engine() vs Engine(metrics=False) over the same
+    microbench the perf_smoke guard uses (source -> 3 rowwise maps).
+    Returns the fractional overhead (0.02 = 2%), None on failure."""
+    from time import perf_counter
+
+    from pathway_tpu.engine.engine import (
+        Engine,
+        InputQueueSource,
+        RowwiseNode,
+    )
+    from pathway_tpu.engine.value import ref_scalar
+
+    rows, ticks = 512, 40
+    deltas = [(ref_scalar("k", i), (i,), 1) for i in range(rows)]
+
+    def ident(keys, cols):
+        return cols[0]
+
+    def run_once(metrics: bool) -> float:
+        eng = Engine(metrics=metrics)
+        src = InputQueueSource(eng)
+        node = src
+        for _ in range(3):
+            node = RowwiseNode(eng, [node], ident)
+        try:
+            t = 2
+            for _ in range(8):  # warmup
+                src.push(t, deltas)
+                eng.process_time(t)
+                t += 2
+            t0 = perf_counter()
+            for _ in range(ticks):
+                src.push(t, deltas)
+                eng.process_time(t)
+                t += 2
+            return perf_counter() - t0
+        finally:
+            eng._gc_unfreeze()
+
+    try:
+        # quiesce cyclic GC like Engine.run_static does: threshold
+        # collections scan the whole live heap and would bill ambient GC
+        # cost to whichever arm allocates the triggering object
+        import gc
+
+        gc.collect()
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            on, off = [], []
+            for _ in range(5):
+                on.append(run_once(True))
+                off.append(run_once(False))
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+        return round(min(on) / min(off) - 1.0, 4)
+    except Exception:  # noqa: BLE001 — never sink the main bench
+        return None
+
+
 def main() -> None:
     err = _device_healthy()
     if err is not None:
@@ -416,6 +479,7 @@ def main() -> None:
                     "vs_baseline": None,
                     "error": err,
                     "host_only": _host_only_numbers(),
+                    "observability_overhead": _observability_overhead(),
                 }
             )
         )
@@ -501,6 +565,7 @@ def main() -> None:
                     1000.0 / max(facts["serving_qps_64clients"], 1e-9), 3
                 ),
                 "n_docs": N_DOCS,
+                "observability_overhead": _observability_overhead(),
                 "device": _device_name(),
                 **_mfu_facts(docs_per_sec, docs),
                 "device_phase_docs_per_sec": round(device_rate, 1),

@@ -23,6 +23,7 @@ from __future__ import annotations
 import gc
 import os
 import threading
+import time as time_mod
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from pathway_tpu.engine.stream import Delta, TableState, consolidate
@@ -174,6 +175,7 @@ class Engine:
         worker_id: int = 0,
         worker_count: int = 1,
         coord=None,
+        metrics: bool = True,
     ):
         if coord is None:
             from pathway_tpu.engine.exchange import Coordinator
@@ -190,17 +192,45 @@ class Engine:
         self._scheduled_times: set[int] = set()
         self._gc_ticks = 0
         self._gc_disabled = False
-        # per-node wall-time introspection, enabled by env var
-        self._node_timing: dict | None = (
-            {} if os.environ.get("PATHWAY_NODE_TIMING_LOG") is not None else None
+        # per-node wall-time dump destination (the always-on metrics
+        # registry is the single instrumented path; this env var only
+        # selects the JSON-lines dump of it at finish())
+        self._node_timing_dest: str | None = os.environ.get(
+            "PATHWAY_NODE_TIMING_LOG"
         )
+        self._timing_dumped = False
         self.current_time: int = 0
         self.stats_rows = 0
         self.now_fn: Callable[[], int] | None = None  # engine-time provider
         self.terminate_flag = threading.Event()
         self.on_error: Callable[[ErrorLogEntry], None] | None = None
+        self.last_diagnostics: dict | None = None
+        # always-on observability (internals/metrics.py): per-node latency
+        # histograms, tick timing, watermark lag, flight recorder.
+        # `metrics=False` exists ONLY so the perf-smoke overhead guard can
+        # measure the bare loop; production runs never disable it.
+        if metrics:
+            from pathway_tpu.internals.metrics import EngineMetrics
+
+            self.metrics: Any | None = EngineMetrics(self)
+        else:
+            self.metrics = None
+        # thread-worker groups track their engines so one Prometheus /
+        # status server can export every worker in the process
+        group = getattr(coord, "group", None)
+        if group is not None and hasattr(group, "engines"):
+            group.engines.append(self)
 
     def register(self, node: Node) -> None:
+        idx = len(self.nodes)
+        node._idx = idx
+        node._rows_out = 0
+        m = self.metrics
+        node._lat_child = (
+            m.node_hist.labels(str(idx), node.name, type(node).__name__)
+            if m is not None
+            else None
+        )
         self.nodes.append(node)
 
     def schedule_time(self, time: int) -> None:
@@ -241,6 +271,14 @@ class Engine:
                 trace = node.trace
         entry = ErrorLogEntry(message, operator, self.current_time, trace)
         self.error_log.append(entry)
+        if self.metrics is not None:
+            self.metrics.recorder.record(
+                "error",
+                time=self.current_time,
+                node=getattr(node, "_idx", -1),
+                name=f"{operator}: {message[:160]}" if operator else message[:160],
+                errors=1,
+            )
         for n in self.error_log_nodes:
             n.push(entry)
         if self.on_error is not None:
@@ -250,8 +288,8 @@ class Engine:
     def process_time(self, time: int) -> None:
         self.current_time = time
         self._scheduled_times.discard(time)
-        if self._node_timing is not None:
-            self._process_time_instrumented(time)
+        if self.metrics is not None:
+            self._process_time_metrics(time, self.metrics)
         else:
             try:
                 for node in self.nodes:
@@ -263,53 +301,103 @@ class Engine:
             node.on_time_end(time)
         self._gc_pulse()
 
-    def _process_time_instrumented(self, time: int) -> None:
-        """PATHWAY_NODE_TIMING_LOG introspection (the reference's
-        DIFFERENTIAL_LOG_ADDR analogue, dataflow.rs:6489-6496): per-node
-        wall time and row counts accumulate per tick and dump as one JSON
-        line per node at finish()."""
-        import time as time_mod
-
-        timing = self._node_timing
+    def _process_time_metrics(self, time: int, m) -> None:
+        """The always-on instrumented worker loop: per-node latency into
+        the log2 histograms, per-tick wall time, and flight-recorder
+        events for nodes that did work.  One perf_counter call per node —
+        a node's interval ends where the next one starts, so bookkeeping
+        (~0.3us) rides on the successor's bucket rather than doubling the
+        timer cost."""
+        perf = time_mod.perf_counter
+        rec_append = m.recorder.events.append
+        err_log = self.error_log
+        errs_seen = len(err_log)
+        errs_tick = 0
+        rows_tick0 = self.stats_rows
+        t0 = perf()
+        t_prev = t0
         try:
-            for idx, node in enumerate(self.nodes):
+            for node in self.nodes:
                 self.current_node = node
-                rows_before = self.stats_rows
-                t0 = time_mod.perf_counter()
+                rows0 = self.stats_rows
                 node.process(time)
-                el = time_mod.perf_counter() - t0
-                ent = timing.get(idx)
-                if ent is None:
-                    ent = timing[idx] = {
-                        "node": idx,
-                        "name": node.name,
-                        "type": type(node).__name__,
-                        "calls": 0,
-                        "total_s": 0.0,
-                        "rows_out": 0,
-                    }
-                ent["calls"] += 1
-                ent["total_s"] += el
-                ent["rows_out"] += self.stats_rows - rows_before
+                t_now = perf()
+                dt = t_now - t_prev
+                t_prev = t_now
+                node._lat_child.observe(dt)
+                rows = self.stats_rows - rows0
+                n_err = len(err_log) - errs_seen
+                if rows:
+                    node._rows_out += rows
+                if n_err:
+                    errs_seen += n_err
+                    errs_tick += n_err
+                if rows or n_err or dt > 1e-4:
+                    rec_append(
+                        (t_now, time, "node", node._idx, node.name,
+                         dt, rows, n_err)
+                    )
         finally:
             self.current_node = None
+        t_end = perf()
+        m.tick_hist.observe(t_end - t0)
+        m.ticks += 1
+        m.last_tick_monotonic = time_mod.monotonic()
+        rec_append(
+            (t_end, time, "tick", -1, "", t_end - t0,
+             self.stats_rows - rows_tick0, errs_tick)
+        )
+
+    def dump_diagnostics(self, *, reason: str = "manual") -> dict:
+        """Structured post-mortem: topology + per-node p50/p99 + flight
+        recorder tail + recent errors (see internals/metrics.py).  Called
+        automatically when a run fails or logged errors; callable any
+        time."""
+        from pathway_tpu.internals.metrics import dump_diagnostics
+
+        return dump_diagnostics(self, reason=reason)
 
     def _dump_node_timing(self) -> None:
-        if not self._node_timing:
+        """PATHWAY_NODE_TIMING_LOG dump (the reference's
+        DIFFERENTIAL_LOG_ADDR analogue, dataflow.rs:6489-6496) — one JSON
+        line per node that processed at least once, derived from the SAME
+        always-on registry the Prometheus endpoint exports (there is no
+        separate instrumented code path)."""
+        if (
+            self._node_timing_dest is None
+            or self._timing_dumped
+            or self.metrics is None
+        ):
             return
-        # idempotent: finish() may run more than once per engine
-        timing, self._node_timing = self._node_timing, {}
         import json as json_mod
         import sys
 
-        dest = os.environ.get("PATHWAY_NODE_TIMING_LOG", "")
-        lines = [
-            json_mod.dumps(
-                {**ent, "total_s": round(ent["total_s"], 6),
-                 "worker": self.worker_id}
+        lines = []
+        for idx, node in enumerate(self.nodes):
+            child = getattr(node, "_lat_child", None)
+            if child is None:
+                continue
+            calls = child.count
+            if not calls:
+                continue
+            lines.append(
+                json_mod.dumps(
+                    {
+                        "node": idx,
+                        "name": node.name,
+                        "type": type(node).__name__,
+                        "calls": calls,
+                        "total_s": round(child.sum, 6),
+                        "rows_out": node._rows_out,
+                        "worker": self.worker_id,
+                    }
+                )
             )
-            for ent in timing.values()
-        ]
+        if not lines:
+            return
+        # idempotent: finish() may run more than once per engine
+        self._timing_dumped = True
+        dest = self._node_timing_dest
         if dest in ("stderr", "-", ""):
             for line in lines:
                 print(line, file=sys.stderr)
@@ -348,6 +436,16 @@ class Engine:
                     break
                 self.process_time(t)
             self.finish()
+        except BaseException:
+            # crash-dump flight recorder: an uncaught run failure leaves a
+            # structured post-mortem behind (engine.last_diagnostics and,
+            # with PATHWAY_DIAGNOSTICS_DIR, a JSON file)
+            if self.metrics is not None:
+                try:
+                    self.dump_diagnostics(reason="run_failure")
+                except Exception:  # noqa: BLE001 — never mask the real error
+                    pass
+            raise
         finally:
             # finish() unfreezes on the success path; this covers
             # exceptions mid-run so the process's GC is never left frozen
@@ -405,8 +503,12 @@ class Engine:
             self._drain()
         finally:
             self._gc_unfreeze()
-            if self._node_timing is not None:
-                self._dump_node_timing()
+            self._dump_node_timing()
+            if self.error_log and self.metrics is not None:
+                try:
+                    self.dump_diagnostics(reason="error_log")
+                except Exception:  # noqa: BLE001 — diagnostics must not fail
+                    pass
 
 
 # ---------------------------------------------------------------------------

@@ -44,6 +44,7 @@ class Coordinator:
 
     worker_id = 0
     worker_count = 1
+    metrics = None  # multi-worker transports carry a MetricsRegistry
 
     def owns(self, shard: int) -> bool:
         return True
@@ -99,6 +100,7 @@ class TcpCoordinator(Coordinator):
         self._out: Dict[int, socket.socket] = {}
         self._out_locks: Dict[int, threading.Lock] = {}
         self._threads: List[threading.Thread] = []
+        self._init_metrics()
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -110,6 +112,57 @@ class TcpCoordinator(Coordinator):
         accept_thread.start()
         self._threads.append(accept_thread)
         self._connect_peers(connect_timeout)
+
+    def _init_metrics(self) -> None:
+        """Exchange backpressure telemetry (ISSUE 2): bytes on the wire,
+        buffered queue depth, and how long collect()/agree() block — the
+        direct signal that this worker is waiting on a slow peer."""
+        from pathway_tpu.internals.metrics import MetricsRegistry
+
+        reg = self.metrics = MetricsRegistry(
+            worker=str(self.worker_id), transport="tcp"
+        )
+        self._m_bytes_sent = reg.counter(
+            "pathway_exchange_bytes_sent",
+            help="bytes written to peer sockets",
+        ).labels()
+        self._m_bytes_recv = reg.counter(
+            "pathway_exchange_bytes_received",
+            help="bytes read from peer sockets",
+        ).labels()
+        self._m_collect_wait = reg.histogram(
+            "pathway_exchange_collect_wait_seconds",
+            help="time collect() blocked waiting for peer punctuation",
+            labels=("channel",),
+        )
+        self._m_agree_wait = reg.histogram(
+            "pathway_exchange_agree_wait_seconds",
+            help="time agree() blocked waiting for peer votes",
+        ).labels()
+
+        def _depth(store):
+            def cb():
+                try:
+                    return sum(
+                        len(lst)
+                        for per_sender in list(store.values())
+                        for lst in list(per_sender.values())
+                    )
+                except RuntimeError:  # racing a concurrent insert
+                    return None
+
+            return cb
+
+        reg.gauge(
+            "pathway_exchange_queue_depth",
+            help="delta rows buffered awaiting collect()",
+            callback=_depth(self._data),
+        )
+        reg.gauge(
+            "pathway_exchange_pending_puncts",
+            help="(channel, time) pairs with outstanding punctuation",
+            callback=lambda: len(self._punct),
+        )
 
     # -- connection setup -------------------------------------------------
     def _connect_peers(self, timeout: float) -> None:
@@ -149,11 +202,11 @@ class TcpCoordinator(Coordinator):
             self._threads.append(t)
 
     # -- wire -------------------------------------------------------------
-    @staticmethod
-    def _send_on(sock: socket.socket, msg: Any) -> None:
+    def _send_on(self, sock: socket.socket, msg: Any) -> None:
         from pathway_tpu.engine.wire import encode_message
 
         blob = encode_message(msg)
+        self._m_bytes_sent.inc(_LEN.size + len(blob))
         sock.sendall(_LEN.pack(len(blob)) + blob)
 
     @staticmethod
@@ -183,6 +236,7 @@ class TcpCoordinator(Coordinator):
                 blob = self._recv_exact(conn, length)
                 if blob is None:
                     break
+                self._m_bytes_recv.inc(_LEN.size + length)
                 if peer is None and (not blob or blob[0] != MSG_HELLO):
                     # refuse to even decode value payloads (incl. the
                     # pickle escape) from a connection that has not
@@ -283,7 +337,8 @@ class TcpCoordinator(Coordinator):
         """Block until every peer punctuated channel@time; return received
         deltas concatenated in sender-id order (deterministic merge)."""
         need = self.worker_count - 1
-        deadline = time_mod.monotonic() + timeout
+        t0 = time_mod.monotonic()
+        deadline = t0 + timeout
         with self._cv:
             while True:
                 got = self._punct.get((channel, time), set())
@@ -293,6 +348,9 @@ class TcpCoordinator(Coordinator):
                     out: list = []
                     for sender in sorted(by_sender):
                         out.extend(by_sender[sender])
+                    self._m_collect_wait.labels(str(channel)).observe(
+                        time_mod.monotonic() - t0
+                    )
                     return out
                 if self._dead:
                     break
@@ -310,13 +368,15 @@ class TcpCoordinator(Coordinator):
         round_no = self._round
         self._round += 1
         self._broadcast(("coord", round_no, payload))
-        deadline = time_mod.monotonic() + timeout
+        t0 = time_mod.monotonic()
+        deadline = t0 + timeout
         with self._cv:
             while True:
                 votes = self._coord.get(round_no, {})
                 if len(votes) >= self.worker_count - 1:
                     self._coord.pop(round_no, None)
                     votes = dict(votes)
+                    self._m_agree_wait.observe(time_mod.monotonic() - t0)
                     break
                 if self._dead:
                     self._check_dead()
@@ -386,6 +446,9 @@ class ThreadGroupCoordinator:
         self._data: Dict[tuple, dict] = {}
         # (dest_thread, channel, time) -> {sender_global}
         self._punct: Dict[tuple, set] = {}
+        # engines register themselves here (Engine.__init__) so worker 0's
+        # Prometheus / status server can export every thread worker
+        self.engines: List[Any] = []
 
     def facade(self, thread_index: int) -> "_ThreadWorkerCoordinator":
         return _ThreadWorkerCoordinator(self, thread_index)
@@ -440,16 +503,51 @@ class _ThreadWorkerCoordinator(Coordinator):
     ThreadGroupCoordinator)."""
 
     def __init__(self, group: ThreadGroupCoordinator, thread_index: int):
+        from pathway_tpu.internals.metrics import MetricsRegistry
+
         self.group = group
         self.thread_index = thread_index
         self.worker_id = group.process_id * group.threads + thread_index
         self.worker_count = group.total
+        reg = self.metrics = MetricsRegistry(
+            worker=str(self.worker_id), transport="threads"
+        )
+        self._m_collect_wait = reg.histogram(
+            "pathway_exchange_collect_wait_seconds",
+            help="time collect() blocked waiting for sibling punctuation",
+            labels=("channel",),
+        )
+        self._m_agree_wait = reg.histogram(
+            "pathway_exchange_agree_wait_seconds",
+            help="time agree() blocked on the thread barrier",
+        ).labels()
+
+        def _depth():
+            me_t = self.thread_index
+            try:
+                return sum(
+                    len(lst)
+                    for key, per_sender in list(group._data.items())
+                    if key[0] == me_t
+                    for lst in list(per_sender.values())
+                )
+            except RuntimeError:  # racing a concurrent insert
+                return None
+
+        reg.gauge(
+            "pathway_exchange_queue_depth",
+            help="delta rows buffered for this worker awaiting collect()",
+            callback=_depth,
+        )
 
     def owns(self, shard: int) -> bool:
         return shard % self.worker_count == self.worker_id
 
     def agree(self, payload: Any) -> List[Any]:
-        return self.group.agree(self.thread_index, payload)
+        t0 = time_mod.monotonic()
+        result = self.group.agree(self.thread_index, payload)
+        self._m_agree_wait.observe(time_mod.monotonic() - t0)
+        return result
 
     def _wire(self, channel: int, dest_t: int, sender_t: int) -> int:
         T = self.group.threads
@@ -481,7 +579,8 @@ class _ThreadWorkerCoordinator(Coordinator):
         g = self.group
         me_t = self.thread_index
         need_local = g.threads - 1
-        deadline = time_mod.monotonic() + timeout
+        t_enter = time_mod.monotonic()
+        deadline = t_enter + timeout
         key = (me_t, channel, time)
         with g._cv:
             while len(g._punct.get(key, ())) < need_local:
@@ -517,6 +616,9 @@ class _ThreadWorkerCoordinator(Coordinator):
                 )
         for sender in sorted(local):
             out.extend(local[sender])
+        self._m_collect_wait.labels(str(channel)).observe(
+            time_mod.monotonic() - t_enter
+        )
         return out
 
     def close(self) -> None:

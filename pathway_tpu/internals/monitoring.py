@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import enum
 import http.server
+import json
 import threading
 import time
 from dataclasses import dataclass, field
@@ -76,6 +77,8 @@ class StatsMonitor:
         self.engine = engine
         self.stats = ProberStats()
         self._live = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
 
     def refresh(self) -> None:
         self.stats.rows_processed = self.engine.stats_rows
@@ -94,6 +97,12 @@ class StatsMonitor:
         snap = self.stats.snapshot()
         if self.stats.input_latency_ms is not None:
             snap["batch_latency_ms"] = round(self.stats.input_latency_ms, 2)
+        m = getattr(self.engine, "metrics", None)
+        if m is not None:
+            snap["ticks"] = m.ticks
+            lag = m._watermark_lag()
+            snap["watermark_lag_s"] = round(lag, 2)
+            snap["scheduled_backlog"] = len(self.engine._scheduled_times)
         for k, v in snap.items():
             table.add_row(k, str(v))
         # per-connector monitors (reference: connectors/monitoring.rs)
@@ -102,13 +111,30 @@ class StatsMonitor:
         ):
             table.add_row(
                 f"source {name}",
-                f"rows={cs['rows_read']} pending={cs['pending']}",
+                f"rows={cs['rows_read']} pending={cs['pending']}"
+                f" lag={cs.get('read_lag_s', 0.0):.1f}s"
+                f" retries={cs.get('retries', 0)}",
             )
         for ps in node_path_stats(self.engine):
             table.add_row(
                 f"{ps['name']}#{ps['node']} [{ps['path']}]",
                 f"rows={ps['rows_processed']} batches={ps['batches_processed']}",
             )
+        # hottest nodes by total process() time, with latency percentiles
+        if m is not None:
+            stats = sorted(
+                m.node_latency_stats(),
+                key=lambda s: s["total_s"],
+                reverse=True,
+            )
+            for s in stats[:8]:
+                if not s["calls"]:
+                    continue
+                table.add_row(
+                    f"node {s['name']}#{s['node']} ({s['type']})",
+                    f"p50={s['p50_ms']}ms p99={s['p99_ms']}ms"
+                    f" calls={s['calls']} total={s['total_s']:.3f}s",
+                )
         return table
 
     def start_live(self, refresh_per_second: float = 2.0):
@@ -118,81 +144,161 @@ class StatsMonitor:
             self.render(), refresh_per_second=refresh_per_second
         )
         self._live.start()
+        self._stop.clear()
 
         def updater():
-            while self._live is not None:
+            # Event.wait doubles as the frame clock and the stop signal:
+            # stop() flips it and joins, so a final render can never race
+            # the Live teardown
+            while not self._stop.wait(1.0 / refresh_per_second):
+                live = self._live
+                if live is None:
+                    break
                 try:
-                    self._live.update(self.render())
+                    live.update(self.render())
                 except Exception:  # noqa: BLE001
                     break
-                time.sleep(1.0 / refresh_per_second)
 
-        threading.Thread(target=updater, daemon=True).start()
+        self._thread = threading.Thread(target=updater, daemon=True)
+        self._thread.start()
         return self._live
 
     def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
         if self._live is not None:
             self._live.stop()
             self._live = None
 
 
 class PrometheusServer:
-    """OpenMetrics endpoint per worker, port 20000+process_id (reference:
-    src/engine/http_server.rs:22)."""
+    """Per-process metrics endpoint, port 20000+process_id (reference:
+    src/engine/http_server.rs:22).
+
+    Serves every worker visible from this process: with thread workers
+    the owning engine's coordinator group lists all sibling engines, so a
+    single scrape returns series for worker="0", worker="1", ... plus the
+    transport registries (exchange bytes/queue depth/wait histograms).
+
+    Routes: ``/metrics`` (and ``/``) — Prometheus exposition format;
+    ``/status`` — JSON with graph topology, per-node p50/p99 latency,
+    connector stats, and the flight-recorder tail per worker."""
 
     def __init__(self, engine, process_id: int = 0, port: int | None = None):
         self.engine = engine
         self.port = port if port is not None else 20000 + process_id
         self._httpd = None
 
+    def _engines(self) -> list:
+        engines = [self.engine]
+        group = getattr(getattr(self.engine, "coord", None), "group", None)
+        for e in getattr(group, "engines", ()) or ():
+            if e not in engines:
+                engines.append(e)
+        return engines
+
+    def _registries(self) -> list:
+        regs: list = []
+        seen: set = set()
+
+        def add(reg):
+            if reg is not None and id(reg) not in seen:
+                seen.add(id(reg))
+                regs.append(reg)
+
+        for e in self._engines():
+            m = getattr(e, "metrics", None)
+            add(getattr(m, "registry", None))
+            coord = getattr(e, "coord", None)
+            add(getattr(coord, "metrics", None))
+            # thread facades share one TCP inter-process transport
+            tcp = getattr(getattr(coord, "group", None), "tcp", None)
+            add(getattr(tcp, "metrics", None))
+        return regs
+
     def metrics_text(self) -> str:
+        regs = self._registries()
+        if regs:
+            from pathway_tpu.internals.metrics import render_registries
+
+            return render_registries(regs)
+        # metrics disabled on the engine (bench A/B mode): minimal legacy
+        # counters so the endpoint still answers
         e = self.engine
-        lines = [
-            "# TYPE pathway_rows_processed counter",
-            f"pathway_rows_processed {e.stats_rows}",
-            "# TYPE pathway_engine_time gauge",
-            f"pathway_engine_time {e.current_time}",
-            "# TYPE pathway_error_count counter",
-            f"pathway_error_count {len(e.error_log)}",
+        w = f'{{worker="{e.worker_id}"}}'
+        return (
+            "# TYPE pathway_rows_processed counter\n"
+            f"pathway_rows_processed{w} {e.stats_rows}\n"
+            "# TYPE pathway_engine_time gauge\n"
+            f"pathway_engine_time{w} {e.current_time}\n"
+            "# TYPE pathway_error_count counter\n"
+            f"pathway_error_count{w} {len(e.error_log)}\n"
+        )
+
+    def status_json(self) -> Dict[str, Any]:
+        workers = []
+        for e in self._engines():
+            m = getattr(e, "metrics", None)
+            workers.append(
+                {
+                    "worker": e.worker_id,
+                    "engine_time": e.current_time,
+                    "rows_processed": e.stats_rows,
+                    "errors": len(e.error_log),
+                    "ticks": m.ticks if m is not None else None,
+                    "watermark_lag_s": (
+                        round(m._watermark_lag(), 3) if m is not None else None
+                    ),
+                    "scheduled_backlog": len(e._scheduled_times),
+                    "connectors": dict(
+                        getattr(e, "connector_stats", None) or {}
+                    ),
+                    "nodes": (
+                        m.node_latency_stats() if m is not None else []
+                    ),
+                    "flight_recorder": (
+                        m.recorder.tail() if m is not None else []
+                    ),
+                }
+            )
+        e0 = self.engine
+        topology = [
+            {
+                "node": idx,
+                "name": n.name,
+                "type": type(n).__name__,
+                "inputs": [getattr(i, "_idx", -1) for i in n.inputs],
+                "path": getattr(n, "path", None),
+            }
+            for idx, n in enumerate(e0.nodes)
         ]
-        path_stats = node_path_stats(e)
-        if path_stats:
-            lines.append("# TYPE pathway_node_rows_processed counter")
-            for ps in path_stats:
-                labels = (
-                    f'node="{ps["node"]}",name="{ps["name"]}",'
-                    f'path="{ps["path"]}"'
-                )
-                lines.append(
-                    f"pathway_node_rows_processed{{{labels}}} "
-                    f"{ps['rows_processed']}"
-                )
-            lines.append("# TYPE pathway_node_batches_processed counter")
-            for ps in path_stats:
-                labels = (
-                    f'node="{ps["node"]}",name="{ps["name"]}",'
-                    f'path="{ps["path"]}"'
-                )
-                lines.append(
-                    f"pathway_node_batches_processed{{{labels}}} "
-                    f"{ps['batches_processed']}"
-                )
-        return "\n".join(lines) + "\n"
+        return {
+            "worker_count": e0.worker_count,
+            "graph": topology,
+            "workers": workers,
+        }
 
     def start(self) -> None:
         monitor = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
-                if self.path not in ("/metrics", "/"):
+                if self.path in ("/metrics", "/"):
+                    body = monitor.metrics_text().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path == "/status":
+                    body = json.dumps(
+                        monitor.status_json(), default=str
+                    ).encode()
+                    ctype = "application/json"
+                else:
                     self.send_response(404)
                     self.end_headers()
                     return
-                body = monitor.metrics_text().encode()
                 self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4"
-                )
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

@@ -25,6 +25,13 @@ def set_monitoring_config(
     _config["endpoint"] = server_endpoint
     _tracer = None  # rebuild lazily against the new endpoint
     _meter_state["meter"] = None  # metrics too (a cached noop would stick)
+    # the old MeterProvider owns a PeriodicExportingMetricReader with a
+    # live export thread — shut it down like the tracer provider, or each
+    # reconfigure leaks a reader thread exporting to the stale endpoint
+    old_provider = _meter_state.pop("provider", None)
+    if old_provider is not None:
+        with contextlib.suppress(Exception):
+            old_provider.shutdown()
 
 
 def _get_tracer():
@@ -170,6 +177,43 @@ def _ensure_meter():
                 if lat is not None:
                     yield Observation(lat, {"worker": eng.worker_id})
 
+        # gauges fed from the always-on metrics registry: the OTel export
+        # observes the same histograms/gauges Prometheus serves, not a
+        # second instrumentation path
+        def _tick_pct(q):
+            def cb(_options):
+                from opentelemetry.metrics import Observation
+
+                for eng in _live_engines():
+                    m = getattr(eng, "metrics", None)
+                    if m is None:
+                        continue
+                    v = m.tick_hist.percentile(q)
+                    if v is not None:
+                        yield Observation(
+                            v * 1000.0, {"worker": eng.worker_id}
+                        )
+
+            return cb
+
+        def _watermark(_options):
+            from opentelemetry.metrics import Observation
+
+            for eng in _live_engines():
+                m = getattr(eng, "metrics", None)
+                if m is not None:
+                    yield Observation(
+                        m._watermark_lag(), {"worker": eng.worker_id}
+                    )
+
+        def _backlog(_options):
+            from opentelemetry.metrics import Observation
+
+            for eng in _live_engines():
+                yield Observation(
+                    len(eng._scheduled_times), {"worker": eng.worker_id}
+                )
+
         meter.create_observable_gauge(
             "process.memory.usage", callbacks=[_mem], unit="By"
         )
@@ -184,6 +228,18 @@ def _ensure_meter():
         )
         meter.create_observable_gauge(
             "latency.input", callbacks=[_latency], unit="ms"
+        )
+        meter.create_observable_gauge(
+            "engine.tick.p50", callbacks=[_tick_pct(50)], unit="ms"
+        )
+        meter.create_observable_gauge(
+            "engine.tick.p99", callbacks=[_tick_pct(99)], unit="ms"
+        )
+        meter.create_observable_gauge(
+            "engine.watermark.lag", callbacks=[_watermark], unit="s"
+        )
+        meter.create_observable_gauge(
+            "engine.scheduled.backlog", callbacks=[_backlog]
         )
         _meter_state["meter"] = meter
         _meter_state["provider"] = provider

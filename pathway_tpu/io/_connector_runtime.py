@@ -284,10 +284,14 @@ class ConnectorSubjectBase:
 
     _worker_id = 0
     _worker_count = 1
+    # class-level default so report_retry works even when a subclass
+    # forgets to call super().__init__()
+    _retries = 0
 
     def __init__(self):
         self._sink = None
         self._closed = False
+        self._retries = 0
         self._object_cache = None  # CachedObjectStorage under persistence
 
     def _bind(self, sink) -> None:
@@ -321,6 +325,12 @@ class ConnectorSubjectBase:
             push_tuples(values_list)
         else:
             self.next_batch([dict(zip(names, v)) for v in values_list])
+
+    def report_retry(self) -> None:
+        """Count a transient read failure that the subject retried
+        (network hiccup, rate limit). Surfaces as the per-connector
+        ``retries`` stat / ``pathway_connector_retries`` series."""
+        self._retries += 1
 
     def next_json(self, message: dict) -> None:
         self.next(**message)
@@ -539,6 +549,8 @@ class StreamingDriver:
         active = 0
         replayed: Dict[LiveSource, List] = {}
         my_worker = self.engine.worker_id
+        sinks: Dict[LiveSource, _QueueSink] = {}
+        last_event: Dict[LiveSource, float] = {}
 
         # operator snapshots (reference: dataflow/persist.rs): restore node
         # state at the persisted frontier, then replay only the log tail
@@ -617,6 +629,7 @@ class StreamingDriver:
                     "live", f"{live.name}@w{my_worker}"
                 )
             sink.subject = subject
+            sinks[live] = sink
             sink.persistence_enabled = self.persistence_config is not None
             subject._bind(sink)
             if self.persistence_config is not None:
@@ -679,6 +692,9 @@ class StreamingDriver:
                 node_of(live).push(time, events)
             self.engine.process_time(time)
             time += 2
+        start_t = time_mod.monotonic()
+        for live in sinks:
+            last_event[live] = start_t
         for t in threads:
             t.start()
 
@@ -792,10 +808,14 @@ class StreamingDriver:
                 stats = getattr(self.engine, "connector_stats", None)
                 if stats is None:
                     stats = self.engine.connector_stats = {}
+                now_ = time_mod.monotonic()
                 for live_, cnt in counters.items():
+                    subj = getattr(sinks.get(live_), "subject", None)
                     stats[live_.name] = {
                         "rows_read": cnt,
                         "pending": len(pending.get(live_, ())),
+                        "read_lag_s": now_ - last_event.get(live_, now_),
+                        "retries": getattr(subj, "_retries", 0),
                     }
                 dirty_since_snapshot = True
                 processed_batch = time
@@ -868,8 +888,10 @@ class StreamingDriver:
                     # so the agreement cadence stays identical everywhere)
                     break
             needs_flush = False
+            now_ev = time_mod.monotonic()
             for kind, live, payload, counter in events:
                 counters[live] = max(counters.get(live, 0), counter)
+                last_event[live] = now_ev
                 if kind == "data":
                     pending.setdefault(live, []).append(payload)
                 elif kind == "data_batch":

@@ -170,8 +170,21 @@ class MessageQueueSubject(ConnectorSubjectBase):
             # stream (reference: Reader::seek, data_storage.rs:398)
             self._client.seek(self._resume_position)
         try:
+            failures = 0
             while True:
-                batch = self._client.poll(self.poll_timeout)
+                try:
+                    batch = self._client.poll(self.poll_timeout)
+                except Exception:
+                    # transient broker hiccup: back off and retry a few
+                    # times (surfaced as pathway_connector_retries) before
+                    # letting a persistent failure kill the reader
+                    failures += 1
+                    self.report_retry()
+                    if failures > 5:
+                        raise
+                    time_mod.sleep(min(0.05 * 2**failures, 1.0))
+                    continue
+                failures = 0
                 if batch is None:
                     return  # stream finished
                 got = False

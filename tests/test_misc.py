@@ -196,7 +196,7 @@ def test_prometheus_server():
             "http://127.0.0.1:29123/metrics", timeout=5
         ) as resp:
             body = resp.read().decode()
-        assert "pathway_rows_processed 42" in body
+        assert 'pathway_rows_processed{worker="0"} 42' in body
     finally:
         server.stop()
 

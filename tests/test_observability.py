@@ -1,0 +1,493 @@
+"""The always-on observability layer: registry rendering (strict
+exposition-format checks), histogram bucket math, /metrics + /status over
+HTTP, and flight-recorder diagnostics dumps on injected errors
+(reference: src/engine/http_server.rs per-worker Prometheus,
+src/engine/dataflow/monitoring.rs ProberStats)."""
+
+import json
+import math
+import re
+import socket
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.config import pathway_config
+from pathway_tpu.internals.metrics import (
+    BUCKET_BOUNDS,
+    Histogram,
+    MetricsRegistry,
+    escape_label_value,
+    render_registries,
+)
+from pathway_tpu.internals.monitoring import PrometheusServer
+from pathway_tpu.internals.runner import last_engine, run_tables
+
+
+@pytest.fixture
+def threads2():
+    old = pathway_config.threads
+    pathway_config.threads = 2
+    try:
+        yield
+    finally:
+        pathway_config.threads = old
+
+
+# ---------------------------------------------------------------------------
+# strict exposition-format checker
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_labels(raw):
+    """Label string -> dict; raises on anything the spec forbids."""
+    if not raw:
+        return {}
+    labels = {}
+    rest = raw
+    while rest:
+        m = _LABEL_RE.match(rest)
+        assert m, f"unparseable labels: {raw!r}"
+        assert m.group(1) not in labels, f"duplicate label in {raw!r}"
+        labels[m.group(1)] = m.group(2)
+        rest = rest[m.end():]
+        if rest.startswith(","):
+            rest = rest[1:]
+        elif rest:
+            raise AssertionError(f"junk after label in {raw!r}")
+    return labels
+
+
+def check_exposition(text):
+    """Validate a Prometheus exposition document strictly: one TYPE block
+    per name, samples only under their TYPE, parseable labels/values,
+    histogram buckets cumulative with +Inf == _count and _sum present.
+    Returns {name: [(labels_dict, value), ...]} keyed by sample name."""
+    assert text.endswith("\n"), "document must end with a newline"
+    typed = {}  # name -> kind
+    samples = {}  # sample name -> [(labels, value)]
+    seen_series = set()
+    for line in text.split("\n")[:-1]:
+        assert line == line.strip(), f"stray whitespace: {line!r}"
+        assert line, "blank line inside document"
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            assert len(parts) >= 4 and _NAME_RE.match(parts[2]), line
+            if parts[1] == "TYPE":
+                assert parts[2] not in typed, f"duplicate TYPE for {parts[2]}"
+                assert parts[3] in ("counter", "gauge", "histogram"), line
+                typed[parts[2]] = parts[3]
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample: {line!r}"
+        name, raw_labels, raw_value = m.groups()
+        labels = _parse_labels(raw_labels or "")
+        value = float(raw_value)  # handles +Inf / NaN too
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            stripped = name[: -len(suffix)]
+            if name.endswith(suffix) and typed.get(stripped) == "histogram":
+                base = stripped
+                break
+        assert base in typed, f"sample {name} before/without its TYPE"
+        if typed[base] == "histogram":
+            assert base != name, f"bare sample {name} for histogram {base}"
+        series = (name, tuple(sorted(labels.items())))
+        assert series not in seen_series, f"duplicate series: {line!r}"
+        seen_series.add(series)
+        samples.setdefault(name, []).append((labels, value))
+
+    # histogram invariants per labelset
+    for name, kind in typed.items():
+        if kind != "histogram":
+            continue
+        by_set = {}
+        for labels, value in samples.get(name + "_bucket", []):
+            le = labels["le"]
+            key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+            by_set.setdefault(key, []).append(
+                (math.inf if le == "+Inf" else float(le), value)
+            )
+        counts = {
+            tuple(sorted(labels.items())): value
+            for labels, value in samples.get(name + "_count", [])
+        }
+        sums = {
+            tuple(sorted(labels.items())): value
+            for labels, value in samples.get(name + "_sum", [])
+        }
+        for key, buckets in by_set.items():
+            assert buckets == sorted(buckets), f"{name}{key}: le out of order"
+            values = [v for _, v in buckets]
+            assert values == sorted(values), f"{name}{key}: not cumulative"
+            assert buckets[-1][0] == math.inf, f"{name}{key}: no +Inf bucket"
+            assert key in counts, f"{name}{key}: missing _count"
+            assert key in sums, f"{name}{key}: missing _sum"
+            assert counts[key] == buckets[-1][1], (
+                f"{name}{key}: +Inf bucket != _count"
+            )
+    return samples
+
+
+# ---------------------------------------------------------------------------
+# histogram unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_bucket_math():
+    h = Histogram()
+    # 2^-21 underflows into the first bucket, 32 s overflows into +Inf
+    for x in (2.0**-21, 3e-6, 3e-6, 0.1, 32.0):
+        h.observe(x)
+    assert h.count == 5
+    assert h.sum == pytest.approx(2.0**-21 + 6e-6 + 0.1 + 32.0)
+    # 3e-6 lands in the le=2^-18 (~3.8e-6) bucket: 2^-19 < 3e-6 <= 2^-18
+    idx = [i for i, b in enumerate(BUCKET_BOUNDS) if b / 2 < 3e-6 <= b]
+    assert len(idx) == 1 and h.counts[idx[0]] == 2
+    assert h.counts[0] == 1  # the underflow
+    assert h.counts[-1] == 1  # +Inf slot
+    # zero/negative observations count without a frexp blowup
+    h.observe(0.0)
+    assert h.count == 6 and h.counts[0] == 2
+
+
+def test_histogram_percentile_and_merge():
+    a = Histogram()
+    b = Histogram()
+    for _ in range(99):
+        a.observe(1e-6)
+    b.observe(1.0)
+    a.merge(b)
+    assert a.count == 100
+    assert a.sum == pytest.approx(99e-6 + 1.0)
+    p50 = a.percentile(50)
+    assert p50 is not None and p50 < 1e-5
+    p99 = a.percentile(99)
+    assert p99 < 1e-5  # the 99th observation is still a fast one
+    assert a.percentile(100) > 0.5  # the slow outlier
+    assert Histogram().percentile(50) is None
+
+
+def test_histogram_exposition_samples():
+    reg = MetricsRegistry(worker="0")
+    fam = reg.histogram("test_seconds", help="x", labels=("op",))
+    fam.labels("read").observe(1e-6)
+    fam.labels("read").observe(2.0)
+    samples = check_exposition(reg.render())
+    infs = [
+        v
+        for labels, v in samples["test_seconds_bucket"]
+        if labels["le"] == "+Inf" and labels["op"] == "read"
+    ]
+    assert infs == [2.0]
+
+
+# ---------------------------------------------------------------------------
+# label escaping
+# ---------------------------------------------------------------------------
+
+
+def test_label_escaping_round_trip():
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+    # escaping the escapes first: a literal backslash-n survives as such
+    assert escape_label_value("a\\nb") == "a\\\\nb"
+
+
+def test_evil_label_values_render_valid():
+    reg = MetricsRegistry(worker="0")
+    evil = 'na"me\\with\nnewline'
+    reg.counter("evil_total", help="evil", labels=("name",)).labels(
+        evil
+    ).inc(3)
+    text = render_registries([reg])
+    samples = check_exposition(text)
+    (labels, value) = samples["evil_total"][0]
+    assert value == 3
+    # the checker's parser unescapes nothing; the raw text must carry the
+    # escaped forms
+    assert 'na\\"me\\\\with\\nnewline' in text
+
+
+def test_registry_kind_clash_raises():
+    reg = MetricsRegistry()
+    reg.counter("x_total")
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")
+
+
+# ---------------------------------------------------------------------------
+# engine-fed surfaces
+# ---------------------------------------------------------------------------
+
+
+def _run_small_graph():
+    t = pw.debug.table_from_markdown(
+        """
+        k | v
+        a | 1
+        a | 2
+        b | 5
+        """
+    )
+    res = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+    (cap,) = run_tables(res)
+    return cap.engine
+
+
+def test_metrics_text_is_valid_exposition():
+    engine = _run_small_graph()
+    text = PrometheusServer(engine).metrics_text()
+    samples = check_exposition(text)
+    for needle in (
+        "pathway_node_process_seconds_bucket",
+        "pathway_tick_seconds_sum",
+        "pathway_rows_processed",
+        "pathway_engine_time",
+        "pathway_watermark_lag_seconds",
+        "pathway_scheduled_backlog",
+        "pathway_ticks_total",
+    ):
+        assert needle in samples, f"missing {needle}"
+    # every series carries the worker label
+    for name, entries in samples.items():
+        for labels, _ in entries:
+            assert labels.get("worker") == "0", (name, labels)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_http_metrics_and_status():
+    engine = _run_small_graph()
+    server = PrometheusServer(engine, port=_free_port())
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
+            body = resp.read().decode()
+        check_exposition(body)
+        assert "pathway_node_process_seconds_bucket" in body
+        with urllib.request.urlopen(base + "/status", timeout=5) as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            status = json.loads(resp.read().decode())
+        assert status["worker_count"] == 1
+        assert status["graph"], "topology missing"
+        assert all("inputs" in n for n in status["graph"])
+        (worker,) = status["workers"]
+        assert worker["rows_processed"] > 0
+        nodes = worker["nodes"]
+        reduce_nodes = [n for n in nodes if n["name"] == "reduce"]
+        assert reduce_nodes and reduce_nodes[0]["calls"] >= 1
+        assert reduce_nodes[0]["p50_ms"] is not None
+        assert reduce_nodes[0]["p99_ms"] is not None
+        assert worker["flight_recorder"], "flight recorder empty"
+        with urllib.request.urlopen(base + "/metrics", timeout=5):
+            pass  # second scrape must not fail either
+    finally:
+        server.stop()
+
+
+def test_status_http_404():
+    engine = _run_small_graph()
+    server = PrometheusServer(engine, port=_free_port())
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/nope", timeout=5
+            )
+    finally:
+        server.stop()
+
+
+def test_stats_monitor_thread_lifecycle(capsys):
+    from pathway_tpu.internals.monitoring import StatsMonitor
+
+    engine = _run_small_graph()
+    mon = StatsMonitor(engine)
+    mon.start_live(refresh_per_second=50.0)
+    thread = mon._thread
+    assert thread is not None and thread.is_alive()
+    mon.stop()  # must join the updater, not race a final render
+    assert mon._thread is None
+    assert not thread.is_alive()
+    assert mon._live is None
+    # restartable after stop
+    mon.start_live(refresh_per_second=50.0)
+    mon.stop()
+    assert mon._thread is None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder / diagnostics dumps
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_dump_on_udf_error():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        6 | 2
+        5 | 0
+        """
+    )
+    res = t.select(a=t.a, q=t.a // t.b)
+    (cap,) = run_tables(res)
+    eng = cap.engine
+    diag = eng.last_diagnostics
+    assert diag is not None, "error run must auto-dump diagnostics"
+    assert diag["reason"] == "error_log"
+    assert diag["errors"] and "ZeroDivision" in diag["errors"][0]["message"]
+    kinds = {e["kind"] for e in diag["flight_recorder"]}
+    assert {"node", "tick", "error"} <= kinds
+    err = [e for e in diag["flight_recorder"] if e["kind"] == "error"][0]
+    assert "ZeroDivision" in err["name"] and err["errors"] == 1
+    # the dump is JSON-serializable as-is
+    json.dumps(diag, default=str)
+    # explicit dumps work too and record their reason
+    assert eng.dump_diagnostics(reason="manual")["reason"] == "manual"
+
+
+def test_flight_recorder_dump_on_udf_error_threads(threads2, tmp_path):
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        6 | 2
+        5 | 0
+        7 | 0
+        8 | 4
+        """
+    )
+    res = t.select(a=t.a, q=t.a // t.b).remove_errors()
+    pw.io.fs.write(res, str(tmp_path / "out.jsonl"), format="json")
+    pw.run(monitoring_level=None)
+    engines = [last_engine()] + list(last_engine().coord.group.engines)
+    dumps = [
+        e.last_diagnostics
+        for e in dict.fromkeys(engines)
+        if e.last_diagnostics is not None
+    ]
+    assert dumps, "no worker dumped diagnostics"
+    assert any(d["errors"] for d in dumps)
+
+
+def test_connector_retries_surface():
+    """A flaky broker client retried by the MQ reader shows up in the
+    per-connector stats and the pathway_connector_retries series."""
+    from pathway_tpu.internals.schema import ColumnSchema, schema_from_columns
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.io import _mq
+
+    class FlakyClient(_mq.MessageQueueClient):
+        def __init__(self):
+            self.calls = 0
+            self.messages = [
+                json.dumps({"a": i}).encode() for i in range(3)
+            ]
+
+        def poll(self, timeout):
+            self.calls += 1
+            if self.calls <= 2:
+                raise ConnectionError("broker hiccup")
+            if not self.messages:
+                return None
+            return [(None, self.messages.pop(0), {})]
+
+        def produce(self, topic, key, payload):
+            raise NotImplementedError
+
+        def close(self):
+            pass
+
+    schema = schema_from_columns(
+        {"a": ColumnSchema(name="a", dtype=dt.INT)}, name="SFlaky"
+    )
+    t = pw.io.kafka.read(
+        {},
+        "topic",
+        schema=schema,
+        format="json",
+        name="flaky_src",
+        _client_factory=FlakyClient,
+    )
+    rows = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition: rows.append(row)
+    )
+    pw.run(monitoring_level=None, autocommit_duration_ms=20)
+    eng = last_engine()
+    assert len(rows) == 3
+    stats = eng.connector_stats["flaky_src"]
+    assert stats["retries"] == 2, stats
+    text = PrometheusServer(eng).metrics_text()
+    assert 'pathway_connector_retries{worker="0",source="flaky_src"} 2' in text
+    check_exposition(text)
+
+
+def test_diagnostics_dir_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_DIAGNOSTICS_DIR", str(tmp_path))
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        5 | 0
+        """
+    )
+    res = t.select(q=t.a // t.b)
+    run_tables(res)
+    files = list(tmp_path.glob("pathway_diag_*.json"))
+    assert files, "no diagnostics file written"
+    diag = json.loads(files[0].read_text())
+    assert diag["errors"] and diag["nodes"]
+
+
+# ---------------------------------------------------------------------------
+# multi-worker export
+# ---------------------------------------------------------------------------
+
+
+def test_two_worker_metrics_export(threads2, tmp_path):
+    t = pw.debug.table_from_markdown(
+        """
+        k | v
+        0 | 1
+        1 | 2
+        0 | 3
+        2 | 4
+        """
+    )
+    res = t.groupby(pw.this.k).reduce(
+        pw.this.k, s=pw.reducers.sum(pw.this.v)
+    )
+    pw.io.fs.write(res, str(tmp_path / "out.jsonl"), format="json")
+    pw.run(monitoring_level=None)
+    server = PrometheusServer(last_engine())
+    text = server.metrics_text()
+    samples = check_exposition(text)
+    workers = {
+        labels.get("worker")
+        for labels, _ in samples["pathway_node_process_seconds_bucket"]
+    }
+    assert workers == {"0", "1"}, workers
+    assert "pathway_watermark_lag_seconds" in samples
+    assert "pathway_exchange_collect_wait_seconds_bucket" in samples
+    assert "pathway_exchange_agree_wait_seconds_bucket" in samples
+    assert "pathway_exchange_queue_depth" in samples
+    status = server.status_json()
+    assert [w["worker"] for w in status["workers"]] == [0, 1]
+    for w in status["workers"]:
+        assert w["nodes"], f"worker {w['worker']} has no node stats"

@@ -85,6 +85,68 @@ def test_columnar_flatten_selected_with_live_counters():
 
 
 @pytest.mark.perf_smoke
+def test_observability_overhead_under_5pct():
+    """The metrics layer runs unconditionally, so its cost on the engine
+    microbench loop (source -> 3 rowwise maps, hundreds of rows/tick) must
+    stay under 5% vs `Engine(metrics=False)`.  Min-of-N interleaved
+    timings keep scheduler noise out of the ratio.
+
+    GC is quiesced around the timed loops for the same reason
+    `Engine.run_static` calls `_gc_quiesce`: threshold-triggered cyclic
+    collections rescan the process's entire live heap, so embedded in a
+    large test suite they'd bill suite-wide GC cost to whichever arm
+    happens to allocate the triggering object."""
+    import gc
+    from time import perf_counter
+
+    from pathway_tpu.engine.engine import InputQueueSource, RowwiseNode
+
+    ROWS, TICKS, REPS = 512, 40, 5
+    deltas = [(ref_scalar("k", i), (i,), 1) for i in range(ROWS)]
+
+    def ident(keys, cols):
+        return cols[0]
+
+    def run_once(metrics: bool) -> float:
+        eng = Engine(metrics=metrics)
+        src = InputQueueSource(eng)
+        node = src
+        for _ in range(3):
+            node = RowwiseNode(eng, [node], ident)
+        try:
+            time = 2
+            for _ in range(8):  # warmup (allocators, bytecode caches)
+                src.push(time, deltas)
+                eng.process_time(time)
+                time += 2
+            t0 = perf_counter()
+            for _ in range(TICKS):
+                src.push(time, deltas)
+                eng.process_time(time)
+                time += 2
+            return perf_counter() - t0
+        finally:
+            eng._gc_unfreeze()
+
+    on, off = [], []
+    gc.collect()
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        for _ in range(REPS):
+            on.append(run_once(True))
+            off.append(run_once(False))
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+    ratio = min(on) / min(off)
+    assert ratio < 1.05, (
+        f"always-on metrics overhead {ratio:.3f}x "
+        f"(on={min(on):.4f}s off={min(off):.4f}s)"
+    )
+
+
+@pytest.mark.perf_smoke
 def test_ineligible_graphs_stay_classic():
     """The gates must also say no: non-hashable join keys and
     non-vector reducers fall back to classic nodes (path counters show
