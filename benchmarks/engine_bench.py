@@ -343,6 +343,137 @@ def bench_wordcount_multiworker(n_rows=2_000_000, workers=(1, 2, 4)):
 
 
 
+def bench_exchange(n_rows=300_000, vocab=40_000, churn_pairs=15_000):
+    """Worker-to-worker shuffle microbench (engine/exchange.py).
+
+    Two numbers:
+
+    1. shuffle rows/s — a 2-thread-worker static wordcount whose groupby
+       forces an exchange_by_key of nearly every row, A/B'd classic vs
+       columnar routing by flipping exchange.VECTOR_EXCHANGE_ENABLED
+       (consulted per batch, so a module-level flip is a clean A/B).
+       Reported from PATHWAY_NODE_TIMING_LOG seconds isolated to the
+       _ExchangeNode (end-to-end wall time is dominated by the json
+       source parse; run-to-run heap noise swamps the routing delta).
+    2. bytes on the wire before/after sender-side consolidation — a real
+       TcpCoordinator pair ships a retraction-heavy batch raw and then
+       consolidated, measured from the coordinator's own bytes_sent
+       counter (the exact frames send_data produces).
+    """
+    import tempfile
+    import threading
+
+    from pathway_tpu.engine import exchange as exchange_mod
+    from pathway_tpu.internals.config import pathway_config
+    from pathway_tpu.internals.parse_graph import G
+
+    rng = random.Random(11)
+
+    class _WordSchema(pw.Schema):
+        word: str
+
+    secs = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        in_dir = _os.path.join(tmp, "input")
+        _os.makedirs(in_dir)
+        with open(_os.path.join(in_dir, "data.jsonl"), "w") as fh:
+            for _ in range(n_rows):
+                fh.write(json.dumps({"word": f"w{rng.randrange(vocab)}"}))
+                fh.write("\n")
+        run_no = 0
+        for label, enabled in (
+            ("classic", False), ("columnar", True),
+            ("classic", False), ("columnar", True),  # best-of-2 per path
+        ):
+            run_no += 1
+            G.clear()
+            log = _os.path.join(tmp, f"timing_{run_no}.jsonl")
+            saved_env = _os.environ.get("PATHWAY_NODE_TIMING_LOG")
+            _os.environ["PATHWAY_NODE_TIMING_LOG"] = log
+            saved_flag = exchange_mod.VECTOR_EXCHANGE_ENABLED
+            saved_threads = pathway_config.threads
+            exchange_mod.VECTOR_EXCHANGE_ENABLED = enabled
+            pathway_config.threads = 2
+            try:
+                words = pw.io.fs.read(
+                    path=in_dir, schema=_WordSchema,
+                    format="json", mode="static",
+                )
+                res = words.groupby(words.word).reduce(
+                    words.word, count=pw.reducers.count()
+                )
+                pw.io.csv.write(
+                    res, _os.path.join(tmp, f"out_{run_no}.csv")
+                )
+                pw.run(monitoring_level=None)
+                node_s = _node_seconds(log, ("_ExchangeNode",))
+                secs[label] = min(secs.get(label, node_s), node_s)
+            finally:
+                exchange_mod.VECTOR_EXCHANGE_ENABLED = saved_flag
+                pathway_config.threads = saved_threads
+                if saved_env is None:
+                    del _os.environ["PATHWAY_NODE_TIMING_LOG"]
+                else:
+                    _os.environ["PATHWAY_NODE_TIMING_LOG"] = saved_env
+                G.clear()
+    rps = {k: round(n_rows / v) for k, v in secs.items()}
+
+    # -- wire bytes: raw vs sender-consolidated ---------------------------
+    from pathway_tpu.engine.exchange import TcpCoordinator
+    from pathway_tpu.engine.stream import consolidate
+
+    # retraction-heavy batch: churn_pairs rows get +1 immediately followed
+    # by -1 (net zero), churn_pairs more survive — consolidation halves+
+    # the row count before encoding
+    deltas = []
+    for i in range(churn_pairs):
+        k = ref_scalar("churn", i)
+        deltas.append((k, (i, f"v{i}"), 1))
+        deltas.append((k, (i, f"v{i}"), -1))
+        deltas.append((ref_scalar("keep", i), (i, f"v{i}"), 1))
+
+    base = _free_port_base(2)
+    coords = [None, None]
+
+    def _mk(w):
+        coords[w] = TcpCoordinator(w, 2, base, run_id="bench-exchange")
+
+    builders = [threading.Thread(target=_mk, args=(w,)) for w in (0, 1)]
+    for b in builders:
+        b.start()
+    for b in builders:
+        b.join()
+    c0 = coords[0]
+    try:
+        before = c0._m_bytes_sent.value
+        c0.send_data(1, 7, 2, deltas)
+        raw_bytes = c0._m_bytes_sent.value - before
+        consolidated = consolidate(deltas)
+        before = c0._m_bytes_sent.value
+        c0.send_data(1, 7, 4, consolidated)
+        cons_bytes = c0._m_bytes_sent.value - before
+    finally:
+        for c in coords:
+            if c is not None:
+                c.close()
+
+    print(json.dumps({
+        "metric": "exchange_throughput",
+        "value": rps["columnar"],
+        "unit": "rows/s through the exchange node "
+                "(2-thread-worker static wordcount shuffle)",
+        "classic_rows_per_sec": rps["classic"],
+        "classic_s": round(secs["classic"], 4),
+        "columnar_s": round(secs["columnar"], 4),
+        "columnar_vs_classic": round(rps["columnar"] / rps["classic"], 2),
+        "bytes_sent_raw": raw_bytes,
+        "bytes_sent_consolidated": cons_bytes,
+        "consolidation_bytes_ratio": round(cons_bytes / raw_bytes, 3),
+        "n_rows": n_rows,
+    }))
+    return rps
+
+
 def bench_tick_overhead(workers=(2, 4), duration_s=3.0):
     """Coordination cost per streaming tick: N workers run an idle
     streaming pipeline (10 ms autocommit) and report ticks/s plus
@@ -449,6 +580,8 @@ if __name__ == "__main__":
     elif "--columnar" in _sys.argv:
         bench_join_columnar()
         bench_flatten_columnar()
+    elif "--exchange" in _sys.argv:
+        bench_exchange()
     else:
         bench_group_update_flatness()
         bench_wordcount()

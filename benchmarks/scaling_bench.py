@@ -135,7 +135,9 @@ def _count_output(tmp: str, out_name: str, n_workers: int) -> int:
     return total
 
 
-def run_processes(n_rows: int, n_workers: int, script: str) -> float:
+def run_processes(
+    n_rows: int, n_workers: int, script: str, extra_env: dict | None = None
+) -> float:
     with tempfile.TemporaryDirectory() as tmp:
         in_dir = os.path.join(tmp, "input")
         os.makedirs(in_dir)
@@ -152,6 +154,7 @@ def run_processes(n_rows: int, n_workers: int, script: str) -> float:
                 PATHWAY_FIRST_PORT=str(base),
                 PATHWAY_THREADS="1",
                 JAX_PLATFORMS="cpu",
+                **(extra_env or {}),
             )
             procs.append(
                 subprocess.Popen(
@@ -172,7 +175,9 @@ def run_processes(n_rows: int, n_workers: int, script: str) -> float:
     return elapsed
 
 
-def run_threads(n_rows: int, n_workers: int, script: str) -> float:
+def run_threads(
+    n_rows: int, n_workers: int, script: str, extra_env: dict | None = None
+) -> float:
     with tempfile.TemporaryDirectory() as tmp:
         in_dir = os.path.join(tmp, "input")
         os.makedirs(in_dir)
@@ -183,6 +188,7 @@ def run_threads(n_rows: int, n_workers: int, script: str) -> float:
             PATHWAY_THREADS=str(n_workers),
             PATHWAY_PROCESSES="1",
             JAX_PLATFORMS="cpu",
+            **(extra_env or {}),
         )
         t0 = time.perf_counter()
         p = subprocess.Popen(
@@ -217,6 +223,16 @@ def main() -> None:
         for n in counts:
             elapsed = run_threads(n_rows, n, script)
             results["threads"][n] = round(n_rows / elapsed)
+        # columnar-exchange A/B at the contended worker counts: same
+        # pipeline with the vectorized shuffle forced off — the delta is
+        # the routing + frame + consolidation work, everything else held
+        classic_env = {"PATHWAY_DISABLE_VECTOR_EXCHANGE": "1"}
+        classic: dict = {"processes": {}, "threads": {}}
+        for n in (2, 4):
+            elapsed = run_processes(n_rows, n, script, classic_env)
+            classic["processes"][n] = round(n_rows / elapsed)
+            elapsed = run_threads(n_rows, n, script, classic_env)
+            classic["threads"][n] = round(n_rows / elapsed)
     finally:
         os.unlink(script)
 
@@ -239,6 +255,14 @@ def main() -> None:
                 "processes_efficiency": efficiency(results["processes"]),
                 "threads_rows_per_sec": results["threads"],
                 "threads_efficiency": efficiency(results["threads"]),
+                "classic_exchange_rows_per_sec": classic,
+                "columnar_exchange_speedup": {
+                    mode: {
+                        n: round(results[mode][n] / classic[mode][n], 3)
+                        for n in classic[mode]
+                    }
+                    for mode in classic
+                },
                 "notes": (
                     "processes: streaming TCP mesh + typed wire, "
                     "partitioned file reads (disjoint parse per worker), "

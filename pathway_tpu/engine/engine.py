@@ -56,11 +56,13 @@ class Node:
     """Base dataflow operator (reference: one timely operator)."""
 
     name: str = "node"
-    # Build-time path observability: operators that participate in the
-    # classic-vs-columnar selection (join/flatten/reduce) set `path` to
-    # "classic" or "columnar" and bump the counters in process().
-    # Augmented assignment on the int class attrs creates per-instance
-    # counters lazily, so plain nodes pay nothing.
+    # Execution-path observability: operators that participate in the
+    # classic-vs-columnar selection set `path` to "classic" or "columnar"
+    # and bump the counters in process(). For join/flatten/reduce the
+    # choice is made at build time; the exchange node decides per batch
+    # (its gate is a runtime flag), so its `path` reflects the last batch
+    # routed. Augmented assignment on the int class attrs creates
+    # per-instance counters lazily, so plain nodes pay nothing.
     path: Optional[str] = None
     rows_processed: int = 0
     batches_processed: int = 0

@@ -21,11 +21,22 @@ Messages:
   ("coord", round_no, payload)       — lockstep agreement votes
 A dead peer (socket EOF/reset) turns every pending wait into EngineError —
 failure detection, not silent hangs.
+
+The shuffle itself is columnar end to end when the native module is
+available (gate: PATHWAY_DISABLE_VECTOR_EXCHANGE): shard codes for a whole
+delta batch come from one wire_ext pass, partitioning into per-worker
+slabs is a single C pass, each remote partition is consolidated before
+encoding (cancelling insert/retract pairs never hit the socket), frames
+are encoded length-prefix-and-all in one buffer, and per-peer writer
+threads overlap encoding with the TCP sends while eager per-destination
+punctuation lets receivers unblock as their partition arrives.
 """
 
 from __future__ import annotations
 
+import logging
 import os
+import queue
 import socket
 import struct
 import threading
@@ -33,6 +44,23 @@ import time as time_mod
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 _LEN = struct.Struct("!I")
+
+logger = logging.getLogger("pathway_tpu.exchange")
+
+# Columnar exchange gate: vectorized shard routing, single-pass
+# partitioning, sender-side consolidation, fused frame encoding and
+# per-peer writer threads. The classic row-wise path stays available as
+# the always-working fallback (and the parity baseline for tests).
+VECTOR_EXCHANGE_ENABLED = (
+    os.environ.get("PATHWAY_DISABLE_VECTOR_EXCHANGE") != "1"
+)
+
+# chunked sends bound peak frame/socket buffers on bulk-ingest batches (a
+# single million-row message costs hundreds of MB on both ends)
+_CHUNK = 65536
+
+# frames buffered per peer writer before senders block (backpressure)
+_SEND_QUEUE_FRAMES = 64
 
 
 class ExchangeError(Exception):
@@ -49,6 +77,13 @@ class Coordinator:
     def owns(self, shard: int) -> bool:
         return True
 
+    def is_remote(self, dest: int) -> bool:
+        """True when frames for `dest` cross a process boundary (encode +
+        socket). Sender-side consolidation only pays for remote peers —
+        local handoffs are plain list appends and the receiver's emit()
+        consolidates the merged batch anyway."""
+        return dest != self.worker_id
+
     def agree(self, payload: Any) -> List[Any]:
         """All-gather `payload` across workers; returns payloads ordered by
         worker id. Calls must happen in the same order on every worker."""
@@ -57,14 +92,98 @@ class Coordinator:
     def send_data(self, dest: int, channel: int, time: int, deltas: list) -> None:
         raise ExchangeError("single-worker coordinator cannot send")
 
+    def broadcast_data(self, channel: int, time: int, deltas: list) -> None:
+        """Ship the same deltas to every peer. Transports override this to
+        encode the message once and fan the identical blob out."""
+        for w in range(self.worker_count):
+            if w != self.worker_id:
+                self.send_data(w, channel, time, deltas)
+
     def punctuate(self, channel: int, time: int) -> None:
         pass
+
+    def punctuate_one(self, dest: int, channel: int, time: int) -> None:
+        """Point-to-point punctuation toward one destination (the eager
+        form: a peer's collect() can unblock before the sender finishes
+        its full fan-out). Broadcast-only transports may fall back to
+        punctuate() — duplicate puncts are idempotent because receivers
+        count distinct senders."""
+        self.punctuate(channel, time)
 
     def collect(self, channel: int, time: int) -> list:
         return []
 
     def close(self) -> None:
         pass
+
+
+class _PeerWriter:
+    """Per-peer send thread behind a small bounded queue: encoding (and
+    consolidating) partition w+1 overlaps the TCP send of partition w.
+
+    ALL post-hello traffic to a peer flows through its writer, so the
+    per-socket FIFO — data frames before the punctuation that covers
+    them, both before the next agreement round — is exactly the ordering
+    direct sendall calls gave. A full queue blocks the sender
+    (backpressure); a dead socket flips the writer into drain mode so
+    blocked senders always unblock and failure surfaces via the
+    coordinator's dead-peer bookkeeping instead of a hang."""
+
+    _CLOSE = object()
+
+    def __init__(
+        self,
+        peer: int,
+        sock: socket.socket,
+        lock: threading.Lock,
+        on_dead: Callable[[int], None],
+    ):
+        self.peer = peer
+        self.sock = sock
+        # shared with the coordinator's synchronous control-plane sends
+        # (agree votes bypass the queue); holding it around each sendall
+        # keeps whole frames atomic on the stream
+        self.lock = lock
+        self.on_dead = on_dead
+        self.dead = False
+        self.q: queue.Queue = queue.Queue(maxsize=_SEND_QUEUE_FRAMES)
+        self.thread = threading.Thread(
+            target=self._run, daemon=True, name=f"exchange-send-{peer}"
+        )
+        self.thread.start()
+
+    def depth(self) -> int:
+        return self.q.qsize()
+
+    def send(self, frame: bytes) -> None:
+        if self.dead:
+            return
+        self.q.put(frame)
+
+    def _run(self) -> None:
+        while True:
+            frame = self.q.get()
+            if frame is self._CLOSE:
+                return
+            if self.dead:
+                continue  # drain so blocked senders never deadlock
+            try:
+                with self.lock:
+                    self.sock.sendall(frame)
+            except OSError:
+                self.dead = True
+                self.on_dead(self.peer)
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Flush queued frames, then stop the thread. If the writer is
+        wedged (peer stopped reading), give up after the timeout — the
+        coordinator closes the socket right after, which unblocks it."""
+        try:
+            self.q.put(self._CLOSE, timeout=timeout)
+        except queue.Full:
+            self.dead = True
+            return
+        self.thread.join(timeout)
 
 
 class TcpCoordinator(Coordinator):
@@ -99,7 +218,23 @@ class TcpCoordinator(Coordinator):
         self._closed = False
         self._out: Dict[int, socket.socket] = {}
         self._out_locks: Dict[int, threading.Lock] = {}
+        self._writers: Dict[int, _PeerWriter] = {}
+        # snapshot: writer threads are a transport choice made once per
+        # mesh; the per-batch routing gate stays flippable at runtime.
+        # Overlapped sends need a second core to overlap ONTO — on a
+        # single-CPU host the extra thread is pure GIL ping-pong, so the
+        # default is auto; PATHWAY_EXCHANGE_WRITERS=1/0 forces it.
+        writers_env = os.environ.get("PATHWAY_EXCHANGE_WRITERS")
+        if writers_env is not None:
+            self._use_writers = writers_env == "1"
+        else:
+            self._use_writers = (
+                VECTOR_EXCHANGE_ENABLED and (os.cpu_count() or 1) > 1
+            )
         self._threads: List[threading.Thread] = []
+        from pathway_tpu.engine.wire import encode_frame
+
+        self._encode_frame = encode_frame
         self._init_metrics()
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -163,6 +298,13 @@ class TcpCoordinator(Coordinator):
             help="(channel, time) pairs with outstanding punctuation",
             callback=lambda: len(self._punct),
         )
+        reg.gauge(
+            "pathway_exchange_send_queue_depth",
+            help="encoded frames buffered on per-peer writer threads",
+            callback=lambda: sum(
+                w.depth() for w in list(self._writers.values())
+            ),
+        )
 
     # -- connection setup -------------------------------------------------
     def _connect_peers(self, timeout: float) -> None:
@@ -179,6 +321,10 @@ class TcpCoordinator(Coordinator):
                     self._out[peer] = s
                     self._out_locks[peer] = threading.Lock()
                     self._send_on(s, ("hello", self.worker_id, self.run_id))
+                    if self._use_writers:
+                        self._writers[peer] = _PeerWriter(
+                            peer, s, self._out_locks[peer], self._mark_peer_dead
+                        )
                     break
                 except OSError:
                     if time_mod.monotonic() > deadline:
@@ -194,6 +340,12 @@ class TcpCoordinator(Coordinator):
                 conn, _addr = self._listener.accept()
             except OSError:
                 return
+            try:
+                # accepted sockets carry punct/coord replies on some
+                # topologies; leaving Nagle on there adds 40ms stalls
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
             t = threading.Thread(
                 target=self._recv_loop, args=(conn,), daemon=True,
                 name="exchange-recv",
@@ -203,21 +355,46 @@ class TcpCoordinator(Coordinator):
 
     # -- wire -------------------------------------------------------------
     def _send_on(self, sock: socket.socket, msg: Any) -> None:
-        from pathway_tpu.engine.wire import encode_message
+        frame = self._encode_frame(msg)
+        self._m_bytes_sent.inc(len(frame))
+        sock.sendall(frame)
 
-        blob = encode_message(msg)
-        self._m_bytes_sent.inc(_LEN.size + len(blob))
-        sock.sendall(_LEN.pack(len(blob)) + blob)
+    def _mark_peer_dead(self, peer: int) -> None:
+        with self._cv:
+            self._dead.add(peer)
+            self._cv.notify_all()
+
+    def _dispatch(self, dest: int, frame: bytes) -> None:
+        """Hand one encoded frame to `dest`'s writer (overlapped) or send
+        it inline when writers are disabled. Send failures mark the peer
+        dead; callers surface that via _check_dead / collect / agree."""
+        self._m_bytes_sent.inc(len(frame))
+        writer = self._writers.get(dest)
+        if writer is not None:
+            writer.send(frame)
+            if writer.dead:
+                self._mark_peer_dead(dest)
+            return
+        sock = self._out[dest]
+        with self._out_locks[dest]:
+            try:
+                sock.sendall(frame)
+            except OSError:
+                self._mark_peer_dead(dest)
 
     @staticmethod
     def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-        buf = b""
-        while len(buf) < n:
-            chunk = sock.recv(n - len(buf))
-            if not chunk:
+        # recv_into a preallocated buffer: the old `buf += chunk` loop
+        # reallocated-and-copied per chunk (O(n^2) on multi-MB frames)
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
+            r = sock.recv_into(view[got:])
+            if not r:
                 return None
-            buf += chunk
-        return buf
+            got += r
+        return bytes(buf)
 
     def _recv_loop(self, conn: socket.socket) -> None:
         from pathway_tpu.engine.wire import (
@@ -284,12 +461,17 @@ class TcpCoordinator(Coordinator):
                         _, round_no, payload = msg
                         self._coord.setdefault(round_no, {})[peer] = payload
                     self._cv.notify_all()
-        except Exception:  # noqa: BLE001 — socket teardown paths
-            pass
+        except Exception as exc:  # noqa: BLE001 — socket teardown paths
+            if peer is not None:
+                with self._cv:
+                    self._dead_reasons.setdefault(
+                        peer, f"{type(exc).__name__}: {exc}"
+                    )
         finally:
             with self._cv:
                 if peer is not None and not self._closed:
                     self._dead.add(peer)
+                    self._dead_reasons.setdefault(peer, "connection closed")
                 self._cv.notify_all()
             try:
                 conn.close()
@@ -297,14 +479,33 @@ class TcpCoordinator(Coordinator):
                 pass
 
     def _broadcast(self, msg: Any) -> None:
+        # encode ONCE; every peer gets the identical blob
+        frame = self._encode_frame(msg)
+        for peer in self._out:
+            self._dispatch(peer, frame)
+
+    def _broadcast_sync(self, msg: Any) -> None:
+        """Broadcast on the caller's thread, bypassing the writer queues.
+
+        Agreement votes MUST go out synchronously: a worker may exit the
+        process right after its final agree() returns, and frames still
+        sitting in a daemon writer queue die with it — the peer then
+        blocks on a vote that never arrives and reports the worker dead.
+        Synchronous sendall puts the bytes in the kernel buffer before
+        agree() can return, so they survive process exit (classic-path
+        behavior). Votes have no ordering constraint against queued
+        data/punct frames — they are keyed by round number and only
+        consumed once the peer itself reaches that agree round, which is
+        after all its collects completed. The per-peer out-lock (shared
+        with the writer thread) keeps frames atomic on the stream."""
+        frame = self._encode_frame(msg)
         for peer, sock in self._out.items():
-            with self._out_locks[peer]:
-                try:
-                    self._send_on(sock, msg)
-                except OSError:
-                    with self._cv:
-                        self._dead.add(peer)
-                        self._cv.notify_all()
+            self._m_bytes_sent.inc(len(frame))
+            try:
+                with self._out_locks[peer]:
+                    sock.sendall(frame)
+            except OSError:
+                self._mark_peer_dead(peer)
 
     def _check_dead(self) -> None:
         if self._dead and not self._closed:
@@ -321,17 +522,20 @@ class TcpCoordinator(Coordinator):
         return shard % self.worker_count == self.worker_id
 
     def send_data(self, dest: int, channel: int, time: int, deltas: list) -> None:
-        sock = self._out[dest]
-        with self._out_locks[dest]:
-            try:
-                self._send_on(sock, ("data", channel, time, deltas))
-            except OSError:
-                with self._cv:
-                    self._dead.add(dest)
-                self._check_dead()
+        self._dispatch(dest, self._encode_frame(("data", channel, time, deltas)))
+        if self._dead:
+            self._check_dead()
+
+    def broadcast_data(self, channel: int, time: int, deltas: list) -> None:
+        self._broadcast(("data", channel, time, deltas))
+        if self._dead:
+            self._check_dead()
 
     def punctuate(self, channel: int, time: int) -> None:
         self._broadcast(("punct", channel, time))
+
+    def punctuate_one(self, dest: int, channel: int, time: int) -> None:
+        self._dispatch(dest, self._encode_frame(("punct", channel, time)))
 
     def collect(self, channel: int, time: int, timeout: float = 600.0) -> list:
         """Block until every peer punctuated channel@time; return received
@@ -352,7 +556,12 @@ class TcpCoordinator(Coordinator):
                         time_mod.monotonic() - t0
                     )
                     return out
-                if self._dead:
+                # a peer that finished its run closes cleanly while we may
+                # still be waiting on OTHER peers' frames — only a dead
+                # peer whose punctuation we still lack is fatal (its punct
+                # rides the same per-peer FIFO as its data, so punct
+                # present => all its data arrived)
+                if self._dead - got:
                     break
                 if not self._cv.wait(timeout=min(1.0, deadline - time_mod.monotonic())):
                     if time_mod.monotonic() >= deadline:
@@ -367,7 +576,7 @@ class TcpCoordinator(Coordinator):
     def agree(self, payload: Any, timeout: float = 600.0) -> List[Any]:
         round_no = self._round
         self._round += 1
-        self._broadcast(("coord", round_no, payload))
+        self._broadcast_sync(("coord", round_no, payload))
         t0 = time_mod.monotonic()
         deadline = t0 + timeout
         with self._cv:
@@ -378,7 +587,14 @@ class TcpCoordinator(Coordinator):
                     votes = dict(votes)
                     self._m_agree_wait.observe(time_mod.monotonic() - t0)
                     break
-                if self._dead:
+                # during the FINAL round early finishers exit (clean EOF)
+                # as soon as their agree completes; their vote already
+                # arrived, so only a dead peer whose vote is still missing
+                # means the round can never complete
+                if any(
+                    w in self._dead for w in range(self.worker_count)
+                    if w != self.worker_id and w not in votes
+                ):
                     self._check_dead()
                 if not self._cv.wait(timeout=min(1.0, deadline - time_mod.monotonic())):
                     if time_mod.monotonic() >= deadline:
@@ -391,6 +607,8 @@ class TcpCoordinator(Coordinator):
 
     def close(self) -> None:
         self._closed = True
+        for writer in self._writers.values():
+            writer.close()
         try:
             self._listener.close()
         except OSError:
@@ -543,6 +761,11 @@ class _ThreadWorkerCoordinator(Coordinator):
     def owns(self, shard: int) -> bool:
         return shard % self.worker_count == self.worker_id
 
+    def is_remote(self, dest: int) -> bool:
+        # in-process siblings get their deltas by reference (send_local);
+        # only cross-process destinations hit encode + socket
+        return dest // self.group.threads != self.group.process_id
+
     def agree(self, payload: Any) -> List[Any]:
         t0 = time_mod.monotonic()
         result = self.group.agree(self.thread_index, payload)
@@ -564,6 +787,21 @@ class _ThreadWorkerCoordinator(Coordinator):
                 time, deltas,
             )
 
+    def broadcast_data(self, channel: int, time: int, deltas: list) -> None:
+        g = self.group
+        for t2 in range(g.threads):
+            if t2 != self.thread_index:
+                g.send_local(t2, channel, time, self.worker_id, deltas)
+        if g.tcp is not None:
+            # one encode per destination thread slot, shared by every peer
+            # process (T encodes instead of T x P)
+            for dest_t in range(g.threads):
+                g.tcp.broadcast_data(
+                    self._wire(channel, dest_t, self.thread_index),
+                    time,
+                    deltas,
+                )
+
     def punctuate(self, channel: int, time: int) -> None:
         g = self.group
         for t2 in range(g.threads):
@@ -574,6 +812,24 @@ class _ThreadWorkerCoordinator(Coordinator):
                 g.tcp.punctuate(
                     self._wire(channel, dest_t, self.thread_index), time
                 )
+
+    def punctuate_one(self, dest: int, channel: int, time: int) -> None:
+        """Eager per-destination punctuation. A broadcast here would be
+        wrong, not just wasteful: it would tell thread dest_t in EVERY
+        process "my data is in" while only dest's partition has been
+        sent — dest_t's collect() in the other processes could pop before
+        their data arrives. Point-to-point puncts ride the same per-peer
+        FIFO as the data frames, so data-before-punct holds per
+        destination."""
+        g = self.group
+        dest_p, dest_t = divmod(dest, g.threads)
+        if dest_p == g.process_id:
+            if dest_t != self.thread_index:
+                g.punct_local(dest_t, channel, time, self.worker_id)
+        else:
+            g.tcp.punctuate_one(
+                dest_p, self._wire(channel, dest_t, self.thread_index), time
+            )
 
     def collect(self, channel: int, time: int, timeout: float = 600.0) -> list:
         g = self.group
@@ -631,17 +887,81 @@ class _ThreadWorkerCoordinator(Coordinator):
 # ---------------------------------------------------------------------------
 
 
+class _Route:
+    """Declarative routing spec for exchange nodes.
+
+    `kind` selects how a row's 16-bit shard code is derived: "key" (the
+    row key's own shard bits), "value" (ref_scalar hash of value_fn's
+    per-row output), "worker" (a fixed destination). Keeping the spec
+    declarative — instead of the closures the helpers used to build —
+    is what lets the exchange node route a whole batch through the
+    native kernels; codes() remains the row-wise reference the classic
+    path runs and the columnar path must agree with."""
+
+    __slots__ = ("kind", "value_fn", "worker")
+
+    def __init__(
+        self,
+        kind: str,
+        value_fn: Optional[Callable] = None,
+        worker: int = 0,
+    ):
+        self.kind = kind
+        self.value_fn = value_fn
+        self.worker = worker
+
+    def codes(
+        self,
+        keys: list,
+        rows: tuple,
+        note_unroutable: Optional[Callable[[int], None]] = None,
+    ) -> List[int]:
+        from pathway_tpu.engine.value import Pointer, ref_scalar
+
+        if self.kind == "key":
+            return [k.shard for k in keys]
+        if self.kind == "worker":
+            return [self.worker] * len(keys)
+        values = self.value_fn(keys, rows)
+        out: List[int] = []
+        n_bad = 0
+        for v in values:
+            if isinstance(v, Pointer):
+                out.append(v.shard)
+            else:
+                try:
+                    out.append(ref_scalar(v).shard)
+                except Exception:  # noqa: BLE001 — unhashable: worker 0
+                    out.append(0)
+                    n_bad += 1
+        if n_bad and note_unroutable is not None:
+            note_unroutable(n_bad)
+        return out
+
+
 def _make_exchange_node():
     from pathway_tpu.engine.engine import Node
+    from pathway_tpu.engine.stream import consolidate
+    from pathway_tpu.engine.value import ref_scalar, shard_kernels
 
     class _ExchangeNode(Node):
-        """Re-partitions a delta stream across workers by a routing function.
+        """Re-partitions a delta stream across workers by a routing spec.
 
         Placed before stateful operators so rows that must interact (same
         group / join key / instance) meet on one worker (reference:
         shard.rs — the exchange pact on keyed edges). Channel ids come from
         a dedicated counter: exchange creation points are SPMD-
-        deterministic, so ids align across workers."""
+        deterministic, so ids align across workers.
+
+        Two scatter paths, same contract as PR 1's columnar nodes
+        (path="columnar"/"classic" + live row counters): the columnar one
+        derives every shard code in one native pass, partitions in one C
+        pass, consolidates each remote partition before encoding, and
+        punctuates each destination eagerly; the classic row-wise loop is
+        the always-available fallback (PATHWAY_DISABLE_VECTOR_EXCHANGE,
+        no native module, or a routing shape the kernels reject). Both
+        produce the identical consolidated output multiset — emit()
+        re-consolidates the merged batch."""
 
         name = "exchange"
 
@@ -653,37 +973,37 @@ def _make_exchange_node():
             # (worker 0 attaches extra sink nodes)
             self.channel = getattr(engine, "_exchange_channels", 0)
             engine._exchange_channels = self.channel + 1
+            reg = getattr(engine.coord, "metrics", None)
+            self._m_unroutable = (
+                reg.counter(
+                    "pathway_exchange_unroutable_rows",
+                    help="rows whose routing value could not be hashed "
+                    "(routed to worker 0)",
+                ).labels()
+                if reg is not None
+                else None
+            )
+
+        def _note_unroutable(self, n: int) -> None:
+            if self._m_unroutable is not None:
+                self._m_unroutable.inc(n)
+            eng = self.engine
+            if not getattr(eng, "_unroutable_logged", False):
+                eng._unroutable_logged = True
+                logger.warning(
+                    "exchange: %d row(s) with unhashable routing values "
+                    "routed to worker 0 (see "
+                    "pathway_exchange_unroutable_rows; logged once per run)",
+                    n,
+                )
 
         def process(self, time: int) -> None:
             deltas = self.take(0)
             coord = self.engine.coord
-            w_count = coord.worker_count
-            me = coord.worker_id
-            parts: List[list] = [[] for _ in range(w_count)]
             if deltas:
-                if self.route_fn is None:
-                    # broadcast: every worker receives every delta
-                    # (reference: timely Broadcast, used for threshold /
-                    # index streams every worker must see in full)
-                    for w in range(w_count):
-                        parts[w] = list(deltas)
-                else:
-                    keys = [d[0] for d in deltas]
-                    rows = ([d[1] for d in deltas],)
-                    shards = self.route_fn(keys, rows)
-                    for d, sh in zip(deltas, shards):
-                        parts[sh % w_count].append(d)
-            for w in range(w_count):
-                if w != me and parts[w]:
-                    # chunked sends bound peak frame/socket buffers on
-                    # bulk-ingest batches (a single million-row message
-                    # costs hundreds of MB on both ends)
-                    part = parts[w]
-                    for s in range(0, len(part), 65536):
-                        coord.send_data(
-                            w, self.channel, time, part[s : s + 65536]
-                        )
-            coord.punctuate(self.channel, time)
+                self.rows_processed += len(deltas)
+                self.batches_processed += 1
+            own = self._scatter(deltas, coord, time)
             received = coord.collect(self.channel, time)
             # deterministic merge without a per-row sort: received deltas
             # arrive concatenated in sender-id order (each sender's local
@@ -691,7 +1011,138 @@ def _make_exchange_node():
             # same convention on every run.  Per-key retraction-before-
             # insertion within the merged batch is restored by emit()'s
             # consolidation.
-            self.emit(time, received + parts[me])
+            self.emit(time, received + own)
+
+        def _send_chunked(self, coord, w: int, time: int, part: list) -> None:
+            for s in range(0, len(part), _CHUNK):
+                coord.send_data(w, self.channel, time, part[s : s + _CHUNK])
+
+        def _scatter(self, deltas, coord, time: int) -> list:
+            """Route the batch, ship every remote partition, punctuate.
+            Returns the partition this worker keeps for itself."""
+            w_count = coord.worker_count
+            me = coord.worker_id
+            if not deltas:
+                coord.punctuate(self.channel, time)
+                return []
+            if self.route_fn is None:
+                # broadcast: every worker receives every delta (reference:
+                # timely Broadcast, used for threshold / index streams
+                # every worker must see in full)
+                if VECTOR_EXCHANGE_ENABLED:
+                    self.path = "columnar"
+                    for s in range(0, len(deltas), _CHUNK):
+                        coord.broadcast_data(
+                            self.channel, time, deltas[s : s + _CHUNK]
+                        )
+                    for w in range(w_count):
+                        if w != me:
+                            coord.punctuate_one(w, self.channel, time)
+                else:
+                    self.path = "classic"
+                    for w in range(w_count):
+                        if w != me:
+                            self._send_chunked(coord, w, time, list(deltas))
+                    coord.punctuate(self.channel, time)
+                return list(deltas)
+            parts = (
+                self._partition_columnar(deltas, w_count)
+                if VECTOR_EXCHANGE_ENABLED
+                else None
+            )
+            if parts is None:
+                self.path = "classic"
+                route = self.route_fn
+                keys = [d[0] for d in deltas]
+                rows = ([d[1] for d in deltas],)
+                codes = (
+                    route.codes(keys, rows, self._note_unroutable)
+                    if isinstance(route, _Route)
+                    else route(keys, rows)
+                )
+                parts = [[] for _ in range(w_count)]
+                for d, sh in zip(deltas, codes):
+                    parts[sh % w_count].append(d)
+                for w in range(w_count):
+                    if w != me and parts[w]:
+                        self._send_chunked(coord, w, time, parts[w])
+                coord.punctuate(self.channel, time)
+                return parts[me]
+            self.path = "columnar"
+            for w in range(w_count):
+                if w == me:
+                    continue
+                part = parts[w]
+                if part:
+                    # sender-side consolidation: insert/retract pairs that
+                    # cancel within the tick never hit the socket. Only
+                    # worth a pass when bytes actually hit one (local
+                    # handoffs are list appends) AND the batch carries a
+                    # retraction — on an insert-only stream the dict pass
+                    # can cancel nothing (per-row keys keep duplicates
+                    # apart). emit() consolidates the merged batch on the
+                    # receiver either way, so sink output is byte-identical.
+                    if coord.is_remote(w) and any(
+                        d[2] < 0 for d in part
+                    ):
+                        part = consolidate(part)
+                    self._send_chunked(coord, w, time, part)
+                # eager punctuation: dest w's collect() can unblock as
+                # soon as ITS partition is on the wire (the per-peer FIFO
+                # keeps data before punct), not after our full fan-out
+                coord.punctuate_one(w, self.channel, time)
+            return parts[me]
+
+        def _partition_columnar(self, deltas, w_count: int):
+            """Per-worker delta slabs via the native kernels: all shard
+            codes in one pass, partitioning (with the % w_count fused in)
+            in another. None when ineligible — no native module, a
+            non-declarative route, or a shape the kernels reject — which
+            sends the batch down the classic row-wise path."""
+            kernels = shard_kernels()
+            route = self.route_fn
+            if kernels is None or not isinstance(route, _Route):
+                return None
+            pointer_shards, ref_shards, partition_deltas = kernels
+            try:
+                if route.kind == "worker":
+                    parts: List[list] = [[] for _ in range(w_count)]
+                    parts[route.worker % w_count] = list(deltas)
+                    return parts
+                if route.kind == "key":
+                    shards = pointer_shards([d[0] for d in deltas])
+                else:  # "value"
+                    values = route.value_fn(
+                        [d[0] for d in deltas], ([d[1] for d in deltas],)
+                    )
+                    if not isinstance(values, list):
+                        values = list(values)
+                    shards, unresolved = ref_shards(values)
+                    if unresolved:
+                        shards = self._patch_unresolved(
+                            values, shards, unresolved
+                        )
+                return partition_deltas(deltas, shards, w_count)
+            except TypeError:
+                # e.g. non-Pointer keys: the classic path handles them
+                return None
+
+        def _patch_unresolved(self, values, shards, unresolved) -> bytes:
+            """Fill in shard codes the native kernel would not derive
+            (containers, ndarrays, oversized scalars) via the python
+            routing — including the unroutable-to-worker-0 convention."""
+            shards = bytearray(shards)
+            n_bad = 0
+            for i in unresolved:
+                try:
+                    code = ref_scalar(values[i]).shard
+                except Exception:  # noqa: BLE001 — unhashable: worker 0
+                    code = 0
+                    n_bad += 1
+                shards[2 * i : 2 * i + 2] = code.to_bytes(2, "little")
+            if n_bad:
+                self._note_unroutable(n_bad)
+            return bytes(shards)
 
     return _ExchangeNode
 
@@ -718,32 +1169,15 @@ def exchange_broadcast(engine, node):
 def exchange_by_key(engine, node):
     """Partition by row-key shard — the standing table invariant:
     owner(row) = key.shard % worker_count."""
-
-    def route(keys, rows):
-        return [k.shard for k in keys]
-
-    return _exchange(engine, node, route)
+    return _exchange(engine, node, _Route("key"))
 
 
 def exchange_by_value(engine, node, value_fn):
     """Partition by the stable hash of a computed per-row value (join keys,
-    instances). value_fn(keys, rows) -> one routing value per row."""
-    from pathway_tpu.engine.value import Pointer, ref_scalar
-
-    def route(keys, rows):
-        values = value_fn(keys, rows)
-        out = []
-        for v in values:
-            if isinstance(v, Pointer):
-                out.append(v.shard)
-            else:
-                try:
-                    out.append(ref_scalar(v).shard)
-                except Exception:  # noqa: BLE001 — unhashable: worker 0
-                    out.append(0)
-        return out
-
-    return _exchange(engine, node, route)
+    instances). value_fn(keys, rows) -> one routing value per row.
+    Unhashable routing values go to worker 0 — counted in the
+    pathway_exchange_unroutable_rows metric and logged once per run."""
+    return _exchange(engine, node, _Route("value", value_fn=value_fn))
 
 
 def exchange_to_worker(engine, node, worker: int = 0):
@@ -758,11 +1192,7 @@ def exchange_to_worker(engine, node, worker: int = 0):
     key = (id(node), worker)
     if key in memo:
         return memo[key]
-
-    def route(keys, rows):
-        return [worker] * len(keys)
-
-    out = _exchange(engine, node, route)
+    out = _exchange(engine, node, _Route("worker", worker=worker))
     memo[key] = out
     return out
 
@@ -790,4 +1220,10 @@ def global_coordinator() -> Coordinator:
     global _global_coord
     if _global_coord is None:
         _global_coord = coordinator_from_config()
+        if isinstance(_global_coord, TcpCoordinator):
+            # flush writer queues before the interpreter tears down the
+            # daemon send threads — peers may still be reading
+            import atexit
+
+            atexit.register(_global_coord.close)
     return _global_coord

@@ -655,6 +655,57 @@ def join_delta_side_native():
     return _fast_delta_side
 
 
+_fast_shards = None
+_fast_shards_checked = False
+
+
+def shard_kernels():
+    """Native exchange-routing kernels as a
+    (pointer_shards, ref_shards, partition_deltas) triple, or None.
+    Verified once against the python routing before use: bulk u16 shard
+    codes from Pointer keys, ref_scalar(v).shard for scalar values (with
+    an unresolved-index escape for types the kernel does not cover), and
+    the single-pass delta partitioner."""
+    global _fast_shards, _fast_shards_checked
+    if _fast_shards_checked:
+        return _fast_shards
+    _fast_shards_checked = True
+    try:
+        from pathway_tpu import native
+
+        ext = native.load_wire_ext()
+        if ext is None or not hasattr(ext, "partition_deltas"):
+            return None
+        keys = [Pointer(0xBEEF), Pointer(2**100 + 7), ref_scalar("probe")]
+        if ext.pointer_shards(keys) != b"".join(
+            k.shard.to_bytes(2, "little") for k in keys
+        ):
+            return None
+        vals = [None, True, -3, 2.5, 4.0, "probe", b"probe", keys[2], (1, 2)]
+        shards, unresolved = ext.ref_shards(vals)
+        if list(unresolved) != [8]:
+            return None
+        for i, v in enumerate(vals[:-1]):
+            want = v.shard if isinstance(v, Pointer) else ref_scalar(v).shard
+            if int.from_bytes(shards[2 * i : 2 * i + 2], "little") != want:
+                return None
+        deltas = [(k, (i,), 1) for i, k in enumerate(keys)]
+        want_parts: list = [[], []]
+        for d, k in zip(deltas, keys):
+            want_parts[k.shard % 2].append(d)
+        codes = b"".join(k.shard.to_bytes(2, "little") for k in keys)
+        if ext.partition_deltas(deltas, codes, 2) != want_parts:
+            return None
+        _fast_shards = (
+            ext.pointer_shards,
+            ext.ref_shards,
+            ext.partition_deltas,
+        )
+    except Exception:  # noqa: BLE001 — python routing always works
+        _fast_shards = None
+    return _fast_shards
+
+
 def seq_key_seed(*name_parts: Any) -> int:
     """Per-source seed for seq_key (one blake2b at source setup)."""
     return hash_values(*name_parts)

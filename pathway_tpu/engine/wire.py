@@ -605,3 +605,17 @@ def decode_message(blob: bytes) -> tuple:
         except RecursionError:
             raise WireError("frame nesting exhausted the decoder") from None
     return py_decode_message(blob)
+
+
+_frame_len = struct.Struct("!I")
+
+
+def encode_frame(msg: tuple) -> bytes:
+    """The full length-prefixed wire frame for `msg` in one buffer — the
+    native path reserves the 4-byte length slot up front and patches it
+    after the body lands, avoiding the `pack(n) + blob` concat copy."""
+    ext = _load_native()
+    if ext is not None and hasattr(ext, "encode_frame"):
+        return ext.encode_frame(msg)
+    blob = encode_message(msg)
+    return _frame_len.pack(len(blob)) + blob

@@ -13,6 +13,7 @@
 #include <Python.h>
 #include <structmember.h>
 
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -643,54 +644,83 @@ PyObject *py_register_types(PyObject *, PyObject *args) {
   Py_RETURN_NONE;
 }
 
-PyObject *py_encode_message(PyObject *, PyObject *arg) {
+// Message-body encoder shared by encode_message (bare blob) and
+// encode_frame (length-prefixed); appends to whatever `out` already holds.
+bool encode_message_body(Buf &out, PyObject *arg) {
   if (!PyTuple_Check(arg) || PyTuple_GET_SIZE(arg) < 1) {
     wire_err("message must be a tuple");
-    return nullptr;
+    return false;
   }
   PyObject *kind = PyTuple_GET_ITEM(arg, 0);
   const char *k = PyUnicode_AsUTF8(kind);
-  if (!k) return nullptr;
-  Buf out;
+  if (!k) return false;
   if (std::strcmp(k, "data") == 0 && PyTuple_GET_SIZE(arg) == 4) {
     out.put(MSG_DATA);
     long channel = PyLong_AsLong(PyTuple_GET_ITEM(arg, 1));
-    if (channel == -1 && PyErr_Occurred()) return nullptr;
+    if (channel == -1 && PyErr_Occurred()) return false;
     out.u32(static_cast<uint32_t>(channel));
     int64_t time = PyLong_AsLongLong(PyTuple_GET_ITEM(arg, 2));
-    if (time == -1 && PyErr_Occurred()) return nullptr;
+    if (time == -1 && PyErr_Occurred()) return false;
     out.zigzag(time);
-    if (!encode_deltas(out, PyTuple_GET_ITEM(arg, 3))) return nullptr;
+    if (!encode_deltas(out, PyTuple_GET_ITEM(arg, 3))) return false;
   } else if (std::strcmp(k, "punct") == 0 && PyTuple_GET_SIZE(arg) == 3) {
     out.put(MSG_PUNCT);
     long channel = PyLong_AsLong(PyTuple_GET_ITEM(arg, 1));
-    if (channel == -1 && PyErr_Occurred()) return nullptr;
+    if (channel == -1 && PyErr_Occurred()) return false;
     out.u32(static_cast<uint32_t>(channel));
     int64_t time = PyLong_AsLongLong(PyTuple_GET_ITEM(arg, 2));
-    if (time == -1 && PyErr_Occurred()) return nullptr;
+    if (time == -1 && PyErr_Occurred()) return false;
     out.zigzag(time);
   } else if (std::strcmp(k, "coord") == 0 && PyTuple_GET_SIZE(arg) == 3) {
     out.put(MSG_COORD);
     uint64_t round_no =
         PyLong_AsUnsignedLongLong(PyTuple_GET_ITEM(arg, 1));
-    if (PyErr_Occurred()) return nullptr;
+    if (PyErr_Occurred()) return false;
     out.u64(round_no);
-    if (!encode_value(out, PyTuple_GET_ITEM(arg, 2))) return nullptr;
+    if (!encode_value(out, PyTuple_GET_ITEM(arg, 2))) return false;
   } else if (std::strcmp(k, "hello") == 0 && PyTuple_GET_SIZE(arg) == 3) {
     out.put(MSG_HELLO);
     long worker = PyLong_AsLong(PyTuple_GET_ITEM(arg, 1));
-    if (worker == -1 && PyErr_Occurred()) return nullptr;
+    if (worker == -1 && PyErr_Occurred()) return false;
     out.u32(static_cast<uint32_t>(worker));
     Py_ssize_t n;
     const char *run_id =
         PyUnicode_AsUTF8AndSize(PyTuple_GET_ITEM(arg, 2), &n);
-    if (!run_id) return nullptr;
+    if (!run_id) return false;
     out.uvarint(static_cast<uint64_t>(n));
     out.put_raw(run_id, static_cast<size_t>(n));
   } else {
     wire_err("unknown message kind");
+    return false;
+  }
+  return true;
+}
+
+PyObject *py_encode_message(PyObject *, PyObject *arg) {
+  Buf out;
+  if (!encode_message_body(out, arg)) return nullptr;
+  return PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(out.d.data()),
+      static_cast<Py_ssize_t>(out.d.size()));
+}
+
+// encode_frame(msg) -> the full length-prefixed wire frame in one pass:
+// the 4-byte big-endian length slot is reserved up front and patched
+// after the body lands, so there is no `_LEN.pack(n) + blob` concat copy.
+PyObject *py_encode_frame(PyObject *, PyObject *arg) {
+  Buf out;
+  out.d.resize(4);
+  if (!encode_message_body(out, arg)) return nullptr;
+  size_t body = out.d.size() - 4;
+  if (body > 0xFFFFFFFFu) {
+    wire_err("frame too large");
     return nullptr;
   }
+  uint32_t n = static_cast<uint32_t>(body);
+  out.d[0] = static_cast<uint8_t>(n >> 24);
+  out.d[1] = static_cast<uint8_t>(n >> 16);
+  out.d[2] = static_cast<uint8_t>(n >> 8);
+  out.d[3] = static_cast<uint8_t>(n);
   return PyBytes_FromStringAndSize(
       reinterpret_cast<const char *>(out.d.data()),
       static_cast<Py_ssize_t>(out.d.size()));
@@ -1703,6 +1733,211 @@ fail:
   return nullptr;
 }
 
+// -- columnar exchange routing ----------------------------------------------
+//
+// The exchange node's shard codes in bulk: pointer_shards reads the low
+// 16 bits of every key's value slot in one C pass; ref_shards computes
+// ref_scalar(v).shard for the common scalar types by serializing each
+// value exactly as value._serialize_for_hash does and taking the first
+// two digest bytes of the single-block blake2b-128 (the low 16 bits of
+// the little-endian digest int). Types the kernel does not cover come
+// back as "unresolved" indices for the Python caller to patch — so the
+// kernel can never silently diverge from the Python routing.
+
+// pointer_shards(keys: list[Pointer]) -> bytes (n x u16 LE shard codes)
+PyObject *py_pointer_shards(PyObject *, PyObject *arg) {
+  if (!PyList_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "pointer_shards expects a list");
+    return nullptr;
+  }
+  PointerSlots slots;
+  if (!slots.resolve()) return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(arg);
+  PyObject *out = PyBytes_FromStringAndSize(nullptr, n * 2);
+  if (!out) return nullptr;
+  uint8_t *dst = reinterpret_cast<uint8_t *>(PyBytes_AS_STRING(out));
+  uint8_t raw[16];
+  for (Py_ssize_t i = 0; i < n; i++) {
+    if (!ptr_value_le16(PyList_GET_ITEM(arg, i), slots, raw)) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    dst[2 * i] = raw[0];
+    dst[2 * i + 1] = raw[1];
+  }
+  return out;
+}
+
+// ref_shards(values: list) -> (bytes n x u16 LE, list[int] unresolved)
+PyObject *py_ref_shards(PyObject *, PyObject *arg) {
+  if (!PyList_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "ref_shards expects a list");
+    return nullptr;
+  }
+  PointerSlots slots;
+  if (!slots.resolve()) return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(arg);
+  PyObject *shards = PyBytes_FromStringAndSize(nullptr, n * 2);
+  if (!shards) return nullptr;
+  PyObject *unresolved = PyList_New(0);
+  if (!unresolved) {
+    Py_DECREF(shards);
+    return nullptr;
+  }
+  uint8_t *dst = reinterpret_cast<uint8_t *>(PyBytes_AS_STRING(shards));
+  uint8_t msg[128];
+  uint8_t dig[16];
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *v = PyList_GET_ITEM(arg, i);
+    size_t len = 0;
+    bool ok = true;
+    bool hashed = true;
+    uint16_t code = 0;
+    if (Py_TYPE(v) == slots.tp) {
+      // a Pointer routes by its own shard bits, no rehash
+      uint8_t raw[16];
+      if (ptr_value_le16(v, slots, raw)) {
+        code = static_cast<uint16_t>(raw[0] | (raw[1] << 8));
+        hashed = false;
+      } else {
+        PyErr_Clear();
+        ok = false;
+      }
+    } else if (v == Py_None) {
+      msg[0] = 0x00;
+      msg[1] = 'N';
+      len = 2;
+    } else if (PyBool_Check(v)) {
+      msg[0] = 0x01;
+      msg[1] = (v == Py_True) ? 0x01 : 0x00;
+      len = 2;
+    } else if (PyLong_CheckExact(v)) {
+      msg[0] = 0x02;
+      if (_PyLong_AsByteArray(reinterpret_cast<PyLongObject *>(v), msg + 1,
+                              16, 1, 1) != 0) {
+        PyErr_Clear();  // >128-bit int: Python path raises -> unroutable
+        ok = false;
+      } else {
+        len = 17;
+      }
+    } else if (PyFloat_CheckExact(v)) {
+      double d = PyFloat_AS_DOUBLE(v);
+      if (d == std::floor(d) && std::fabs(d) < 4611686018427387904.0) {
+        // integral floats hash as their int (1 == 1.0 for keying)
+        int64_t iv = static_cast<int64_t>(d);
+        uint64_t u = static_cast<uint64_t>(iv);
+        msg[0] = 0x02;
+        std::memcpy(msg + 1, &u, 8);
+        std::memset(msg + 9, iv < 0 ? 0xFF : 0x00, 8);
+        len = 17;
+      } else {
+        msg[0] = 0x03;
+        std::memcpy(msg + 1, &d, 8);
+        len = 9;
+      }
+    } else if (PyUnicode_CheckExact(v)) {
+      Py_ssize_t sl;
+      const char *s = PyUnicode_AsUTF8AndSize(v, &sl);
+      if (!s) {
+        PyErr_Clear();
+        ok = false;
+      } else if (sl <= 119) {  // 1 + 8 + len must fit one blake2b block
+        uint64_t L = static_cast<uint64_t>(sl);
+        msg[0] = 0x04;
+        std::memcpy(msg + 1, &L, 8);
+        std::memcpy(msg + 9, s, static_cast<size_t>(sl));
+        len = 9 + static_cast<size_t>(sl);
+      } else {
+        ok = false;
+      }
+    } else if (PyBytes_CheckExact(v)) {
+      Py_ssize_t bl = PyBytes_GET_SIZE(v);
+      if (bl <= 119) {
+        uint64_t L = static_cast<uint64_t>(bl);
+        msg[0] = 0x05;
+        std::memcpy(msg + 1, &L, 8);
+        std::memcpy(msg + 9, PyBytes_AS_STRING(v), static_cast<size_t>(bl));
+        len = 9 + static_cast<size_t>(bl);
+      } else {
+        ok = false;
+      }
+    } else {
+      ok = false;  // containers, ndarrays, subclasses: Python fallback
+    }
+    if (ok && hashed) {
+      blake2b128_single(msg, len, dig);
+      code = static_cast<uint16_t>(dig[0] | (dig[1] << 8));
+    }
+    if (!ok) {
+      code = 0;
+      PyObject *idx = PyLong_FromSsize_t(i);
+      if (!idx || PyList_Append(unresolved, idx) < 0) {
+        Py_XDECREF(idx);
+        Py_DECREF(shards);
+        Py_DECREF(unresolved);
+        return nullptr;
+      }
+      Py_DECREF(idx);
+    }
+    dst[2 * i] = static_cast<uint8_t>(code & 0xFF);
+    dst[2 * i + 1] = static_cast<uint8_t>(code >> 8);
+  }
+  return Py_BuildValue("(NN)", shards, unresolved);
+}
+
+// partition_deltas(deltas: list, shards: bytes n x u16 LE, nparts: int)
+//   -> list of nparts lists
+//
+// Single C pass replacing the per-row `parts[shard % n].append(d)` loop:
+// count, allocate each partition exactly-sized, fill. Order within each
+// partition preserves stream order.
+PyObject *py_partition_deltas(PyObject *, PyObject *args) {
+  PyObject *deltas;
+  Py_buffer shards;
+  Py_ssize_t nparts;
+  if (!PyArg_ParseTuple(args, "O!y*n", &PyList_Type, &deltas, &shards,
+                        &nparts))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(deltas);
+  if (shards.len != n * 2 || nparts <= 0) {
+    PyBuffer_Release(&shards);
+    PyErr_SetString(PyExc_ValueError,
+                    "shards must be 2*len(deltas) bytes, nparts > 0");
+    return nullptr;
+  }
+  const uint8_t *sp = static_cast<const uint8_t *>(shards.buf);
+  std::vector<Py_ssize_t> counts(static_cast<size_t>(nparts), 0);
+  std::vector<uint32_t> part_of(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; i++) {
+    uint32_t code = static_cast<uint32_t>(sp[2 * i] | (sp[2 * i + 1] << 8));
+    uint32_t p = code % static_cast<uint32_t>(nparts);
+    part_of[i] = p;
+    counts[p]++;
+  }
+  PyObject *out = PyList_New(nparts);
+  if (!out) {
+    PyBuffer_Release(&shards);
+    return nullptr;
+  }
+  for (Py_ssize_t p = 0; p < nparts; p++) {
+    PyObject *part = PyList_New(counts[p]);
+    if (!part) {
+      PyBuffer_Release(&shards);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, p, part);
+  }
+  std::vector<Py_ssize_t> fill(static_cast<size_t>(nparts), 0);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *d = PyList_GET_ITEM(deltas, i);
+    Py_INCREF(d);
+    PyList_SET_ITEM(PyList_GET_ITEM(out, part_of[i]), fill[part_of[i]]++, d);
+  }
+  PyBuffer_Release(&shards);
+  return out;
+}
+
 PyMethodDef methods[] = {
     {"make_seq_pointers", py_make_seq_pointers, METH_VARARGS,
      "bulk-construct Pointer objects from (hi64, u64-LE bytes)"},
@@ -1727,6 +1962,16 @@ PyMethodDef methods[] = {
      "register engine value classes and rare-type helpers"},
     {"encode_message", py_encode_message, METH_O,
      "encode an exchange message tuple to bytes"},
+    {"encode_frame", py_encode_frame, METH_O,
+     "encode an exchange message tuple to a length-prefixed wire frame"},
+    {"pointer_shards", py_pointer_shards, METH_O,
+     "bulk shard codes (u16 LE bytes) from a list of Pointer keys"},
+    {"ref_shards", py_ref_shards, METH_O,
+     "bulk ref_scalar(v).shard codes for scalar values; returns "
+     "(u16 LE bytes, unresolved index list)"},
+    {"partition_deltas", py_partition_deltas, METH_VARARGS,
+     "partition a delta list into nparts lists by shard % nparts in one "
+     "C pass"},
     {"decode_message", py_decode_message, METH_O,
      "decode bytes to an exchange message tuple"},
     {"consolidate", py_consolidate, METH_O,

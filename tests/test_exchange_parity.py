@@ -1,0 +1,234 @@
+"""Exchange-path parity: the columnar scatter (vectorized routing +
+sender-side consolidation + fused frames) must produce EXACTLY the same
+consolidated sink output as the classic row-wise path, on both transports
+(in-process thread workers and the TCP process mesh), with every channel
+kind in play — keyed shuffle (groupby), broadcast (gradual_broadcast's
+threshold table), and gather (subscribe onto worker 0).
+
+Also pins the ordering guarantee the columnar path leans on: per-worker
+part files are byte-identical run to run, because collect() merges in
+sender-id order (each sender's local order is SPMD-deterministic), so the
+output cannot depend on which peer's frames happened to arrive first.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from _fakes import free_port_base
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SEED = 20260805
+
+# randomized input with mid-stream retractions (same primary key -> same
+# pointer, so sender-side consolidation has pairs to cancel), shuffled by
+# key, broadcast against a tiny threshold table, gathered via subscribe
+PIPELINE = textwrap.dedent(
+    """
+    import json
+    import random
+    import sys
+
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_markdown, table_from_rows
+
+    out_dir, seed = sys.argv[1], int(sys.argv[2])
+    rng = random.Random(seed)
+
+    class S(pw.Schema, primary_key=["id"]):
+        id: int
+        k: int
+        v: int
+
+    rows = []
+    live = []
+    t = 2
+    for i in range(400):
+        k, v = rng.randrange(12), rng.randrange(50)
+        rows.append((i, k, v, t, 1))
+        live.append((i, k, v))
+        if live and rng.random() < 0.25:
+            rid, rk, rv = live.pop(rng.randrange(len(live)))
+            rows.append((rid, rk, rv, t + 2, -1))
+        if rng.random() < 0.15:
+            t += 2
+
+    tab = table_from_rows(S, rows, is_stream=True)
+
+    # keyed shuffle: every row crosses the exchange to its group owner
+    grouped = tab.groupby(pw.this.k).reduce(
+        pw.this.k,
+        total=pw.reducers.sum(pw.this.v),
+        n=pw.reducers.count(),
+    )
+    pw.io.fs.write(grouped, out_dir + "/grouped.jsonl", format="json")
+
+    # broadcast channel: the tiny threshold table is replicated to every
+    # worker (engine/operators.py gradual_broadcast)
+    thr = table_from_markdown(
+        '''
+        lower | value | upper
+        0.0   | 0.5   | 1.0
+        '''
+    )
+    apx = tab._gradual_broadcast(thr, thr.lower, thr.value, thr.upper)
+    flagged = apx.select(pw.this.k, hi=pw.this.apx_value >= 0.5)
+    pw.io.fs.write(flagged, out_dir + "/flagged.jsonl", format="json")
+
+    # gather channel: subscribe with on_worker=0 pulls the full stream
+    # onto worker 0 via exchange_to_worker (without it callbacks fire
+    # per-shard on every worker and nothing crosses the exchange)
+    got = []
+    pw.io.subscribe(
+        grouped,
+        on_change=lambda key, row, time, is_addition: got.append(
+            (row["k"], row["total"], row["n"], 1 if is_addition else -1)
+        ),
+        on_worker=0,
+    )
+
+    pw.run(monitoring_level=None)
+
+    from pathway_tpu.internals.runner import last_engine
+
+    eng = last_engine()
+    if eng is not None and eng.worker_id == 0:
+        counts = {}
+        for k, total, n, diff in got:
+            key = (k, total, n)
+            counts[key] = counts.get(key, 0) + diff
+        final = sorted([k, t, n] for (k, t, n), c in counts.items()
+                       for _ in range(c))
+        with open(out_dir + "/subscribed.json", "w") as fh:
+            json.dump(final, fh)
+    """
+)
+
+
+def _final_rows(events: list[dict], keys: list[str]) -> dict:
+    counts: dict = {}
+    for e in events:
+        key = tuple(e[c] for c in keys)
+        counts[key] = counts.get(key, 0) + e["diff"]
+    return {k: c for k, c in counts.items() if c != 0}
+
+
+def _read_parts(out_dir: Path, name: str) -> list[dict]:
+    rows = []
+    for f in sorted(out_dir.glob(f"{name}*")):
+        for line in f.read_text().splitlines():
+            if line.strip():
+                rows.append(json.loads(line))
+    return rows
+
+
+def _run_config(
+    tmp_path: Path,
+    label: str,
+    *,
+    processes: int = 1,
+    threads: int = 1,
+    extra_env: dict | None = None,
+) -> Path:
+    """Run PIPELINE under one worker topology; returns its output dir."""
+    out_dir = tmp_path / label
+    out_dir.mkdir()
+    script = tmp_path / "pipeline.py"
+    if not script.exists():
+        script.write_text(PIPELINE)
+    base_env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    base_env.update(extra_env or {})
+    procs = []
+    base = free_port_base(processes) if processes > 1 else 0
+    for wid in range(processes):
+        env = dict(base_env)
+        if processes > 1:
+            env.update(
+                PATHWAY_PROCESSES=str(processes),
+                PATHWAY_PROCESS_ID=str(wid),
+                PATHWAY_FIRST_PORT=str(base),
+            )
+        if threads > 1:
+            env["PATHWAY_THREADS"] = str(threads)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script), str(out_dir), str(SEED)],
+                env=env,
+                cwd=tmp_path,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+    for wid, p in enumerate(procs):
+        out, err = p.communicate(timeout=180)
+        assert p.returncode == 0, (
+            f"{label} worker {wid} rc={p.returncode}\n{err.decode()[-2000:]}"
+        )
+    return out_dir
+
+
+def _outputs(out_dir: Path) -> tuple[dict, dict, list]:
+    grouped = _final_rows(
+        _read_parts(out_dir, "grouped.jsonl"), ["k", "total", "n"]
+    )
+    flagged = _final_rows(_read_parts(out_dir, "flagged.jsonl"), ["k", "hi"])
+    subscribed = json.loads((out_dir / "subscribed.json").read_text())
+    return grouped, flagged, subscribed
+
+
+CONFIGS = [
+    # (label, processes, threads, extra_env)
+    ("thread_columnar", 1, 2, {}),
+    ("thread_classic", 1, 2, {"PATHWAY_DISABLE_VECTOR_EXCHANGE": "1"}),
+    ("tcp_columnar", 2, 1, {"PATHWAY_EXCHANGE_WRITERS": "1"}),
+    ("tcp_classic", 2, 1, {"PATHWAY_DISABLE_VECTOR_EXCHANGE": "1"}),
+    # mixed topology: 2 processes x 2 threads, overlapped sends forced on
+    ("grid_columnar", 2, 2, {"PATHWAY_EXCHANGE_WRITERS": "1"}),
+]
+
+
+@pytest.mark.parametrize("n_workers", [1])
+def test_columnar_classic_parity_all_transports(n_workers, tmp_path):
+    """Same seed, five topologies x two scatter paths: the consolidated
+    output of every sink (sharded jsonl, broadcast-derived jsonl, and the
+    worker-0 subscribe gather) must be identical everywhere — including a
+    single-worker run, which has no exchange at all and therefore pins
+    the ground truth."""
+    baseline = _outputs(_run_config(tmp_path, "single", processes=1))
+    for label, processes, threads, extra in CONFIGS:
+        got = _outputs(
+            _run_config(
+                tmp_path, label,
+                processes=processes, threads=threads, extra_env=extra,
+            )
+        )
+        assert got == baseline, f"{label} diverged from single-worker run"
+
+
+def test_columnar_sink_output_deterministic_across_runs(tmp_path):
+    """Two runs of the same TCP columnar config must write byte-identical
+    per-worker part files: collect() concatenates in sender-id order, so
+    reordered peer arrivals cannot leak into sink output."""
+    a = _run_config(
+        tmp_path, "run_a", processes=2,
+        extra_env={"PATHWAY_EXCHANGE_WRITERS": "1"},
+    )
+    b = _run_config(
+        tmp_path, "run_b", processes=2,
+        extra_env={"PATHWAY_EXCHANGE_WRITERS": "1"},
+    )
+    parts_a = sorted(p.name for p in a.iterdir())
+    parts_b = sorted(p.name for p in b.iterdir())
+    assert parts_a == parts_b
+    for name in parts_a:
+        assert (a / name).read_bytes() == (b / name).read_bytes(), (
+            f"part {name} differs between identical runs"
+        )

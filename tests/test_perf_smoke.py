@@ -147,6 +147,44 @@ def test_observability_overhead_under_5pct():
 
 
 @pytest.mark.perf_smoke
+def test_columnar_exchange_selected_on_two_workers(tmp_path):
+    """An eligible keyed shuffle on a 2-thread-worker graph must route
+    through the columnar scatter (vectorized shard codes + C partition
+    pass), proven by the exchange node's own path counter — single-worker
+    runs have no exchange node at all, so this needs a real worker pair."""
+    from pathway_tpu.internals.config import pathway_config
+    from pathway_tpu.internals.runner import last_engine
+
+    old = pathway_config.threads
+    pathway_config.threads = 2
+    try:
+        t = pw.debug.table_from_markdown(
+            """
+            k | v
+            0 | 1
+            1 | 2
+            0 | 3
+            2 | 4
+            1 | 5
+            2 | 6
+            """
+        )
+        grouped = t.groupby(pw.this.k).reduce(
+            pw.this.k, total=pw.reducers.sum(pw.this.v)
+        )
+        pw.io.fs.write(grouped, str(tmp_path / "out.jsonl"), format="json")
+        pw.run(monitoring_level=None)
+    finally:
+        pathway_config.threads = old
+
+    eng = last_engine()
+    stats = _columnar_stats(eng)
+    assert "_ExchangeNode" in stats, node_path_stats(eng)
+    assert stats["_ExchangeNode"]["rows_processed"] > 0
+    assert stats["_ExchangeNode"]["batches_processed"] > 0
+
+
+@pytest.mark.perf_smoke
 def test_ineligible_graphs_stay_classic():
     """The gates must also say no: non-hashable join keys and
     non-vector reducers fall back to classic nodes (path counters show

@@ -98,6 +98,25 @@ def test_native_codec_matches_python_bytes():
         assert _deep_equal(wire.py_decode_message(nat_blob), msg), msg[0]
 
 
+def test_encode_frame_matches_length_prefixed_message():
+    """The fused single-buffer frame encoder (native reserves the 4-byte
+    length slot and patches it in place) must be byte-identical to the
+    classic pack(len) + blob concat for every message kind, so receivers
+    cannot tell which sender path produced a frame."""
+    for msg in _messages():
+        blob = wire.encode_message(msg)
+        expected = wire._frame_len.pack(len(blob)) + blob
+        assert wire.encode_frame(msg) == expected, msg[0]
+
+    ext = native.load_wire_ext()
+    if ext is not None and hasattr(ext, "encode_frame"):
+        for msg in _messages():
+            py_blob = wire.py_encode_message(msg)
+            assert ext.encode_frame(msg) == (
+                wire._frame_len.pack(len(py_blob)) + py_blob
+            ), msg[0]
+
+
 def test_malformed_frames_raise_wire_error():
     ext = native.load_wire_ext()
     rng = random.Random(11)
