@@ -53,18 +53,86 @@ def _free_port_base(n):
     raise RuntimeError("no free ports")
 
 
-def _run_reduce(size, n_updates):
+# ---------------------------------------------------------------------------
+# Graph builders — importable by tests (test_perf_smoke runs the static
+# analyzer over each topology and checks its columnar predictions against
+# the path the engine actually selects).  Every bench below builds its
+# graph through one of these.
+# ---------------------------------------------------------------------------
+
+
+def build_reduce_graph(size, n_updates=0):
+    """One big group + n single-row updates -> count/sum/max reduce."""
     schema = schema_from_types(g=str, v=int)
     events = [(2, (ref_scalar(i), ("g", i), 1)) for i in range(size)]
     for j in range(n_updates):
         events.append((4 + 2 * j, (ref_scalar(size + j), ("g", j), 1)))
     t = table_from_events(schema, events)
-    res = t.groupby(t.g).reduce(
+    return t.groupby(t.g).reduce(
         t.g,
         cnt=pw.reducers.count(),
         total=pw.reducers.sum(t.v),
         mx=pw.reducers.max(t.v),
     )
+
+
+def build_wordcount_graph(n_rows, vocab=10_000, batch=200_000):
+    """Streaming wordcount: source -> groupby(word) -> count."""
+    rng = random.Random(7)
+    words = [f"w{i}" for i in range(vocab)]
+    schema = schema_from_types(word=str)
+    events = []
+    t = 2
+    for i in range(n_rows):
+        events.append((t, (ref_scalar(i), (rng.choice(words),), 1)))
+        if (i + 1) % batch == 0:
+            t += 2
+    tab = table_from_events(schema, events)
+    return tab.groupby(tab.word).reduce(tab.word, cnt=pw.reducers.count())
+
+
+def build_join_graph(n_left, n_right):
+    """Small build side at t=2, one big probe-side batch at t=4 ->
+    inner join -> select."""
+    lschema = schema_from_types(k=int, a=int)
+    rschema = schema_from_types(k=int, b=int)
+    right = table_from_events(
+        rschema,
+        [(2, (ref_scalar("r", i), (i, i * 10), 1)) for i in range(n_right)],
+    )
+    left = table_from_events(
+        lschema,
+        [
+            (4, (ref_scalar("l", i), (i % n_right, i), 1))
+            for i in range(n_left)
+        ],
+    )
+    return left.join(right, left.k == right.k).select(pw.left.a, pw.right.b)
+
+
+def build_flatten_graph(n_rows, width=4):
+    """Rows with `width`-element lists -> flatten."""
+    schema = schema_from_types(i=int, vs=list)
+    t = table_from_events(
+        schema,
+        [
+            (2, (ref_scalar("b", i), (i, [i, i + 1, i + 2, i + 3][:width]), 1))
+            for i in range(n_rows)
+        ],
+    )
+    return t.flatten(pw.this.vs)
+
+
+GRAPH_BUILDERS = {
+    "reduce": lambda: build_reduce_graph(64, 4),
+    "wordcount": lambda: build_wordcount_graph(256, vocab=32, batch=64),
+    "join": lambda: build_join_graph(128, 16),
+    "flatten": lambda: build_flatten_graph(64),
+}
+
+
+def _run_reduce(size, n_updates):
+    res = build_reduce_graph(size, n_updates)
     t0 = _time.perf_counter()
     (capture,) = run_tables(res, record_stream=True)
     elapsed = _time.perf_counter() - t0
@@ -100,17 +168,7 @@ def bench_wordcount(n_rows=5_000_000, vocab=10_000, batch=200_000):
     harness scale (reference: integration_tests/wordcount/base.py:19
     DEFAULT_INPUT_SIZE).  Batch size mirrors what a 100 ms autocommit
     produces at this throughput."""
-    rng = random.Random(7)
-    words = [f"w{i}" for i in range(vocab)]
-    schema = schema_from_types(word=str)
-    events = []
-    t = 2
-    for i in range(n_rows):
-        events.append((t, (ref_scalar(i), (rng.choice(words),), 1)))
-        if (i + 1) % batch == 0:
-            t += 2
-    tab = table_from_events(schema, events)
-    res = tab.groupby(tab.word).reduce(tab.word, cnt=pw.reducers.count())
+    res = build_wordcount_graph(n_rows, vocab=vocab, batch=batch)
     t0 = _time.perf_counter()
     (capture,) = run_tables(res, record_stream=True)
     elapsed = _time.perf_counter() - t0
@@ -172,21 +230,8 @@ def bench_join_columnar(n_left=100_000, n_right=1_000):
     lookup + match expansion + bucket update) is the measured kernel."""
     from pathway_tpu.engine import vector_join
 
-    lschema = schema_from_types(k=int, a=int)
-    rschema = schema_from_types(k=int, b=int)
-    right_events = [
-        (2, (ref_scalar("r", i), (i, i * 10), 1)) for i in range(n_right)
-    ]
-    left_events = [
-        (4, (ref_scalar("l", i), (i % n_right, i), 1)) for i in range(n_left)
-    ]
-
     def build():
-        left = table_from_events(lschema, list(left_events))
-        right = table_from_events(rschema, list(right_events))
-        return left.join(right, left.k == right.k).select(
-            pw.left.a, pw.right.b
-        )
+        return build_join_graph(n_left, n_right)
 
     secs = _ab_columnar(
         build,
@@ -214,15 +259,8 @@ def bench_flatten_columnar(n_rows=100_000, width=4):
     mixer + fused triple assembly vs per-element Python."""
     from pathway_tpu.engine import vector_flatten
 
-    schema = schema_from_types(i=int, vs=list)
-    events = [
-        (2, (ref_scalar("b", i), (i, [i, i + 1, i + 2, i + 3][:width]), 1))
-        for i in range(n_rows)
-    ]
-
     def build():
-        t = table_from_events(schema, list(events))
-        return t.flatten(pw.this.vs)
+        return build_flatten_graph(n_rows, width)
 
     secs = _ab_columnar(
         build,

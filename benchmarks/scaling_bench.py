@@ -79,6 +79,36 @@ _PIPELINE = textwrap.dedent(
 )
 
 
+def build_wordcount_graph(
+    in_dir: str, out_path: str, mode: str = "static", n_workers: int = 1
+):
+    """Build the exact graph _PIPELINE runs, without executing it.
+
+    Importable so the static analyzer (pathway-tpu analyze /
+    tests/test_perf_smoke.py) can lint the benchmark topology: fs json
+    read -> groupby(word).count -> csv write.  Returns the reduced
+    table; the csv write registers the sink on the parse graph."""
+    import pathway_tpu as pw
+
+    class InputSchema(pw.Schema):
+        word: str
+
+    words = pw.io.fs.read(
+        path=in_dir,
+        schema=InputSchema,
+        format="json",
+        mode=mode,
+        partitioned=mode == "streaming" and n_workers > 1,
+        batch_per_file=mode == "streaming",
+        refresh_interval=3600.0,
+    )
+    result = words.groupby(words.word).reduce(
+        words.word, count=pw.reducers.count()
+    )
+    pw.io.csv.write(result, out_path)
+    return result
+
+
 def generate_input(directory: str, n_rows: int, n_files: int, vocab=10_000):
     rng = random.Random(7)
     words = [f"word{i}" for i in range(vocab)]

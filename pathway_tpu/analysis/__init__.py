@@ -1,0 +1,90 @@
+"""Build-time static analysis of the dataflow graph.
+
+`analyze()` walks the recorded parse graph (see
+`internals/parse_graph.OpSpec`) and returns an `AnalysisResult` of
+structured diagnostics — stable PWT codes, user stack frames, rendered
+expressions — plus per-node columnar-eligibility predictions.
+
+Three surfaces consume it:
+  * `pathway-tpu analyze script.py` (cli.py) — text/JSON, --fail-on for CI
+  * `pw.run(analysis="strict"|"warn"|"off")` (internals/runner.py)
+  * the `/status` observability endpoint (internals/monitoring.py)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+from pathway_tpu.analysis.diagnostics import (
+    CODES,
+    AnalysisResult,
+    Diagnostic,
+    Severity,
+    make_diag,
+)
+from pathway_tpu.analysis.graph import GraphView
+from pathway_tpu.analysis.passes import (
+    columnar_pass,
+    dead_pass,
+    dtype_pass,
+    state_pass,
+    udf_pass,
+    verify_against_plan,
+)
+
+
+class AnalysisError(RuntimeError):
+    """Raised by pw.run(analysis="strict") when the analyzer finds
+    warning-or-worse diagnostics."""
+
+    def __init__(self, result: AnalysisResult):
+        self.result = result
+        super().__init__(
+            "static analysis failed:\n" + result.render_text()
+        )
+
+
+def _worker_count() -> int:
+    from pathway_tpu.internals.config import pathway_config
+
+    threads = getattr(pathway_config, "threads", 1) or 1
+    processes = getattr(pathway_config, "processes", 1) or 1
+    return max(threads, 1) * max(processes, 1)
+
+
+def analyze(
+    graph: Any = None,
+    *,
+    extra_tables: Iterable[Any] = (),
+    workers: Optional[int] = None,
+) -> AnalysisResult:
+    """Run every pass over `graph` (default: the global parse graph).
+
+    `extra_tables` anchors tables that are not registered as sinks (e.g.
+    run_tables captures); `workers` overrides the configured worker
+    count for the exchange-related lints."""
+    if graph is None:
+        from pathway_tpu.internals.parse_graph import G as graph
+    if workers is None:
+        workers = _worker_count()
+    view = GraphView(graph, extra_tables=extra_tables)
+    result = AnalysisResult()
+    dtype_pass(view, result)
+    state_pass(view, result)
+    columnar_pass(view, result, workers=workers)
+    dead_pass(view, result)
+    udf_pass(view, result, workers=workers)
+    return result
+
+
+__all__ = [
+    "AnalysisError",
+    "AnalysisResult",
+    "CODES",
+    "Diagnostic",
+    "GraphView",
+    "Severity",
+    "analyze",
+    "make_diag",
+    "verify_against_plan",
+]

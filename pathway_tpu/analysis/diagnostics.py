@@ -1,0 +1,192 @@
+"""Diagnostic model for the build-time graph analyzer.
+
+Every finding carries a stable code (PWT1xx correctness, PWT2xx
+state/robustness, PWT3xx performance), a severity, a human message, and
+a location: the user stack frame that built the operator when
+`internals/trace.py` found one, otherwise the operator id + graph path —
+synthetic/stdlib-built operators still produce findings, they just point
+at the graph instead of a user line.
+
+The JSON form (`AnalysisResult.to_dict`/`from_dict`) round-trips exactly
+so CI tooling can consume `pathway-tpu analyze --json` output.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class Severity(enum.IntEnum):
+    INFO = 10
+    WARNING = 20
+    ERROR = 30
+
+    def __str__(self) -> str:
+        return self.name.lower()
+
+    @classmethod
+    def parse(cls, name: str) -> "Severity":
+        return cls[name.upper()]
+
+
+# code -> (default severity, short title).  Codes are append-only: once
+# published they keep their meaning, tooling may match on them.
+CODES: Dict[str, tuple] = {
+    # PWT1xx — correctness
+    "PWT101": (Severity.WARNING, "lossy numeric cast"),
+    "PWT102": (Severity.ERROR, "comparison between incompatible dtypes"),
+    "PWT103": (Severity.WARNING, "arithmetic on optional operand"),
+    "PWT110": (Severity.WARNING, "dead subgraph never reaches a sink"),
+    "PWT111": (Severity.INFO, "unused column"),
+    # PWT2xx — state growth / robustness
+    "PWT201": (Severity.WARNING, "temporal operator without behavior"),
+    "PWT202": (Severity.WARNING, "groupby key of unbounded cardinality"),
+    "PWT203": (Severity.WARNING, "iterate without iteration_limit"),
+    # PWT3xx — performance
+    "PWT301": (Severity.INFO, "join falls back to the classic path"),
+    "PWT302": (Severity.WARNING, "unroutable routing dtype on exchange"),
+    "PWT303": (Severity.INFO, "reduce falls back to the classic path"),
+    "PWT304": (Severity.INFO, "flatten vector path disabled"),
+    "PWT305": (Severity.WARNING, "non-deterministic UDF feeds stateful operator"),
+    "PWT306": (Severity.WARNING, "async/blocking UDF on exchange-crossing path"),
+    "PWT399": (Severity.ERROR, "analyzer prediction disagrees with built plan"),
+}
+
+
+def _trace_to_dict(trace: Any) -> Optional[Dict[str, Any]]:
+    if trace is None:
+        return None
+    return {
+        "file": trace.file,
+        "line": trace.line,
+        "function": trace.function,
+        "line_text": trace.line_text,
+    }
+
+
+@dataclass
+class Diagnostic:
+    code: str
+    message: str
+    severity: Severity
+    # user frame, as a plain dict (file/line/function/line_text); None for
+    # synthetic operators with no user frame
+    trace: Optional[Dict[str, Any]] = None
+    # always-present fallback location: "kind#op_id" (+ graph path) — the
+    # finding is never dropped just because the trace is missing
+    operator: Optional[str] = None
+    details: Dict[str, Any] = field(default_factory=dict)
+
+    def location(self) -> str:
+        if self.trace is not None:
+            loc = f"{self.trace['file']}:{self.trace['line']}"
+            if self.trace.get("line_text"):
+                return f"{loc}: {self.trace['line_text']}"
+            return loc
+        if self.operator:
+            return f"<{self.operator}>"
+        return "<unknown>"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "code": self.code,
+            "severity": str(self.severity),
+            "message": self.message,
+            "trace": dict(self.trace) if self.trace is not None else None,
+            "operator": self.operator,
+            "details": dict(self.details),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Diagnostic":
+        return cls(
+            code=d["code"],
+            message=d["message"],
+            severity=Severity.parse(d["severity"]),
+            trace=dict(d["trace"]) if d.get("trace") is not None else None,
+            operator=d.get("operator"),
+            details=dict(d.get("details", {})),
+        )
+
+
+def make_diag(
+    code: str,
+    message: str,
+    *,
+    trace: Any = None,
+    operator: Optional[str] = None,
+    severity: Optional[Severity] = None,
+    **details: Any,
+) -> Diagnostic:
+    default_sev, _title = CODES[code]
+    return Diagnostic(
+        code=code,
+        message=message,
+        severity=severity if severity is not None else default_sev,
+        trace=_trace_to_dict(trace),
+        operator=operator,
+        details=details,
+    )
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Diagnostic] = field(default_factory=list)
+    # columnar-eligibility predictions, one per join/reduce/flatten op:
+    # {"op", "op_id", "predicted": "columnar"|"classic", "reasons": [...],
+    #  "trace": {...}|None}
+    predictions: List[Dict[str, Any]] = field(default_factory=list)
+
+    def add(self, diag: Diagnostic) -> None:
+        self.findings.append(diag)
+
+    def max_severity(self) -> Optional[Severity]:
+        if not self.findings:
+            return None
+        return max(f.severity for f in self.findings)
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[str(f.severity)] = out.get(str(f.severity), 0) + 1
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "version": 1,
+            "findings": [f.to_dict() for f in self.findings],
+            "predictions": [dict(p) for p in self.predictions],
+            "summary": self.counts(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AnalysisResult":
+        return cls(
+            findings=[Diagnostic.from_dict(f) for f in d.get("findings", [])],
+            predictions=[dict(p) for p in d.get("predictions", [])],
+        )
+
+    def render_text(self) -> str:
+        lines: List[str] = []
+        order = sorted(
+            self.findings, key=lambda f: (-int(f.severity), f.code)
+        )
+        for f in order:
+            _sev, title = CODES.get(f.code, (Severity.INFO, ""))
+            lines.append(f"{f.severity}: {f.code} [{title}]")
+            lines.append(f"  {f.message}")
+            lines.append(f"  at {f.location()}")
+            for key, value in sorted(f.details.items()):
+                lines.append(f"  {key}: {value}")
+        counts = self.counts()
+        if counts:
+            summary = ", ".join(
+                f"{counts[k]} {k}" for k in ("error", "warning", "info")
+                if k in counts
+            )
+            lines.append(f"{len(self.findings)} finding(s): {summary}")
+        else:
+            lines.append("no findings")
+        return "\n".join(lines)

@@ -1,0 +1,178 @@
+"""Graph access layer for the analyzer.
+
+The parse graph records an `OpSpec` on every op-result table
+(`internals/parse_graph.record_op`) — kind, input tables, expression
+payload.  This module turns that flat record into the views the passes
+need: the anchored set (tables reachable upstream from a sink), a
+consumer index for downstream reachability, expression traversal, and a
+best-effort dtype resolver.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Set
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    IdReference,
+)
+from pathway_tpu.internals.type_interpreter import infer_dtype
+
+
+def walk_expr(expr: Any) -> Iterator[ColumnExpression]:
+    """Yield `expr` and every sub-expression, in pre-order.  Children are
+    discovered structurally (any ColumnExpression attribute, or tuple /
+    list / dict attribute containing one), matching how expression
+    classes store operands."""
+    if not isinstance(expr, ColumnExpression):
+        return
+    stack: List[ColumnExpression] = [expr]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, ColumnReference):
+            continue  # leaf: do not follow the _table backref
+        for value in vars(node).values():
+            if isinstance(value, ColumnExpression):
+                stack.append(value)
+            elif isinstance(value, (tuple, list)):
+                for v in value:
+                    if isinstance(v, ColumnExpression):
+                        stack.append(v)
+            elif isinstance(value, dict):
+                for v in value.values():
+                    if isinstance(v, ColumnExpression):
+                        stack.append(v)
+
+
+def op_exprs(op: Any) -> Iterator[ColumnExpression]:
+    """Every expression the op's payload closes over, flattened."""
+    for value in op.exprs.values():
+        if isinstance(value, ColumnExpression):
+            yield value
+        elif isinstance(value, (tuple, list)):
+            for v in value:
+                if isinstance(v, ColumnExpression):
+                    yield v
+        elif isinstance(value, dict):
+            for v in value.values():
+                if isinstance(v, ColumnExpression):
+                    yield v
+
+
+def resolve_ref_dtype(ref: ColumnReference) -> dt.DType:
+    if isinstance(ref, IdReference):
+        return dt.POINTER
+    return ref._table._schema[ref.name].dtype
+
+
+def infer(expr: ColumnExpression) -> Optional[dt.DType]:
+    """Best-effort dtype of an expression; None when inference fails
+    (the analyzer then stays silent rather than guessing)."""
+    try:
+        return infer_dtype(expr, resolve_ref_dtype)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+class GraphView:
+    """Immutable snapshot of the parse graph, indexed for analysis."""
+
+    def __init__(self, graph: Any, extra_tables: Iterable[Any] = ()):
+        self.graph = graph
+        self.markers = list(graph.markers)
+        self.sink_tables: List[Any] = []
+        seen_sink: Set[int] = set()
+        for spec in graph.sinks:
+            for t in spec.tables:
+                if id(t) not in seen_sink:
+                    seen_sink.add(id(t))
+                    self.sink_tables.append(t)
+        for t in extra_tables:
+            if id(t) not in seen_sink:
+                seen_sink.add(id(t))
+                self.sink_tables.append(t)
+
+        # anchored: everything a sink transitively depends on
+        self.anchored: List[Any] = []
+        self._anchored_ids: Set[int] = set()
+        stack = list(self.sink_tables)
+        while stack:
+            t = stack.pop()
+            if id(t) in self._anchored_ids:
+                continue
+            self._anchored_ids.add(id(t))
+            self.anchored.append(t)
+            op = getattr(t, "_op", None)
+            if op is not None:
+                stack.extend(op.inputs)
+
+        # every table the analyzer can see: anchored first (dead tables
+        # may already be garbage-collected; live_tables catches the rest)
+        self.tables: List[Any] = list(self.anchored)
+        known = set(self._anchored_ids)
+        for t in graph.live_tables():
+            if id(t) not in known:
+                known.add(id(t))
+                self.tables.append(t)
+
+        # consumer index over the visible tables
+        self.consumers: Dict[int, List[Any]] = {}
+        for t in self.tables:
+            op = getattr(t, "_op", None)
+            if op is None:
+                continue
+            for inp in op.inputs:
+                self.consumers.setdefault(id(inp), []).append(t)
+
+    def is_anchored(self, table: Any) -> bool:
+        return id(table) in self._anchored_ids
+
+    def ops(self, *, anchored_only: bool = False) -> Iterator[Any]:
+        """(table, op) pairs, de-duplicated, anchored tables first."""
+        for t in (self.anchored if anchored_only else self.tables):
+            op = getattr(t, "_op", None)
+            if op is not None:
+                yield t, op
+
+    def graph_path(self, table: Any, depth: int = 5) -> str:
+        """Short upstream chain for trace-less findings:
+        "select#7 <- join#3 <- source"."""
+        parts: List[str] = []
+        t = table
+        while t is not None and len(parts) < depth:
+            op = getattr(t, "_op", None)
+            if op is None:
+                parts.append("source")
+                break
+            parts.append(f"{op.kind}#{op.op_id}")
+            t = op.inputs[0] if op.inputs else None
+        else:
+            if t is not None:
+                parts.append("...")
+        return " <- ".join(parts)
+
+    def op_label(self, table: Any) -> str:
+        """The trace-fallback operator label: kind#op_id plus path."""
+        op = getattr(table, "_op", None)
+        if op is None:
+            return "source"
+        path = self.graph_path(table)
+        return f"{op.kind}#{op.op_id} ({path})"
+
+    def reaches_kind(self, table: Any, kinds: Set[str]) -> bool:
+        """Does any transitive consumer of `table` run an op in `kinds`?"""
+        stack = list(self.consumers.get(id(table), ()))
+        seen: Set[int] = set()
+        while stack:
+            t = stack.pop()
+            if id(t) in seen:
+                continue
+            seen.add(id(t))
+            op = getattr(t, "_op", None)
+            if op is not None and op.kind in kinds:
+                return True
+            stack.extend(self.consumers.get(id(t), ()))
+        return False

@@ -1,0 +1,502 @@
+"""The analyzer passes.
+
+Each pass takes the `GraphView` and appends findings / predictions to an
+`AnalysisResult`.  Passes only report what they can prove from recorded
+ops, markers and schemas — anything uninferable stays silent (a lint
+that guesses is worse than no lint).
+
+The columnar-eligibility pass does not re-implement the runtime gates:
+joins expose `_columnar_reasons()` next to `_join_keys_hashable()`,
+reduce records the gate outcome (`use_vector` + reasons) on its OpSpec
+from the very variable the build closure captures, and flatten asks
+`vector_flatten_supported()`.  Prediction and selection share one source
+of truth, which is what lets `verify_against_plan` treat a mismatch as
+an internal error (PWT399) rather than an expected drift.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Set
+
+from pathway_tpu.analysis.diagnostics import AnalysisResult, make_diag
+from pathway_tpu.analysis.graph import GraphView, infer, op_exprs, walk_expr
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.expression import (
+    ApplyExpression,
+    BinaryOpExpression,
+    CastExpression,
+    ColumnReference,
+    IdReference,
+)
+from pathway_tpu.internals.expression_printer import print_expression
+
+_ARITH_OPS = {"+", "-", "*", "/", "//", "%", "**"}
+_COMPARE_OPS = {"==", "!=", "<", "<=", ">", ">="}
+# dtypes whose values ref_scalar can always hash — the exchange layer
+# routes by that hash; anything else risks the unroutable-to-worker-0
+# fallback (engine/exchange.py _Route.codes)
+_ROUTABLE_CORES = (
+    dt.STR, dt.INT, dt.FLOAT, dt.BOOL, dt.BYTES, dt.POINTER,
+    dt.DATE_TIME_NAIVE, dt.DATE_TIME_UTC, dt.DURATION, dt.NONE,
+)
+# op kinds that accumulate state keyed on their input rows: a
+# non-deterministic UDF upstream of one of these makes retractions
+# recompute a *different* value, so deletions stop cancelling insertions
+STATEFUL_KINDS = {
+    "reduce", "join", "semijoin", "deduplicate", "sort", "iterate",
+    "clocked", "stream_to_table", "merge_streams", "gradual_broadcast",
+    "ix", "reindex",
+}
+# kinds whose engine nodes sit behind an exchange on multi-worker runs
+_EXCHANGE_KINDS = {"reduce", "join", "semijoin", "deduplicate", "sort"}
+
+
+def _trace_or_none(table: Any):
+    return getattr(table, "_trace", None)
+
+
+def _core(d: Optional[dt.DType]) -> Optional[dt.DType]:
+    if d is None:
+        return None
+    if isinstance(d, dt.Optionalized):
+        d = dt.unoptionalize(d)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Pass 1 — dtype / coercion checks (PWT101, PWT102, PWT103)
+# ---------------------------------------------------------------------------
+
+_NUMERIC = (dt.INT, dt.FLOAT, dt.BOOL)
+
+
+def _comparable(a: dt.DType, b: dt.DType) -> bool:
+    if a == b:
+        return True
+    if a in _NUMERIC and b in _NUMERIC:
+        return True
+    # naive/utc datetimes, durations etc. must match exactly; ANY-family
+    # and container dtypes are handled by the caller (skipped)
+    return False
+
+
+def dtype_pass(view: GraphView, result: AnalysisResult) -> None:
+    for table, op in view.ops():
+        if op.synthetic:
+            continue
+        seen_nodes: Set[int] = set()
+        for expr in op_exprs(op):
+            for node in walk_expr(expr):
+                if id(node) in seen_nodes:
+                    continue  # shared subexpressions report once
+                seen_nodes.add(id(node))
+                trace = _trace_or_none(table)
+                operator = view.op_label(table)
+                if isinstance(node, CastExpression):
+                    inner = _core(infer(node._expr))
+                    target = _core(node._target)
+                    if inner is dt.FLOAT and target is dt.INT:
+                        result.add(make_diag(
+                            "PWT101",
+                            "cast from float to int truncates: "
+                            f"{print_expression(node)}",
+                            trace=trace, operator=operator,
+                            expression=print_expression(node),
+                        ))
+                elif isinstance(node, BinaryOpExpression):
+                    lhs = infer(node._left)
+                    rhs = infer(node._right)
+                    lc, rc = _core(lhs), _core(rhs)
+                    if lc is None or rc is None:
+                        continue
+                    simple = (
+                        lc in _ROUTABLE_CORES and rc in _ROUTABLE_CORES
+                    )
+                    if (
+                        node._op in _COMPARE_OPS
+                        and simple
+                        and not _comparable(lc, rc)
+                    ):
+                        result.add(make_diag(
+                            "PWT102",
+                            f"comparison {print_expression(node)} mixes "
+                            f"incompatible dtypes {lhs} and {rhs}",
+                            trace=trace, operator=operator,
+                            expression=print_expression(node),
+                            left_dtype=str(lhs), right_dtype=str(rhs),
+                        ))
+                    elif node._op in _ARITH_OPS and (
+                        isinstance(lhs, dt.Optionalized)
+                        or isinstance(rhs, dt.Optionalized)
+                    ):
+                        if lc in _NUMERIC and rc in _NUMERIC:
+                            result.add(make_diag(
+                                "PWT103",
+                                "arithmetic on optional operand "
+                                f"{print_expression(node)} silently "
+                                "propagates None",
+                                trace=trace, operator=operator,
+                                expression=print_expression(node),
+                            ))
+
+
+# ---------------------------------------------------------------------------
+# Pass 2 — state growth (PWT201, PWT202, PWT203)
+# ---------------------------------------------------------------------------
+
+# temporal entry points that accept behavior=; window_join has no such
+# knob, so flagging it would be unsatisfiable noise
+_BEHAVIORAL_TEMPORAL = {"windowby", "interval_join", "asof_join"}
+
+
+def state_pass(view: GraphView, result: AnalysisResult) -> None:
+    for marker in view.markers:
+        if (
+            marker.kind in _BEHAVIORAL_TEMPORAL
+            and not marker.info.get("has_behavior")
+        ):
+            result.add(make_diag(
+                "PWT201",
+                f"{marker.kind} without behavior= keeps every row "
+                "forever; pass pw.temporal.common_behavior(...) to bound "
+                "state",
+                trace=marker.trace, operator=marker.kind,
+                temporal_op=marker.kind,
+            ))
+    for table, op in view.ops():
+        if op.kind == "reduce" and not op.synthetic:
+            for g in op.exprs.get("grouping", ()):
+                gd = infer(g)
+                core = _core(gd)
+                if core is dt.FLOAT or core is dt.ANY:
+                    result.add(make_diag(
+                        "PWT202",
+                        f"groupby key {print_expression(g)} has "
+                        f"unbounded-cardinality dtype {gd}: every "
+                        "distinct value becomes a group held in state",
+                        trace=_trace_or_none(table),
+                        operator=view.op_label(table),
+                        key=print_expression(g), dtype=str(gd),
+                    ))
+        elif op.kind == "iterate" and op.info.get("iteration_limit") is None:
+            result.add(make_diag(
+                "PWT203",
+                "iterate without iteration_limit= may never converge on "
+                "adversarial input; bound it or document why the "
+                "fixpoint is guaranteed",
+                trace=_trace_or_none(table),
+                operator=view.op_label(table),
+            ))
+
+
+# ---------------------------------------------------------------------------
+# Pass 3 — columnar eligibility + predictions (PWT301..PWT304)
+# ---------------------------------------------------------------------------
+
+def _routable(d: Optional[dt.DType]) -> bool:
+    """Can ref_scalar hash every value of this dtype?  Containers of
+    routable dtypes hash fine; Json / ANY / arrays may not."""
+    core = _core(d)
+    if core is None:
+        return False
+    if core in _ROUTABLE_CORES:
+        return True
+    if isinstance(core, dt.TupleDType):
+        return all(_routable(a) for a in core.args)
+    if isinstance(core, dt.ListDType):
+        return _routable(core.arg)
+    return False
+
+
+def _prediction(
+    view: GraphView,
+    table: Any,
+    op_kind: str,
+    op_id: int,
+    reasons: List[str],
+) -> Dict[str, Any]:
+    from pathway_tpu.analysis.diagnostics import _trace_to_dict
+
+    return {
+        "op": op_kind,
+        "op_id": op_id,
+        "predicted": "classic" if reasons else "columnar",
+        "reasons": list(reasons),
+        "trace": _trace_to_dict(_trace_or_none(table)),
+        "operator": view.op_label(table),
+        "anchored": view.is_anchored(table),
+    }
+
+
+def columnar_pass(
+    view: GraphView, result: AnalysisResult, *, workers: int = 1
+) -> None:
+    from pathway_tpu.engine.vector_flatten import vector_flatten_supported
+
+    seen_joins: Set[int] = set()
+    for table, op in view.ops():
+        trace = _trace_or_none(table)
+        operator = view.op_label(table)
+        if op.kind == "join":
+            from pathway_tpu.internals.joins import JoinResult
+
+            jr = op.info.get("join_result")
+            if jr is None or id(jr) in seen_joins:
+                continue  # several selects on one JoinResult share a node
+            seen_joins.add(id(jr))
+            # temporal subclasses (interval/asof) build their own node
+            # kinds — the vector-join gate does not apply to them
+            if type(jr) is JoinResult:
+                reasons = jr._columnar_reasons()
+                result.predictions.append(
+                    _prediction(view, table, "join", op.op_id, reasons)
+                )
+                if reasons:
+                    result.add(make_diag(
+                        "PWT301",
+                        "join cannot take the columnar path: "
+                        + "; ".join(reasons),
+                        trace=trace, operator=operator, reasons=reasons,
+                    ))
+            if workers > 1:
+                for key in (
+                    list(op.exprs.get("on_left", ()))
+                    + list(op.exprs.get("on_right", ()))
+                ):
+                    if not _routable(infer(key)):
+                        result.add(make_diag(
+                            "PWT302",
+                            f"join key {print_expression(key)} has "
+                            f"dtype {infer(key)} the exchange layer "
+                            "cannot hash: rows pile up on worker 0 "
+                            "(pathway_exchange_unroutable_rows)",
+                            trace=trace, operator=operator,
+                            key=print_expression(key),
+                        ))
+        elif op.kind == "reduce":
+            reasons = list(op.info.get("vector_reasons", ()))
+            result.predictions.append(
+                _prediction(view, table, "reduce", op.op_id, reasons)
+            )
+            if reasons and not op.synthetic:
+                result.add(make_diag(
+                    "PWT303",
+                    "reduce cannot take the columnar path: "
+                    + "; ".join(reasons),
+                    trace=trace, operator=operator, reasons=reasons,
+                ))
+            if workers > 1 and not op.synthetic:
+                for g in op.exprs.get("grouping", ()):
+                    if not _routable(infer(g)):
+                        result.add(make_diag(
+                            "PWT302",
+                            f"groupby key {print_expression(g)} has "
+                            f"dtype {infer(g)} the exchange layer "
+                            "cannot hash: rows pile up on worker 0 "
+                            "(pathway_exchange_unroutable_rows)",
+                            trace=trace, operator=operator,
+                            key=print_expression(g),
+                        ))
+        elif op.kind == "flatten":
+            reasons = (
+                []
+                if vector_flatten_supported()
+                else ["vector flatten disabled by configuration"]
+            )
+            result.predictions.append(
+                _prediction(view, table, "flatten", op.op_id, reasons)
+            )
+            if reasons:
+                result.add(make_diag(
+                    "PWT304",
+                    "flatten runs the classic row-wise path: "
+                    + "; ".join(reasons),
+                    trace=trace, operator=operator, reasons=reasons,
+                ))
+
+
+# ---------------------------------------------------------------------------
+# Pass 4 — dead subgraphs and unused columns (PWT110, PWT111)
+# ---------------------------------------------------------------------------
+
+def dead_pass(view: GraphView, result: AnalysisResult) -> None:
+    if not view.sink_tables:
+        return  # nothing is anchored; "everything is dead" is not useful
+    for table, op in view.ops():
+        if op.synthetic or view.is_anchored(table):
+            continue
+        # report only subgraph leaves (no consumers): the table the user
+        # computed and dropped, not every op that fed it
+        if view.consumers.get(id(table)):
+            continue
+        result.add(make_diag(
+            "PWT110",
+            f"result of {op.kind} is never written to a sink: the "
+            "subgraph computes rows nobody reads",
+            trace=_trace_or_none(table),
+            operator=view.op_label(table),
+        ))
+
+    # backward column liveness over the anchored region
+    live: Dict[int, Set[str]] = {
+        id(t): set(t.column_names()) for t in view.sink_tables
+    }
+    by_id = {id(t): t for t in view.anchored}
+    work = list(view.sink_tables)
+
+    def mark(tbl: Any, col: str) -> None:
+        s = live.setdefault(id(tbl), set())
+        if col not in s:
+            s.add(col)
+            work.append(tbl)
+
+    def mark_refs(expr: Any) -> None:
+        for node in walk_expr(expr):
+            if isinstance(node, ColumnReference) and not isinstance(
+                node, IdReference
+            ):
+                mark(node._table, node._name)
+
+    processed: Set[tuple] = set()
+    while work:
+        t = work.pop()
+        op = getattr(t, "_op", None)
+        if op is None:
+            continue
+        out_live = frozenset(live.get(id(t), ()))
+        key = (id(t), out_live)
+        if key in processed:
+            continue
+        processed.add(key)
+        if op.kind == "select":
+            for name in out_live:
+                expr = op.exprs.get("cols", {}).get(name)
+                if expr is not None:
+                    mark_refs(expr)
+        elif op.kind == "filter":
+            (inp,) = op.inputs
+            for name in out_live:
+                mark(inp, name)
+            mark_refs(op.exprs.get("expr"))
+        else:
+            # conservative: the op may read anything from its inputs
+            for inp in op.inputs:
+                for name in inp.column_names():
+                    mark(inp, name)
+            for expr in op_exprs(op):
+                mark_refs(expr)
+
+    for t in view.anchored:
+        op = getattr(t, "_op", None)
+        if op is None or op.kind != "select" or op.synthetic:
+            continue
+        if not view.consumers.get(id(t)):
+            continue  # sink-written tables keep every column
+        unused = sorted(set(t.column_names()) - live.get(id(t), set()))
+        for name in unused:
+            result.add(make_diag(
+                "PWT111",
+                f"column {name!r} is computed but never read "
+                "downstream",
+                trace=_trace_or_none(t),
+                operator=view.op_label(t),
+                column=name,
+            ))
+
+
+# ---------------------------------------------------------------------------
+# Pass 5 — UDF hazards (PWT305, PWT306)
+# ---------------------------------------------------------------------------
+
+def udf_pass(
+    view: GraphView, result: AnalysisResult, *, workers: int = 1
+) -> None:
+    for table, op in view.ops():
+        if op.synthetic:
+            continue
+        stateful_here = op.kind in STATEFUL_KINDS
+        reaches_stateful = stateful_here or view.reaches_kind(
+            table, STATEFUL_KINDS
+        )
+        crosses_exchange = workers > 1 and (
+            op.kind in _EXCHANGE_KINDS
+            or view.reaches_kind(table, _EXCHANGE_KINDS)
+        )
+        seen: Set[int] = set()
+        for expr in op_exprs(op):
+            for node in walk_expr(expr):
+                if not isinstance(node, ApplyExpression):
+                    continue
+                if id(node) in seen:
+                    continue
+                seen.add(id(node))
+                fname = getattr(node._fun, "__name__", "<udf>")
+                if not node._deterministic and reaches_stateful:
+                    result.add(make_diag(
+                        "PWT305",
+                        f"UDF {fname!r} is not marked deterministic but "
+                        "feeds a stateful operator: retractions recompute "
+                        "it and may not cancel the original insertion "
+                        "(mark it @pw.udf(deterministic=True) if it is)",
+                        trace=_trace_or_none(table),
+                        operator=view.op_label(table),
+                        udf=fname,
+                    ))
+                if node._is_async and crosses_exchange:
+                    result.add(make_diag(
+                        "PWT306",
+                        f"async UDF {fname!r} sits on an exchange-"
+                        "crossing path: its completion times differ per "
+                        "worker, so downstream keyed state sees "
+                        "interleavings that are hard to reproduce",
+                        trace=_trace_or_none(table),
+                        operator=view.op_label(table),
+                        udf=fname,
+                    ))
+
+
+# ---------------------------------------------------------------------------
+# Plan verification (PWT399)
+# ---------------------------------------------------------------------------
+
+# engine node class name -> (op kind, selected path)
+_NODE_PATHS = {
+    "VectorJoinNode": ("join", "columnar"),
+    "JoinNode": ("join", "classic"),
+    "VectorReduceNode": ("reduce", "columnar"),
+    "ReduceNode": ("reduce", "classic"),
+    "VectorFlattenNode": ("flatten", "columnar"),
+    "FlattenNode": ("flatten", "classic"),
+}
+
+
+def verify_against_plan(engine: Any, result: AnalysisResult) -> None:
+    """Compare the analyzer's anchored columnar predictions against the
+    node classes the build actually instantiated.  Counts (not per-node
+    identity) — parse-level ops and engine nodes have no shared id, but
+    every anchored join/reduce/flatten op builds exactly one node, so the
+    histograms must agree."""
+    predicted: Dict[tuple, int] = {}
+    for p in result.predictions:
+        if not p.get("anchored"):
+            continue
+        key = (p["op"], p["predicted"])
+        predicted[key] = predicted.get(key, 0) + 1
+    actual: Dict[tuple, int] = {}
+    for node in getattr(engine, "nodes", ()):
+        hit = _NODE_PATHS.get(type(node).__name__)
+        if hit is not None:
+            actual[hit] = actual.get(hit, 0) + 1
+    for key in sorted(set(predicted) | set(actual)):
+        if predicted.get(key, 0) != actual.get(key, 0):
+            op_kind, path = key
+            result.add(make_diag(
+                "PWT399",
+                f"analyzer predicted {predicted.get(key, 0)} {path} "
+                f"{op_kind} node(s) but the built plan has "
+                f"{actual.get(key, 0)} — the static gate and the build "
+                "gate have drifted; please report this",
+                operator=f"{op_kind}/{path}",
+                predicted=predicted.get(key, 0),
+                actual=actual.get(key, 0),
+            ))

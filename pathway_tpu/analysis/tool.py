@@ -1,0 +1,82 @@
+"""`pathway-tpu analyze` implementation: load a user script, intercept
+pw.run, analyze the graph it built.
+
+The script is executed for its graph-building side effects only —
+`runner.run`/`run_all` are patched to record that they were called (and
+with what) instead of starting the engine, so analysis stays cheap and
+side-effect-free even for streaming jobs.  Exit codes: 0 clean, 1
+findings at or above --fail-on, 2 the script itself failed to load.
+"""
+
+from __future__ import annotations
+
+import json
+import runpy
+import sys
+from typing import List, Optional
+
+from pathway_tpu.analysis import AnalysisResult, Severity, analyze
+
+
+def analyze_script(path: str) -> AnalysisResult:
+    """Execute `path` with pw.run patched out, then analyze the graph it
+    registered on the global parse graph."""
+    from pathway_tpu.internals import runner
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    calls: List[dict] = []
+
+    def _capture_run(**kwargs):
+        calls.append(kwargs)
+
+    real_run, real_run_all = runner.run, runner.run_all
+    # patch both the module and the package re-export: scripts call
+    # pw.run, which resolved at import time
+    import pathway_tpu as pw
+
+    pw_run, pw_run_all = pw.run, pw.run_all
+    runner.run = _capture_run
+    runner.run_all = _capture_run
+    pw.run = _capture_run
+    pw.run_all = _capture_run
+    try:
+        runpy.run_path(path, run_name="__main__")
+    finally:
+        runner.run, runner.run_all = real_run, real_run_all
+        pw.run, pw.run_all = pw_run, pw_run_all
+    return analyze(G)
+
+
+def main_analyze(args) -> int:
+    """Entry point for the cli.py `analyze` subcommand."""
+    try:
+        result = analyze_script(args.script)
+    except SystemExit as exc:  # script called sys.exit()
+        code = exc.code if isinstance(exc.code, int) else 1
+        if code != 0:
+            print(
+                f"error: {args.script} exited with {code} during graph "
+                "build",
+                file=sys.stderr,
+            )
+            return 2
+        from pathway_tpu.internals.parse_graph import G
+
+        result = analyze(G)
+    except Exception as exc:  # noqa: BLE001 — report, don't traceback
+        print(f"error: failed to load {args.script}: {exc}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(result.to_dict(), indent=2, sort_keys=True))
+    else:
+        print(result.render_text())
+
+    threshold: Optional[Severity] = None
+    if args.fail_on:
+        threshold = Severity.parse(args.fail_on)
+    worst = result.max_severity()
+    if threshold is not None and worst is not None and worst >= threshold:
+        return 1
+    return 0

@@ -71,9 +71,32 @@ def _airbyte_create_source(args) -> int:
     return 0
 
 
+def _analyze(args) -> int:
+    from pathway_tpu.analysis.tool import main_analyze
+
+    return main_analyze(args)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="pathway")
     sub = parser.add_subparsers(dest="command", required=True)
+
+    analyze = sub.add_parser(
+        "analyze",
+        help="statically analyze a script's dataflow graph without "
+        "running it",
+    )
+    analyze.add_argument("script", help="python script that builds a graph")
+    analyze.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    analyze.add_argument(
+        "--fail-on",
+        choices=["info", "warning", "error"],
+        default=None,
+        help="exit 1 when a finding at or above this severity exists",
+    )
+    analyze.set_defaults(func=_analyze)
 
     spawn = sub.add_parser("spawn", help="run a program on multiple workers")
     spawn.add_argument("--threads", "-t", type=int, default=1)

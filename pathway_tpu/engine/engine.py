@@ -189,6 +189,12 @@ class Engine:
         self.nodes: List[Node] = []
         self.worker_id = coord.worker_id
         self.worker_count = coord.worker_count
+        # log-once keys for this engine — per-engine (NOT process-global)
+        # so multi-engine tests and re-runs each warn once (warn_once)
+        self._warned_once: set[str] = set()
+        # static-analysis result dict, attached by pw.run(analysis=...)
+        # and served by the /status endpoint
+        self.analysis: dict | None = None
         self.error_log: List[ErrorLogEntry] = []
         self.error_log_nodes: List["ErrorLogNode"] = []
         self._scheduled_times: set[int] = set()
@@ -261,6 +267,19 @@ class Engine:
             return flag
         return any(self.coord.agree(bool(flag)))
 
+    def warn_once(self, key: str, message: str, *args) -> bool:
+        """Log `message` at WARNING the first time `key` is seen on THIS
+        engine.  Per-engine, not process-global: every engine of a
+        multi-worker run (and every re-run) gets its warning exactly
+        once.  Returns True when the message was emitted."""
+        if key in self._warned_once:
+            return False
+        self._warned_once.add(key)
+        import logging
+
+        logging.getLogger("pathway_tpu").warning(message, *args)
+        return True
+
     def log_error(self, message: str, operator: str = "", trace=None) -> None:
         # default attribution to the node being processed right now — this
         # catches expression/UDF errors logged through bare engine loggers
@@ -271,6 +290,13 @@ class Engine:
                 operator = node.name
             if trace is None:
                 trace = node.trace
+                if trace is None:
+                    # synthetic/stdlib-built operators have no user frame;
+                    # fall back to the node's graph position so the entry
+                    # stays attributable instead of being anonymous
+                    idx = getattr(node, "_idx", None)
+                    if idx is not None and "#" not in operator:
+                        operator = f"{operator}#{idx}"
         entry = ErrorLogEntry(message, operator, self.current_time, trace)
         self.error_log.append(entry)
         if self.metrics is not None:

@@ -987,15 +987,15 @@ def _make_exchange_node():
         def _note_unroutable(self, n: int) -> None:
             if self._m_unroutable is not None:
                 self._m_unroutable.inc(n)
-            eng = self.engine
-            if not getattr(eng, "_unroutable_logged", False):
-                eng._unroutable_logged = True
-                logger.warning(
-                    "exchange: %d row(s) with unhashable routing values "
-                    "routed to worker 0 (see "
-                    "pathway_exchange_unroutable_rows; logged once per run)",
-                    n,
-                )
+            # Engine.warn_once is per-engine: every worker engine of a
+            # multi-engine test (and every re-run) warns exactly once
+            self.engine.warn_once(
+                "exchange_unroutable",
+                "exchange: %d row(s) with unhashable routing values "
+                "routed to worker 0 (see "
+                "pathway_exchange_unroutable_rows; logged once per run)",
+                n,
+            )
 
         def process(self, time: int) -> None:
             deltas = self.take(0)

@@ -36,4 +36,23 @@ def print_expression(expr) -> str:
     if isinstance(expr, ex.MethodCallExpression):
         args = ", ".join(print_expression(a) for a in expr._args)
         return f"{expr._method}({args})"
+    if isinstance(expr, ex.CastExpression):
+        return f"cast({expr._target}, {print_expression(expr._expr)})"
+    if isinstance(expr, ex.ConvertExpression):
+        return f"convert({expr._target}, {print_expression(expr._expr)})"
+    if isinstance(expr, ex.CoalesceExpression):
+        args = ", ".join(print_expression(a) for a in expr._args)
+        return f"coalesce({args})"
+    if isinstance(expr, ex.IsNoneExpression):
+        return f"is_none({print_expression(expr._arg)})"
+    if isinstance(expr, ex.UnwrapExpression):
+        return f"unwrap({print_expression(expr._expr)})"
+    if isinstance(expr, ex.MakeTupleExpression):
+        args = ", ".join(print_expression(a) for a in expr._args)
+        return f"make_tuple({args})"
+    if isinstance(expr, ex.GetExpression):
+        return (
+            f"{print_expression(expr._obj)}"
+            f"[{print_expression(expr._index)}]"
+        )
     return f"<{type(expr).__name__}>"

@@ -217,33 +217,54 @@ class GroupedTable:
         # multiplicities columnar-ly; min/max stay classic on optional
         # columns (the classic accumulator's None-death is path-dependent).
         # `any` never compares values, so it takes any argument dtype.
-        use_vector = sort_by is None and id_expr is None
-        if use_vector:
-            from pathway_tpu.engine.vector_reduce import VECTOR_REDUCERS
-            from pathway_tpu.internals.table import _expr_deterministic
+        # Reasons are collected (not short-circuited) so the analyzer can
+        # report every disqualifier; `use_vector` stays exactly
+        # "no reasons", which the build closure below captures — the
+        # analyzer's prediction and the selected node cannot disagree.
+        vector_reasons: List[str] = []
+        if sort_by is not None:
+            vector_reasons.append(
+                "sort_by makes accumulation order-dependent"
+            )
+        if id_expr is not None:
+            vector_reasons.append("explicit id= keying bypasses group keys")
+        from pathway_tpu.engine.vector_reduce import VECTOR_REDUCERS
+        from pathway_tpu.internals.table import _expr_deterministic
 
-            for red in reducers:
-                name = red._reducer.name
-                if name not in VECTOR_REDUCERS:
-                    use_vector = False
-                    break
-                if not all(_expr_deterministic(a) for a in red._args):
-                    use_vector = False
-                    break
-                if red._args and name != "any":
-                    try:
-                        adt = self._infer_on_source(red._args[0])
-                    except Exception:  # noqa: BLE001
-                        use_vector = False
-                        break
-                    opt = isinstance(adt, dt.Optionalized)
-                    base = dt.unoptionalize(adt) if opt else adt
-                    if base not in (dt.INT, dt.FLOAT, dt.BOOL):
-                        use_vector = False
-                        break
-                    if opt and name not in ("sum", "avg"):
-                        use_vector = False
-                        break
+        for red in reducers:
+            name = red._reducer.name
+            if name not in VECTOR_REDUCERS:
+                vector_reasons.append(
+                    f"reducer {name!r} has no vector implementation"
+                )
+                continue
+            if not all(_expr_deterministic(a) for a in red._args):
+                vector_reasons.append(
+                    f"reducer {name!r} has a non-deterministic argument"
+                )
+                continue
+            if red._args and name != "any":
+                try:
+                    adt = self._infer_on_source(red._args[0])
+                except Exception:  # noqa: BLE001
+                    vector_reasons.append(
+                        f"reducer {name!r} argument dtype is uninferable"
+                    )
+                    continue
+                opt = isinstance(adt, dt.Optionalized)
+                base = dt.unoptionalize(adt) if opt else adt
+                if base not in (dt.INT, dt.FLOAT, dt.BOOL):
+                    vector_reasons.append(
+                        f"reducer {name!r} argument dtype {adt} is not "
+                        "numeric"
+                    )
+                    continue
+                if opt and name not in ("sum", "avg"):
+                    vector_reasons.append(
+                        f"reducer {name!r} does not accept optional "
+                        f"dtype {adt}"
+                    )
+        use_vector = not vector_reasons
 
         def build(ctx):
             from pathway_tpu.engine.operators import ReduceNode
@@ -389,10 +410,25 @@ class GroupedTable:
             raw_cols[f"_r{j}"] = ColumnSchema(
                 name=f"_r{j}", dtype=self._infer_on_source(red)
             )
-        raw = Table(
-            schema=schema_from_columns(raw_cols),
-            universe=Universe(),
-            build=build,
+        from pathway_tpu.internals.parse_graph import record_op
+
+        raw = record_op(
+            Table(
+                schema=schema_from_columns(raw_cols),
+                universe=Universe(),
+                build=build,
+            ),
+            "reduce",
+            (source,),
+            {
+                "grouping": list(grouping),
+                "reducers": list(reducers),
+                "instance": instance,
+                "id_expr": id_expr,
+                "sort_by": sort_by,
+            },
+            use_vector=use_vector,
+            vector_reasons=list(vector_reasons),
         )
 
         # rewrite output expressions against the raw table

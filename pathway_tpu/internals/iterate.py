@@ -223,6 +223,8 @@ def iterate_impl(func, iteration_limit: int | None = None, **kwargs):
             ctx.join_nodes[cache_key] = core
         return core
 
+    from pathway_tpu.internals.parse_graph import record_op
+
     results: Dict[str, Table] = {}
     for name in output_names:
 
@@ -230,8 +232,14 @@ def iterate_impl(func, iteration_limit: int | None = None, **kwargs):
             core = build_core(ctx)
             return IterateOutputNode(ctx.engine, core, name)
 
-        results[name] = Table(
-            schema=output_schemas[name], universe=Universe(), build=build
+        results[name] = record_op(
+            Table(
+                schema=output_schemas[name], universe=Universe(), build=build
+            ),
+            "iterate",
+            tuple(input_tables.values()),
+            iteration_limit=iteration_limit,
+            output=name,
         )
 
     if len(results) == 1:

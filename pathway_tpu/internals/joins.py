@@ -233,6 +233,42 @@ class JoinResult:
                 return False
         return True
 
+    def _columnar_reasons(self) -> list:
+        """Reason strings for every way this join fails the columnar
+        gate — the analyzer-facing twin of `_join_keys_hashable`, kept
+        next to it so the two can't drift.  Empty list == eligible."""
+        from pathway_tpu.engine import vector_join
+        from pathway_tpu.internals.expression_printer import print_expression
+        from pathway_tpu.internals.type_interpreter import infer_dtype
+
+        reasons = []
+        if not vector_join.vector_join_supported():
+            reasons.append("vector join disabled by configuration")
+
+        def resolve(ref: ColumnReference) -> dt.DType:
+            if isinstance(ref, IdReference):
+                return dt.POINTER
+            return ref._table._schema[ref.name].dtype
+
+        for expr in self._on_left + self._on_right:
+            try:
+                d = infer_dtype(expr, resolve)
+            except Exception:  # noqa: BLE001 — mirror the gate's fallback
+                reasons.append(
+                    f"join key {print_expression(expr)} has "
+                    "uninferable dtype"
+                )
+                continue
+            base = d
+            if isinstance(base, dt.Optionalized):
+                base = dt.unoptionalize(base)
+            if base not in self._HASHABLE_JOIN_DTYPES:
+                reasons.append(
+                    f"join key {print_expression(expr)} has unhashable "
+                    f"dtype {d}"
+                )
+        return reasons
+
     def _join_node(self, ctx):
         """Build (or reuse) the engine join node for this join; picks the
         columnar VectorJoinNode when the join-key dtypes statically allow
@@ -363,10 +399,24 @@ class JoinResult:
             schema_cols[name] = ColumnSchema(
                 name=name, dtype=self._infer_joined(e)
             )
-        return Table(
-            schema=schema_from_columns(schema_cols),
-            universe=Universe(),
-            build=build,
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(
+                schema=schema_from_columns(schema_cols),
+                universe=Universe(),
+                build=build,
+            ),
+            "join",
+            (self._left, self._right),
+            {
+                "on_left": list(self._on_left),
+                "on_right": list(self._on_right),
+                "cols": dict(cols),
+                "filters": list(self._filters),
+            },
+            mode=self._mode.name,
+            join_result=self,
         )
 
     def _infer_joined(self, expr: ColumnExpression) -> dt.DType:

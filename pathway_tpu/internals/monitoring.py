@@ -278,6 +278,9 @@ class PrometheusServer:
             "worker_count": e0.worker_count,
             "graph": topology,
             "workers": workers,
+            # findings from pw.run(analysis=...): deployed graphs report
+            # their own lint state (None when analysis was off)
+            "analysis": getattr(e0, "analysis", None),
         }
 
     def start(self) -> None:

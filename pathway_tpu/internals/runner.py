@@ -89,6 +89,38 @@ def last_engine():
     return _last_engine
 
 
+def _apply_analysis(engine: Engine, mode) -> None:
+    """Run the static analyzer over the registered sinks, verify its
+    columnar predictions against the freshly built plan, and attach the
+    result to the engine (the /status endpoint serves it).  "warn" logs
+    findings, "strict" refuses to run on warning-or-worse."""
+    if mode is None or mode == "off":
+        return
+    if mode not in ("warn", "strict"):
+        raise ValueError(
+            f"analysis= must be 'strict', 'warn' or 'off', got {mode!r}"
+        )
+    import logging
+
+    from pathway_tpu.analysis import (
+        AnalysisError,
+        Severity,
+        analyze,
+        verify_against_plan,
+    )
+
+    result = analyze(G, workers=engine.worker_count)
+    verify_against_plan(engine, result)
+    engine.analysis = result.to_dict()
+    if not result.findings:
+        return
+    if mode == "strict" and result.max_severity() >= Severity.WARNING:
+        raise AnalysisError(result)
+    logging.getLogger("pathway_tpu").warning(
+        "static analysis:\n%s", result.render_text()
+    )
+
+
 def run(
     *,
     debug: bool = False,
@@ -96,6 +128,7 @@ def run(
     with_http_server: bool = False,
     persistence_config=None,
     autocommit_duration_ms: float | None = None,
+    analysis=None,
     **kwargs,
 ) -> None:
     """pw.run — execute every registered sink (reference:
@@ -111,6 +144,7 @@ def run(
             with_http_server=with_http_server,
             persistence_config=persistence_config,
             autocommit_duration_ms=autocommit_duration_ms,
+            analysis=analysis,
             **kwargs,
         )
 
@@ -124,6 +158,7 @@ def run(
         for sink in G.sinks:
             nodes = [ctx.node(t) for t in sink.tables]
             sink.attach(ctx, nodes)
+    _apply_analysis(engine, analysis)
     _attach_monitoring(engine)
     monitor = _maybe_start_dashboard(engine, monitoring_level)
     http_server = None
@@ -160,6 +195,7 @@ def _run_threaded(
     with_http_server: bool = False,
     persistence_config=None,
     autocommit_duration_ms: float | None = None,
+    analysis=None,
     **kwargs,
 ) -> None:
     """workers = threads x processes (reference:
@@ -204,6 +240,12 @@ def _run_threaded(
                 for sink in G.sinks:
                     nodes = [ctx.node(t) for t in sink.tables]
                     sink.attach(ctx, nodes)
+                # thread 0 analyzes under the build lock: the analyzer
+                # reads the shared parse graph the other threads are
+                # still building from, and strict mode must raise before
+                # any worker starts executing
+                if thread_index == 0:
+                    _apply_analysis(engine, analysis)
             _attach_monitoring(engine)
             monitor = None
             http_server = None
@@ -246,6 +288,13 @@ def _run_threaded(
     for t in ts:
         t.join()
     if errors:
+        from pathway_tpu.analysis import AnalysisError
+
+        # strict-mode refusal on thread 0 races with the abort errors it
+        # triggers on the other workers; surface the real cause
+        for e in errors:
+            if isinstance(e, AnalysisError):
+                raise e
         raise errors[0]
 
 

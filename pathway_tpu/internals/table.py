@@ -60,6 +60,13 @@ class Table:
         from pathway_tpu.internals.trace import trace_user_frame
 
         self._trace = trace_user_frame()
+        # analysis substrate: ops attach an OpSpec after construction; the
+        # graph keeps a weakref so the dead-subgraph pass can see tables
+        # that never reach a sink
+        self._op = None
+        from pathway_tpu.internals.parse_graph import G
+
+        G.register_table(self)
 
     # -- introspection ----------------------------------------------------
     @property
@@ -180,7 +187,18 @@ class Table:
         }
         schema = schema_from_columns(schema_cols)
         build = _rowwise_build(self, cols)
-        return Table(schema=schema, universe=self._universe, build=build)
+        from pathway_tpu.internals.parse_graph import record_op
+
+        foreign: set = set()
+        for e in cols.values():
+            collect_tables(e, foreign)
+        foreign.discard(self)
+        return record_op(
+            Table(schema=schema, universe=self._universe, build=build),
+            "select",
+            (self, *foreign),
+            {"cols": dict(cols)},
+        )
 
     def filter(self, filter_expression) -> "Table":
         """Subset rows (reference: table.py filter).
@@ -226,8 +244,17 @@ class Table:
             prog = _compile_on(ctx, [self_], expr)
             return FilterNode(ctx.engine, node, prog)
 
-        return Table(
-            schema=self._schema, universe=self._universe.subset(), build=build
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(
+                schema=self._schema,
+                universe=self._universe.subset(),
+                build=build,
+            ),
+            "filter",
+            (self,),
+            {"expr": expr},
         )
 
     def split(self, split_expression) -> tuple["Table", "Table"]:
@@ -357,11 +384,17 @@ class Table:
         return self.rename_columns(**kwargs)
 
     def copy(self) -> "Table":
+        from pathway_tpu.internals.parse_graph import record_op
+
         self_ = self
-        return Table(
-            schema=self._schema,
-            universe=self._universe,
-            build=lambda ctx: ctx.node(self_),
+        return record_op(
+            Table(
+                schema=self._schema,
+                universe=self._universe,
+                build=lambda ctx: ctx.node(self_),
+            ),
+            "copy",
+            (self,),
         )
 
     # -- typing -----------------------------------------------------------
@@ -443,7 +476,14 @@ class Table:
             # multi-worker: new keys must land on their owning worker
             return exchange_by_key(ctx.engine, ReindexNode(ctx.engine, node, prog))
 
-        return Table(schema=self._schema, universe=Universe(), build=build)
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(schema=self._schema, universe=Universe(), build=build),
+            "reindex",
+            (self,),
+            {"key": key_expr},
+        )
 
     # -- groupby / reduce -------------------------------------------------
     def groupby(
@@ -542,7 +582,14 @@ class Table:
                 ),
             )
 
-        return Table(schema=self._schema, universe=Universe(), build=build)
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(schema=self._schema, universe=Universe(), build=build),
+            "deduplicate",
+            (self,),
+            {"value": value_expr, "instance": instance_expr},
+        )
 
     # -- joins ------------------------------------------------------------
     def join(self, other: "Table", *on, id=None, how=None, **kwargs):
@@ -759,8 +806,16 @@ class Table:
             )
             schema_cols[name] = ColumnSchema(name=name, dtype=merged)
         universe = solver.get_union(self._universe, other._universe)
-        return Table(
-            schema=schema_from_columns(schema_cols), universe=universe, build=build
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(
+                schema=schema_from_columns(schema_cols),
+                universe=universe,
+                build=build,
+            ),
+            "update_rows",
+            (self, other_aligned),
         )
 
     def update_cells(self, other: "Table") -> "Table":
@@ -819,23 +874,31 @@ class Table:
             if name in other_idx:
                 dtype = dt.types_lca(dtype, other._schema[name].dtype)
             schema_cols[name] = ColumnSchema(name=name, dtype=dtype)
-        return Table(
-            schema=schema_from_columns(schema_cols),
-            universe=self._universe,
-            build=build,
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(
+                schema=schema_from_columns(schema_cols),
+                universe=self._universe,
+                build=build,
+            ),
+            "update_cells",
+            (self, other),
         )
 
     def __lshift__(self, other: "Table") -> "Table":
         return self.update_cells(other)
 
     def with_universe_of(self, other: "Table") -> "Table":
+        from pathway_tpu.internals.parse_graph import record_op
+
         self_ = self
         result = Table(
             schema=self._schema,
             universe=other._universe,
             build=lambda ctx: ctx.node(self_),
         )
-        return result
+        return record_op(result, "copy", (self,))
 
     def unsafe_promise_universes_are_equal(self, other: "Table") -> "Table":
         solver.register_equal(self._universe, other._universe)
@@ -904,8 +967,16 @@ class Table:
                 dtype = dt.types_lca(dtype, o._schema[name].dtype)
             schema_cols[name] = ColumnSchema(name=name, dtype=dtype)
         universe = solver.get_union(*(t._universe for t in [self, *others]))
-        return Table(
-            schema=schema_from_columns(schema_cols), universe=universe, build=build
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(
+                schema=schema_from_columns(schema_cols),
+                universe=universe,
+                build=build,
+            ),
+            "concat",
+            tuple(tables),
         )
 
     def concat_reindex(self, *others: "Table") -> "Table":
@@ -997,10 +1068,17 @@ class Table:
                         "is not a sequence"
                     )
             schema_cols[name] = ColumnSchema(name=name, dtype=dtype)
-        return Table(
-            schema=schema_from_columns(schema_cols),
-            universe=Universe(),
-            build=build,
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(
+                schema=schema_from_columns(schema_cols),
+                universe=Universe(),
+                build=build,
+            ),
+            "flatten",
+            (self,),
+            {"expr": ref},
         )
 
     def sort(self, key, instance=None) -> "Table":
@@ -1050,7 +1128,14 @@ class Table:
                 "next": ColumnSchema(name="next", dtype=dt.Optionalize(dt.POINTER)),
             }
         )
-        return Table(schema=schema, universe=self._universe, build=build)
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(schema=schema, universe=self._universe, build=build),
+            "sort",
+            (self,),
+            {"key": key_expr, "instance": instance_expr},
+        )
 
     def _gradual_broadcast(
         self,
@@ -1100,7 +1185,13 @@ class Table:
                 )
             }
         )
-        return Table(schema=schema, universe=self._universe, build=build)
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(schema=schema, universe=self._universe, build=build),
+            "gradual_broadcast",
+            (self, threshold_table),
+        )
 
     # -- stream shaping ----------------------------------------------------
     def _clocked(self, node_cls, time_column, threshold, **node_kwargs) -> "Table":
@@ -1122,8 +1213,18 @@ class Table:
                 **node_kwargs,
             )
 
-        return Table(
-            schema=self._schema, universe=self._universe.subset(), build=build
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(
+                schema=self._schema,
+                universe=self._universe.subset(),
+                build=build,
+            ),
+            "clocked",
+            (self,),
+            {"time": time_expr},
+            node_cls=node_cls.__name__,
         )
 
     def forget(
@@ -1196,10 +1297,16 @@ class Table:
         schema_cols[upsert_column_name] = ColumnSchema(
             name=upsert_column_name, dtype=dt.BOOL, append_only=True
         )
-        return Table(
-            schema=schema_from_columns(schema_cols),
-            universe=Universe(multiset=True),
-            build=build,
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(
+                schema=schema_from_columns(schema_cols),
+                universe=Universe(multiset=True),
+                build=build,
+            ),
+            "to_stream",
+            (self,),
         )
 
     def stream_to_table(self, is_upsert) -> "Table":
@@ -1222,7 +1329,14 @@ class Table:
             )
 
         # replayed state is a proper keyed table again, never a multiset
-        return Table(schema=self._schema, universe=Universe(), build=build)
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(schema=self._schema, universe=Universe(), build=build),
+            "stream_to_table",
+            (self,),
+            {"expr": expr},
+        )
 
     def from_streams(self, deletion_stream: "Table") -> "Table":
         """Merge an updates stream (``self``) and a deletion stream into
@@ -1237,7 +1351,13 @@ class Table:
             )
 
         # replayed state is a proper keyed table again, never a multiset
-        return Table(schema=self._schema, universe=Universe(), build=build)
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(schema=self._schema, universe=Universe(), build=build),
+            "merge_streams",
+            (self, deletion_stream),
+        )
 
     def remove_errors(self) -> "Table":
         """Filter out rows containing Error values (reference:
@@ -1256,8 +1376,16 @@ class Table:
 
             return FilterNode(ctx.engine, ctx.node(self_), pred)
 
-        return Table(
-            schema=self._schema, universe=self._universe.subset(), build=build
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(
+                schema=self._schema,
+                universe=self._universe.subset(),
+                build=build,
+            ),
+            "remove_errors",
+            (self,),
         )
 
     def await_futures(self) -> "Table":
@@ -1283,10 +1411,16 @@ class Table:
             if isinstance(dtype, dt.FutureDType):
                 dtype = dtype.wrapped
             schema_cols[name] = ColumnSchema(name=name, dtype=dtype)
-        return Table(
-            schema=schema_from_columns(schema_cols),
-            universe=self._universe.subset(),
-            build=build,
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(
+                schema=schema_from_columns(schema_cols),
+                universe=self._universe.subset(),
+                build=build,
+            ),
+            "await_futures",
+            (self,),
         )
 
     @property
@@ -1314,10 +1448,16 @@ class Table:
             )
             for name in self.column_names()
         }
-        return Table(
-            schema=schema_from_columns(schema_cols),
-            universe=self._universe,
-            build=build,
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(
+                schema=schema_from_columns(schema_cols),
+                universe=self._universe,
+                build=build,
+            ),
+            "assert_append_only",
+            (self,),
         )
 
     def update_id_type(self, id_type, *, id_append_only: bool | None = None) -> "Table":
@@ -1465,10 +1605,17 @@ class Table:
             if optional:
                 dtype = dt.Optionalize(dtype)
             schema_cols[name] = ColumnSchema(name=name, dtype=dtype)
-        return Table(
-            schema=schema_from_columns(schema_cols),
-            universe=source._universe,
-            build=build,
+        from pathway_tpu.internals.parse_graph import record_op
+
+        return record_op(
+            Table(
+                schema=schema_from_columns(schema_cols),
+                universe=source._universe,
+                build=build,
+            ),
+            "ix",
+            (self, source),
+            {"key": expr},
         )
 
     def ix_ref(self, *args, optional: bool = False, context=None, instance=None):
@@ -1724,6 +1871,12 @@ def _semijoin(
             filter_key_fn=filter_key_fn,
         )
 
-    return Table(
-        schema=table._schema, universe=table._universe.subset(), build=build
+    from pathway_tpu.internals.parse_graph import record_op
+
+    return record_op(
+        Table(
+            schema=table._schema, universe=table._universe.subset(), build=build
+        ),
+        "semijoin",
+        (table, other),
     )

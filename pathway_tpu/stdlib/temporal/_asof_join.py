@@ -220,6 +220,9 @@ def asof_join(
     qty | price
     1   | 10
     """
+    from pathway_tpu.internals.parse_graph import record_marker
+
+    record_marker("asof_join", has_behavior=behavior is not None)
     if isinstance(how, str):
         how = JoinMode[how.upper()]
     if isinstance(direction, str):

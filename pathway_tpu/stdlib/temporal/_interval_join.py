@@ -234,6 +234,9 @@ def interval_join(
     a | b
     1 | 10
     """
+    from pathway_tpu.internals.parse_graph import record_marker
+
+    record_marker("interval_join", has_behavior=behavior is not None)
     if isinstance(how, str):
         how = JoinMode[how.upper()]
     remap = None

@@ -320,6 +320,13 @@ def windowby(
     10    | 5
     0     | 30
     """
+    from pathway_tpu.internals.parse_graph import record_marker
+
+    record_marker(
+        "windowby",
+        has_behavior=behavior is not None,
+        window=type(window).__name__,
+    )
     if instance is None and shard is not None:
         instance = shard
     mapping = {thisclass.this: table}

@@ -92,6 +92,14 @@ def window_join(
     a | b
     1 | 10
     """
+    from pathway_tpu.internals.parse_graph import record_marker
+
+    # window_join has no behavior= knob at all, so the marker exists for
+    # graph inventory, not for the missing-behavior lint (PWT201 skips it
+    # — there would be no way to satisfy the lint).
+    record_marker(
+        "window_join", has_behavior=False, window=type(window).__name__
+    )
     if isinstance(how, str):
         how = JoinMode[how.upper()]
     if isinstance(window, SessionWindow):

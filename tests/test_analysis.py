@@ -1,0 +1,470 @@
+"""Build-time static analyzer (pathway_tpu/analysis/) — golden
+diagnostic matrix, JSON round-trip, clean-graph guard, the pw.run
+surface, the CLI surface, and the per-engine warn-once regression.
+
+The golden file (tests/golden/analysis_matrix.json) pins (code,
+severity, message) for every finding the lint-bait graph produces.
+Regenerate after an intentional message change with:
+
+    python tests/test_analysis.py --regen
+"""
+
+import json
+import os
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.analysis import (
+    CODES,
+    AnalysisError,
+    AnalysisResult,
+    Diagnostic,
+    Severity,
+    analyze,
+    make_diag,
+)
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.runner import last_engine, run_tables
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "analysis_matrix.json")
+
+
+def _sink(*tables):
+    for t in tables:
+        pw.io.subscribe(t, on_change=lambda *a, **k: None)
+
+
+def build_lintful_graph():
+    """One graph that trips every statically reachable diagnostic."""
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str, age=int, score=float, grp=float),
+        [("a", 1, 1.5, 0.5), ("b", 2, 2.5, 0.5)],
+    )
+    # PWT101: lossy float -> int cast
+    lossy = t.select(name=t.name, age_i=pw.cast(int, t.score))
+    # PWT102: str == int comparison
+    bad_cmp = t.filter(t.name == t.age)
+    # PWT103: arithmetic on an optional operand
+    opt = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=dt.Optionalized(dt.INT)), [("a", 1)]
+    )
+    arith = opt.select(k=opt.k, w=opt.v + 1)
+    # PWT202: groupby on an unbounded-cardinality float key
+    by_float = t.groupby(t.grp).reduce(t.grp, c=pw.reducers.count())
+    # PWT303: reducer with no vector implementation
+    tup = t.groupby(t.name).reduce(t.name, xs=pw.reducers.tuple(t.age))
+    # PWT301 + PWT302: join keyed on an unhashable/unroutable dtype
+    left = t.select(
+        key=pw.apply_with_type(lambda s: [s], list, t.name), age=t.age
+    )
+    right = t.select(
+        key=pw.apply_with_type(lambda s: [s], list, t.name), score=t.score
+    )
+    joined = left.join(right, left.key == right.key).select(
+        left.age, right.score
+    )
+    # PWT305: non-deterministic UDF feeding a stateful operator
+    nd = t.select(name=t.name, r=pw.apply(lambda x: x + 1, t.age))
+    nd_red = nd.groupby(nd.name).reduce(nd.name, s=pw.reducers.sum(nd.r))
+    # PWT306: async UDF on an exchange-crossing path
+    au = t.select(name=t.name, r=pw.apply_async(lambda x: x * 2, t.age))
+    au_red = au.groupby(au.name).reduce(au.name, s=pw.reducers.sum(au.r))
+    # PWT201: windowby without behavior=
+    ts = pw.debug.table_from_rows(
+        pw.schema_from_types(at=int, v=int), [(1, 1)]
+    )
+    win = ts.windowby(
+        ts.at, window=pw.temporal.tumbling(duration=2)
+    ).reduce(c=pw.reducers.count())
+
+    # PWT203: iterate without iteration_limit=
+    def step(tab):
+        return tab.select(v=pw.this.v)
+
+    it = pw.iterate(step, tab=ts.select(v=ts.v))
+    # PWT111: anchored select whose consumer reads only one column
+    wide = t.select(name=t.name, age=t.age, score=t.score)
+    narrow = wide.select(name=wide.name)
+
+    _sink(
+        lossy, bad_cmp, arith, by_float, tup, joined, nd_red, au_red,
+        win, it, narrow,
+    )
+    # PWT110: computed after the sinks, read by nobody.  Returned so the
+    # caller keeps it alive — the parse graph tracks tables by weakref,
+    # and an already-collected table is (correctly) not analyzed
+    return t.select(doomed=t.age * 2)
+
+
+def _normalized(result):
+    return sorted(
+        (
+            {"code": f.code, "severity": str(f.severity), "message": f.message}
+            for f in result.findings
+        ),
+        key=lambda d: (d["code"], d["message"]),
+    )
+
+
+def _analyze_lintful():
+    dead = build_lintful_graph()
+    result = analyze(G, workers=4)
+    del dead
+    return result
+
+
+# ---------------------------------------------------------------------------
+# golden diagnostic matrix
+# ---------------------------------------------------------------------------
+
+
+def test_golden_diagnostic_matrix():
+    got = _normalized(_analyze_lintful())
+    with open(GOLDEN) as fh:
+        want = json.load(fh)
+    assert got == want, (
+        "diagnostics drifted from tests/golden/analysis_matrix.json; "
+        "if intentional, regenerate with `python tests/test_analysis.py "
+        "--regen`"
+    )
+
+
+def test_matrix_covers_enough_codes():
+    codes = {f.code for f in _analyze_lintful().findings}
+    assert len(codes) >= 8, codes
+    assert codes <= set(CODES)
+
+
+def test_every_finding_has_a_location():
+    for f in _analyze_lintful().findings:
+        assert f.location() != "<unknown>"
+        # user code built every op in this graph, so traces point here
+        assert f.trace is None or f.trace["file"].endswith(
+            "test_analysis.py"
+        )
+
+
+# ---------------------------------------------------------------------------
+# JSON round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_json_round_trip():
+    result = _analyze_lintful()
+    d = result.to_dict()
+    blob = json.dumps(d, sort_keys=True)
+    back = AnalysisResult.from_dict(json.loads(blob))
+    assert back.to_dict() == d
+    assert d["version"] == 1
+    assert d["summary"] == result.counts()
+    assert len(d["predictions"]) == len(result.predictions)
+
+
+def test_severity_model():
+    assert Severity.parse("warning") is Severity.WARNING
+    assert str(Severity.ERROR) == "error"
+    assert Severity.ERROR > Severity.WARNING > Severity.INFO
+    for code, (sev, title) in CODES.items():
+        assert code.startswith("PWT") and title
+
+
+# ---------------------------------------------------------------------------
+# clean graphs stay clean
+# ---------------------------------------------------------------------------
+
+
+def _clean_topologies():
+    """Representative well-formed pipelines (the shapes
+    test_engine_semantics.py exercises) — none should lint."""
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int, w=float),
+        [("a", 1, 1.0), ("b", 2, 2.0)],
+    )
+    yield t.select(k=t.k, doubled=t.v * 2)
+    yield t.filter(t.v > 1).select(k=pw.this.k, v=pw.this.v)
+    yield t.groupby(t.k).reduce(
+        t.k,
+        c=pw.reducers.count(),
+        s=pw.reducers.sum(t.v),
+        lo=pw.reducers.min(t.w),
+    )
+    other = t.select(k=t.k, label=t.k + "!")
+    yield t.join(other, t.k == other.k).select(t.v, other.label)
+    lists = pw.debug.table_from_rows(
+        pw.schema_from_types(i=int, vs=list), [(1, [1, 2])]
+    )
+    yield lists.flatten(pw.this.vs)
+    yield pw.Table.concat_reindex(
+        t.select(k=t.k, v=t.v), t.select(k=t.k, v=t.v + 10)
+    )
+    ts = pw.debug.table_from_rows(
+        pw.schema_from_types(at=int, v=int), [(1, 1)]
+    )
+    yield ts.windowby(
+        ts.at,
+        window=pw.temporal.tumbling(duration=2),
+        behavior=pw.temporal.common_behavior(cutoff=10),
+    ).reduce(c=pw.reducers.count())
+
+    def step(tab):
+        return tab.select(v=pw.this.v)
+
+    yield pw.iterate(step, iteration_limit=3, tab=ts.select(v=ts.v))
+
+
+def test_clean_graphs_have_zero_findings():
+    tables = list(_clean_topologies())
+    _sink(*tables)
+    result = analyze(G, workers=4)
+    assert result.findings == [], result.render_text()
+    # the eligible ops all predict columnar
+    predicted = {(p["op"], p["predicted"]) for p in result.predictions}
+    assert ("join", "columnar") in predicted
+    assert ("reduce", "columnar") in predicted
+    assert ("flatten", "columnar") in predicted
+
+
+def test_empty_graph_is_clean():
+    result = analyze(G)
+    assert result.findings == [] and result.predictions == []
+    assert result.max_severity() is None
+    assert result.render_text() == "no findings"
+
+
+# ---------------------------------------------------------------------------
+# trace fallback: findings survive without a user frame
+# ---------------------------------------------------------------------------
+
+
+def test_diagnostic_without_trace_keeps_operator_location():
+    d = make_diag(
+        "PWT303", "reduce cannot take the columnar path: x",
+        operator="reduce#7 (reduce#7 <- select#3)",
+    )
+    assert d.trace is None
+    assert d.location() == "<reduce#7 (reduce#7 <- select#3)>"
+    rendered = AnalysisResult(findings=[d]).render_text()
+    assert "reduce#7" in rendered
+    assert Diagnostic.from_dict(d.to_dict()) == d
+
+
+def test_marker_without_user_frame_still_reported():
+    # a marker recorded with no user frame (stdlib-built temporal op):
+    # the finding must survive with the operator fallback
+    from pathway_tpu.internals.parse_graph import MarkerSpec
+
+    G.markers.append(MarkerSpec("windowby", {"has_behavior": False}, None))
+    result = analyze(G)
+    (finding,) = [f for f in result.findings if f.code == "PWT201"]
+    assert finding.trace is None
+    assert finding.location() == "<windowby>"
+
+
+# ---------------------------------------------------------------------------
+# pw.run(analysis=...) surface
+# ---------------------------------------------------------------------------
+
+
+def _graph_with_warning():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(g=float, v=int), [(0.5, 1), (0.5, 2)]
+    )
+    res = t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.v))
+    _sink(res)
+
+
+def test_run_analysis_strict_raises():
+    _graph_with_warning()
+    with pytest.raises(AnalysisError) as exc:
+        pw.run(analysis="strict")
+    assert any(f.code == "PWT202" for f in exc.value.result.findings)
+    assert "PWT202" in str(exc.value)
+
+
+def test_run_analysis_warn_executes_and_attaches():
+    _graph_with_warning()
+    pw.run(analysis="warn")
+    eng = last_engine()
+    assert eng is not None and eng.analysis is not None
+    assert any(
+        f["code"] == "PWT202" for f in eng.analysis["findings"]
+    )
+
+
+def test_run_analysis_off_and_invalid():
+    _graph_with_warning()
+    pw.run(analysis="off")
+    assert last_engine().analysis is None
+    G.clear()
+    _graph_with_warning()
+    with pytest.raises(ValueError):
+        pw.run(analysis="nonsense")
+
+
+def test_run_analysis_strict_clean_graph_executes():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int), [("a", 1)]
+    )
+    rows = []
+    pw.io.subscribe(
+        t.select(k=t.k, v=t.v * 2),
+        on_change=lambda key, row, time, is_addition: rows.append(row),
+    )
+    pw.run(analysis="strict")
+    assert rows == [{"k": "a", "v": 2}]
+
+
+def test_status_endpoint_carries_analysis():
+    from pathway_tpu.internals.monitoring import PrometheusServer
+
+    _graph_with_warning()
+    pw.run(analysis="warn")
+    eng = last_engine()
+    status = PrometheusServer(eng).status_json()
+    assert status["analysis"] == eng.analysis
+    codes = [f["code"] for f in status["analysis"]["findings"]]
+    assert "PWT202" in codes
+
+
+# ---------------------------------------------------------------------------
+# prediction vs built plan (PWT399 wiring)
+# ---------------------------------------------------------------------------
+
+
+def test_verify_against_plan_clean():
+    from pathway_tpu.analysis import verify_against_plan
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int), [("a", 1), ("a", 2)]
+    )
+    red = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+    result = analyze(G, extra_tables=(red,))
+    (capture,) = run_tables(red)
+    verify_against_plan(capture.engine, result)
+    assert not [f for f in result.findings if f.code == "PWT399"]
+
+
+def test_verify_against_plan_detects_drift():
+    from pathway_tpu.analysis import verify_against_plan
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int), [("a", 1)]
+    )
+    red = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+    result = analyze(G, extra_tables=(red,))
+    # sabotage the prediction: claim the gate chose classic
+    for p in result.predictions:
+        p["predicted"] = "classic"
+    (capture,) = run_tables(red)
+    verify_against_plan(capture.engine, result)
+    drift = [f for f in result.findings if f.code == "PWT399"]
+    assert drift and all(str(f.severity) == "error" for f in drift)
+
+
+# ---------------------------------------------------------------------------
+# per-engine warn-once (exchange unroutable regression)
+# ---------------------------------------------------------------------------
+
+
+def test_warn_once_is_per_engine(caplog):
+    import logging
+
+    from pathway_tpu.engine.engine import Engine
+
+    e1 = Engine(worker_id=0, worker_count=1, metrics=False)
+    e2 = Engine(worker_id=0, worker_count=1, metrics=False)
+    with caplog.at_level(logging.WARNING, logger="pathway_tpu"):
+        assert e1.warn_once("exchange_unroutable", "unroutable on e1")
+        assert not e1.warn_once("exchange_unroutable", "again on e1")
+        # a different engine in the same process warns independently
+        assert e2.warn_once("exchange_unroutable", "unroutable on e2")
+    texts = [r.getMessage() for r in caplog.records]
+    assert texts.count("unroutable on e1") == 1
+    assert texts.count("unroutable on e2") == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI: pathway-tpu analyze
+# ---------------------------------------------------------------------------
+
+_CLEAN_SCRIPT = """
+import pathway_tpu as pw
+
+t = pw.debug.table_from_rows(
+    pw.schema_from_types(k=str, v=int), [("a", 1)]
+)
+res = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+pw.io.subscribe(res, on_change=lambda *a, **kw: None)
+pw.run()
+"""
+
+_LINTY_SCRIPT = """
+import pathway_tpu as pw
+
+t = pw.debug.table_from_rows(
+    pw.schema_from_types(g=float, v=int), [(0.5, 1)]
+)
+res = t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.v))
+pw.io.subscribe(res, on_change=lambda *a, **kw: None)
+pw.run()
+"""
+
+
+def _write_script(tmp_path, body, name="script.py"):
+    path = tmp_path / name
+    path.write_text(body)
+    return str(path)
+
+
+def test_cli_analyze_clean(tmp_path, capsys):
+    from pathway_tpu.cli import main
+
+    script = _write_script(tmp_path, _CLEAN_SCRIPT)
+    assert main(["analyze", script, "--fail-on", "warning"]) == 0
+    assert "no findings" in capsys.readouterr().out
+
+
+def test_cli_analyze_fail_on(tmp_path, capsys):
+    from pathway_tpu.cli import main
+
+    script = _write_script(tmp_path, _LINTY_SCRIPT)
+    # PWT202 is a warning: below the error bar, at the warning bar
+    assert main(["analyze", script, "--fail-on", "error"]) == 0
+    assert main(["analyze", script, "--fail-on", "warning"]) == 1
+    assert main(["analyze", script]) == 0  # report-only without --fail-on
+    out = capsys.readouterr().out
+    assert "PWT202" in out
+
+
+def test_cli_analyze_json(tmp_path, capsys):
+    from pathway_tpu.cli import main
+
+    script = _write_script(tmp_path, _LINTY_SCRIPT)
+    assert main(["analyze", script, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["version"] == 1
+    assert any(f["code"] == "PWT202" for f in payload["findings"])
+    # and the run() call was intercepted: nothing executed, graph intact
+    assert payload["predictions"]
+
+
+def test_cli_analyze_broken_script(tmp_path, capsys):
+    from pathway_tpu.cli import main
+
+    script = _write_script(tmp_path, "raise RuntimeError('boom')\n")
+    assert main(["analyze", script]) == 2
+    assert "boom" in capsys.readouterr().err
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--regen" in sys.argv:
+        G.clear()
+        os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+        with open(GOLDEN, "w") as fh:
+            json.dump(_normalized(_analyze_lintful()), fh, indent=2)
+            fh.write("\n")
+        print(f"wrote {GOLDEN}")

@@ -203,3 +203,89 @@ def test_ineligible_graphs_stay_classic():
     )
     run_tables(joined, sorted_vals, engine=eng)
     assert _columnar_stats(eng) == {}
+
+
+# ---------------------------------------------------------------------------
+# static analyzer over the benchmark topologies: the graphs we publish
+# numbers for must lint clean, and the analyzer's columnar predictions
+# must match what the build actually selects (PWT399 drift guard)
+# ---------------------------------------------------------------------------
+
+import os as _os
+import sys as _sys
+
+_REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+if _REPO not in _sys.path:
+    _sys.path.insert(0, _REPO)
+
+
+def _bench_builders():
+    from benchmarks.engine_bench import GRAPH_BUILDERS
+
+    return sorted(GRAPH_BUILDERS.items())
+
+
+@pytest.mark.perf_smoke
+def test_benchmark_graphs_lint_clean():
+    """`pathway-tpu analyze --fail-on=error` semantics over every
+    engine_bench topology: no error-severity findings, ever."""
+    from pathway_tpu.analysis import Severity, analyze
+
+    for name, builder in _bench_builders():
+        pw.G.clear()
+        result_table = builder()
+        result = analyze(pw.G, extra_tables=(result_table,), workers=1)
+        errors = [
+            f for f in result.findings if f.severity >= Severity.ERROR
+        ]
+        assert not errors, (name, result.render_text())
+
+
+@pytest.mark.perf_smoke
+def test_benchmark_predictions_match_selection():
+    """Prediction/selection parity on every engine_bench topology: the
+    analyzer must predict the columnar path AND verify_against_plan must
+    agree with the nodes the engine actually built."""
+    from pathway_tpu.analysis import analyze, verify_against_plan
+
+    expected_op = {
+        "reduce": "reduce",
+        "wordcount": "reduce",
+        "join": "join",
+        "flatten": "flatten",
+    }
+    for name, builder in _bench_builders():
+        pw.G.clear()
+        result_table = builder()
+        result = analyze(pw.G, extra_tables=(result_table,), workers=1)
+        preds = {
+            (p["op"], p["predicted"])
+            for p in result.predictions
+            if p["anchored"]
+        }
+        assert (expected_op[name], "columnar") in preds, (name, preds)
+        (capture,) = run_tables(result_table)
+        verify_against_plan(capture.engine, result)
+        drift = [f for f in result.findings if f.code == "PWT399"]
+        assert not drift, (name, result.render_text())
+
+
+@pytest.mark.perf_smoke
+def test_scaling_bench_graph_lints_clean(tmp_path):
+    """The scaling benchmark's wordcount pipeline (fs json read ->
+    groupby(word).count -> csv write) also passes --fail-on=error and
+    predicts the columnar reduce."""
+    from benchmarks.scaling_bench import build_wordcount_graph
+    from pathway_tpu.analysis import Severity, analyze
+
+    in_dir = tmp_path / "input"
+    in_dir.mkdir()
+    (in_dir / "a.jsonl").write_text('{"word": "x"}\n{"word": "y"}\n')
+    pw.G.clear()
+    build_wordcount_graph(str(in_dir), str(tmp_path / "out.csv"))
+    result = analyze(pw.G, workers=1)
+    errors = [f for f in result.findings if f.severity >= Severity.ERROR]
+    assert not errors, result.render_text()
+    assert [
+        (p["op"], p["predicted"]) for p in result.predictions
+    ] == [("reduce", "columnar")]
