@@ -338,30 +338,14 @@ def _rtt_floor_ms() -> float:
 
 
 def _device_healthy(timeout_s: float = 120.0) -> str | None:
-    """Probe the device in a SUBPROCESS with a hard timeout: behind the
-    tunnel a dead backend hangs even trivial dispatches indefinitely, and
-    an in-process hang cannot be interrupted. Returns an error string
-    when the device is unusable."""
-    import subprocess
-    import sys
+    """Pre-flight device check, shared with the runtime monitor (the
+    probe was born here in round 5; it now lives in
+    internals/device_probe.py and also feeds pathway_device_rtt_ms and
+    the /status "device" key). Returns an error string when the device
+    is unusable."""
+    from pathway_tpu.internals.device_probe import device_healthy
 
-    code = (
-        "import jax, jax.numpy as jnp, numpy as np;"
-        "print(float(np.asarray(jax.jit(lambda a: (a@a).sum())"
-        "(jnp.ones((64,64))))))"
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
-            timeout=timeout_s,
-            text=True,
-        )
-        if proc.returncode != 0:
-            return f"device probe failed: {proc.stderr[-300:]}"
-        return None
-    except subprocess.TimeoutExpired:
-        return f"device probe hung for {timeout_s}s (tunnel down?)"
+    return device_healthy(timeout_s)
 
 
 def _host_only_numbers(timeout_s: float = 600.0) -> dict | None:
@@ -503,6 +487,78 @@ def _observability_overhead() -> float | None:
         return None
 
 
+def _tracing_overhead() -> float | None:
+    """Cost of epoch tracing at DEFAULT sampling (every 16th epoch) on
+    top of the always-on metrics layer: A/B of PATHWAY_TRACE unset vs
+    =0, both arms with metrics enabled, same microbench as
+    _observability_overhead.  Returns fractional overhead, None on
+    failure."""
+    from time import perf_counter
+
+    from pathway_tpu.engine.engine import (
+        Engine,
+        InputQueueSource,
+        RowwiseNode,
+    )
+    from pathway_tpu.engine.value import ref_scalar
+
+    rows, ticks = 512, 40
+    deltas = [(ref_scalar("k", i), (i,), 1) for i in range(rows)]
+
+    def ident(keys, cols):
+        return cols[0]
+
+    def run_once(trace: str | None) -> float:
+        prev = os.environ.get("PATHWAY_TRACE")
+        if trace is None:  # default: enabled, every-16th-epoch sampling
+            os.environ.pop("PATHWAY_TRACE", None)
+        else:
+            os.environ["PATHWAY_TRACE"] = trace
+        try:
+            eng = Engine()  # TraceStore reads the env at construction
+        finally:
+            if prev is None:
+                os.environ.pop("PATHWAY_TRACE", None)
+            else:
+                os.environ["PATHWAY_TRACE"] = prev
+        src = InputQueueSource(eng)
+        node = src
+        for _ in range(3):
+            node = RowwiseNode(eng, [node], ident)
+        try:
+            t = 2
+            for _ in range(8):  # warmup
+                src.push(t, deltas)
+                eng.process_time(t)
+                t += 2
+            t0 = perf_counter()
+            for _ in range(ticks):
+                src.push(t, deltas)
+                eng.process_time(t)
+                t += 2
+            return perf_counter() - t0
+        finally:
+            eng._gc_unfreeze()
+
+    try:
+        import gc
+
+        gc.collect()
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            on, off = [], []
+            for _ in range(5):
+                on.append(run_once(None))
+                off.append(run_once("0"))
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+        return round(min(on) / min(off) - 1.0, 4)
+    except Exception:  # noqa: BLE001 — never sink the main bench
+        return None
+
+
 def main() -> None:
     err = _device_healthy()
     if err is not None:
@@ -538,6 +594,7 @@ def main() -> None:
                     "host_only": host,
                     "exchange_throughput": exchange,
                     "observability_overhead": _observability_overhead(),
+                    "tracing_overhead": _tracing_overhead(),
                 }
             )
         )
@@ -625,6 +682,7 @@ def main() -> None:
                 "n_docs": N_DOCS,
                 "exchange_throughput": _exchange_numbers(),
                 "observability_overhead": _observability_overhead(),
+                "tracing_overhead": _tracing_overhead(),
                 "device": _device_name(),
                 **_mfu_facts(docs_per_sec, docs),
                 "device_phase_docs_per_sec": round(device_rate, 1),

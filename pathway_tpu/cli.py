@@ -77,6 +77,18 @@ def _analyze(args) -> int:
     return main_analyze(args)
 
 
+def _trace(args) -> int:
+    from pathway_tpu.internals.trace_tool import main_trace
+
+    return main_trace(args)
+
+
+def _status(args) -> int:
+    from pathway_tpu.internals.trace_tool import main_status
+
+    return main_status(args)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="pathway")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -97,6 +109,48 @@ def main(argv=None) -> int:
         help="exit 1 when a finding at or above this severity exists",
     )
     analyze.set_defaults(func=_analyze)
+
+    trace = sub.add_parser(
+        "trace",
+        help="run a script with epoch tracing on and dump a "
+        "Chrome/Perfetto trace (open at https://ui.perfetto.dev)",
+    )
+    trace.add_argument("script", help="python script that calls pw.run")
+    trace.add_argument(
+        "--out", default="trace.json", help="output trace file"
+    )
+    trace.add_argument(
+        "--duration",
+        type=float,
+        default=10.0,
+        help="terminate a streaming run after this many seconds",
+    )
+    trace.add_argument(
+        "--sample",
+        type=int,
+        default=1,
+        help="trace every Nth epoch (1 = every epoch)",
+    )
+    trace.set_defaults(func=_trace)
+
+    status = sub.add_parser(
+        "status",
+        help="summarize the /status endpoint of a running job "
+        "(pw.run(with_http_server=True))",
+    )
+    status.add_argument(
+        "--url", default=None, help="full /status URL (overrides --port)"
+    )
+    status.add_argument(
+        "--port",
+        type=int,
+        default=20000,
+        help="local monitoring port (default: worker 0's 20000)",
+    )
+    status.add_argument(
+        "--json", action="store_true", help="raw JSON output"
+    )
+    status.set_defaults(func=_status)
 
     spawn = sub.add_parser("spawn", help="run a program on multiple workers")
     spawn.add_argument("--threads", "-t", type=int, default=1)

@@ -316,8 +316,28 @@ class Engine:
     def process_time(self, time: int) -> None:
         self.current_time = time
         self._scheduled_times.discard(time)
-        if self.metrics is not None:
-            self._process_time_metrics(time, self.metrics)
+        m = self.metrics
+        if m is not None:
+            sw = m.slow_watch
+            if sw is not None:
+                sw.begin(time)
+            tr = m.trace
+            if tr is not None and tr.should_sample(time):
+                # sampled epoch: the traced loop variant also captures
+                # per-node spans, and watermark advancement gets a span
+                # of its own before the epoch record closes
+                self._process_time_traced(time, m, tr)
+                perf = time_mod.perf_counter
+                wm0 = perf()
+                for node in self.nodes:
+                    node.on_time_end(time)
+                tr.end_epoch(wm0, perf())
+            else:
+                self._process_time_metrics(time, m)
+                for node in self.nodes:
+                    node.on_time_end(time)
+            if sw is not None:
+                sw.end()
         else:
             try:
                 for node in self.nodes:
@@ -325,8 +345,8 @@ class Engine:
                     node.process(time)
             finally:
                 self.current_node = None
-        for node in self.nodes:
-            node.on_time_end(time)
+            for node in self.nodes:
+                node.on_time_end(time)
         self._gc_pulse()
 
     def _process_time_metrics(self, time: int, m) -> None:
@@ -337,7 +357,8 @@ class Engine:
         (~0.3us) rides on the successor's bucket rather than doubling the
         timer cost."""
         perf = time_mod.perf_counter
-        rec_append = m.recorder.events.append
+        rec = m.recorder
+        rec_append = rec.events.append
         err_log = self.error_log
         errs_seen = len(err_log)
         errs_tick = 0
@@ -361,9 +382,10 @@ class Engine:
                     errs_seen += n_err
                     errs_tick += n_err
                 if rows or n_err or dt > 1e-4:
+                    rec.seq = seq = rec.seq + 1
                     rec_append(
                         (t_now, time, "node", node._idx, node.name,
-                         dt, rows, n_err)
+                         dt, rows, n_err, seq)
                     )
         finally:
             self.current_node = None
@@ -371,9 +393,64 @@ class Engine:
         m.tick_hist.observe(t_end - t0)
         m.ticks += 1
         m.last_tick_monotonic = time_mod.monotonic()
+        rec.seq = seq = rec.seq + 1
         rec_append(
             (t_end, time, "tick", -1, "", t_end - t0,
-             self.stats_rows - rows_tick0, errs_tick)
+             self.stats_rows - rows_tick0, errs_tick, seq)
+        )
+
+    def _process_time_traced(self, time: int, m, tr) -> None:
+        """The sampled-epoch loop variant: identical to
+        ``_process_time_metrics`` plus one tuple append per active node
+        into the epoch's span list (internals/tracing.py TraceStore).
+        Duplicated rather than flag-checked so the unsampled path keeps
+        its instruction count."""
+        perf = time_mod.perf_counter
+        rec = m.recorder
+        rec_append = rec.events.append
+        err_log = self.error_log
+        errs_seen = len(err_log)
+        errs_tick = 0
+        rows_tick0 = self.stats_rows
+        t0 = perf()
+        ep = tr.begin_epoch(time, t0)
+        spans_append = ep.spans.append
+        t_prev = t0
+        try:
+            for node in self.nodes:
+                self.current_node = node
+                rows0 = self.stats_rows
+                node.process(time)
+                t_now = perf()
+                dt = t_now - t_prev
+                node._lat_child.observe(dt)
+                rows = self.stats_rows - rows0
+                n_err = len(err_log) - errs_seen
+                if rows:
+                    node._rows_out += rows
+                if n_err:
+                    errs_seen += n_err
+                    errs_tick += n_err
+                if rows or n_err or dt > 1e-5:
+                    spans_append((node._idx, node.name, t_prev, dt, rows))
+                if rows or n_err or dt > 1e-4:
+                    rec.seq = seq = rec.seq + 1
+                    rec_append(
+                        (t_now, time, "node", node._idx, node.name,
+                         dt, rows, n_err, seq)
+                    )
+                t_prev = t_now
+        finally:
+            self.current_node = None
+        t_end = perf()
+        ep.t1 = t_end
+        m.tick_hist.observe(t_end - t0)
+        m.ticks += 1
+        m.last_tick_monotonic = time_mod.monotonic()
+        rec.seq = seq = rec.seq + 1
+        rec_append(
+            (t_end, time, "tick", -1, "", t_end - t0,
+             self.stats_rows - rows_tick0, errs_tick, seq)
         )
 
     def dump_diagnostics(self, *, reason: str = "manual") -> dict:
@@ -384,6 +461,29 @@ class Engine:
         from pathway_tpu.internals.metrics import dump_diagnostics
 
         return dump_diagnostics(self, reason=reason)
+
+    def dump_trace(self, path: str | None = None) -> dict:
+        """Chrome/Perfetto ``trace_event`` JSON for every sampled epoch,
+        merged across ALL workers: thread siblings are read directly,
+        remote processes contribute via one coordinator ``agree`` round —
+        which makes this an SPMD collective in multiprocess runs (every
+        process must call it at the same point, exactly once).  Writes to
+        ``path`` when given; always returns the trace dict."""
+        from pathway_tpu.internals.tracing import (
+            build_chrome_trace,
+            gather_trace_events,
+            validate_chrome_trace,
+        )
+
+        events = gather_trace_events(self)
+        trace = build_chrome_trace(events)
+        validate_chrome_trace(trace)
+        if path is not None:
+            import json as json_mod
+
+            with open(path, "w") as fh:
+                json_mod.dump(trace, fh)
+        return trace
 
     def _dump_node_timing(self) -> None:
         """PATHWAY_NODE_TIMING_LOG dump (the reference's
@@ -532,6 +632,9 @@ class Engine:
         finally:
             self._gc_unfreeze()
             self._dump_node_timing()
+            m = self.metrics
+            if m is not None and m.slow_watch is not None:
+                m.slow_watch.stop()
             if self.error_log and self.metrics is not None:
                 try:
                     self.dump_diagnostics(reason="error_log")
@@ -888,12 +991,14 @@ class SubscribeNode(Node):
         on_time_end: Callable | None = None,
         on_end: Callable | None = None,
         column_names: List[str] | None = None,
+        sink_name: str | None = None,
     ):
         super().__init__(engine, [input_])
         self._on_change = on_change
         self._on_time_end = on_time_end
         self._on_end = on_end
         self.column_names = column_names or []
+        self.sink_name = sink_name
         self._saw_data_at: set[int] = set()
 
     def process(self, time: int) -> None:
@@ -907,8 +1012,16 @@ class SubscribeNode(Node):
                 self._on_change(key=key, row=row, time=time, is_addition=diff > 0)
 
     def on_time_end(self, time: int) -> None:
-        if self._on_time_end is not None and time in self._saw_data_at:
-            self._on_time_end(time)
+        if time in self._saw_data_at:
+            if self._on_time_end is not None:
+                self._on_time_end(time)
+            # sink freshness: the epoch's rows have now fully left the
+            # graph through this sink (callbacks included)
+            m = self.engine.metrics
+            if m is not None:
+                m.note_sink_emit(
+                    self.sink_name or f"{self.name}#{self._idx}", time
+                )
 
     def on_end(self) -> None:
         if self._on_end is not None:

@@ -113,6 +113,21 @@ class Coordinator:
     def collect(self, channel: int, time: int) -> list:
         return []
 
+    def send_stamp(
+        self, dest: int, channel: int, time: int, origin: int, wall: float
+    ) -> None:
+        """Tracing stamp toward one destination: (origin worker, epoch,
+        send wall-time).  Fire-and-forget — stamps ride the same per-peer
+        FIFO as data/punct frames but are NEVER counted toward
+        punctuation, so they cannot affect collect() semantics."""
+
+    def take_stamps(self, channel: int, time: int) -> dict:
+        """Pop stamps received for channel@time:
+        {origin: (send_wall, recv_wall)}.  Called unconditionally by the
+        exchange node after collect() so stamp state stays bounded even
+        when peers' sampling config diverges."""
+        return {}
+
     def close(self) -> None:
         pass
 
@@ -210,6 +225,8 @@ class TcpCoordinator(Coordinator):
         self._data: Dict[Tuple[int, int], list] = {}
         # (channel, time) -> set of workers that punctuated
         self._punct: Dict[Tuple[int, int], set] = {}
+        # (channel, time) -> {origin: (send_wall, recv_wall)} tracing stamps
+        self._stamps: Dict[Tuple[int, int], dict] = {}
         # round -> {worker: payload}
         self._coord: Dict[int, Dict[int, Any]] = {}
         self._round = 0
@@ -457,6 +474,11 @@ class TcpCoordinator(Coordinator):
                     elif kind == "punct":
                         _, channel, time = msg
                         self._punct.setdefault((channel, time), set()).add(peer)
+                    elif kind == "stamp":
+                        _, channel, time, origin, wall = msg
+                        self._stamps.setdefault((channel, time), {})[
+                            origin
+                        ] = (wall, time_mod.time())
                     elif kind == "coord":
                         _, round_no, payload = msg
                         self._coord.setdefault(round_no, {})[peer] = payload
@@ -536,6 +558,17 @@ class TcpCoordinator(Coordinator):
 
     def punctuate_one(self, dest: int, channel: int, time: int) -> None:
         self._dispatch(dest, self._encode_frame(("punct", channel, time)))
+
+    def send_stamp(
+        self, dest: int, channel: int, time: int, origin: int, wall: float
+    ) -> None:
+        self._dispatch(
+            dest, self._encode_frame(("stamp", channel, time, origin, wall))
+        )
+
+    def take_stamps(self, channel: int, time: int) -> dict:
+        with self._cv:
+            return self._stamps.pop((channel, time), {})
 
     def collect(self, channel: int, time: int, timeout: float = 600.0) -> list:
         """Block until every peer punctuated channel@time; return received
@@ -664,6 +697,8 @@ class ThreadGroupCoordinator:
         self._data: Dict[tuple, dict] = {}
         # (dest_thread, channel, time) -> {sender_global}
         self._punct: Dict[tuple, set] = {}
+        # (dest_thread, channel, time) -> {origin: send_wall} tracing stamps
+        self._stamps: Dict[tuple, dict] = {}
         # engines register themselves here (Engine.__init__) so worker 0's
         # Prometheus / status server can export every thread worker
         self.engines: List[Any] = []
@@ -714,6 +749,12 @@ class ThreadGroupCoordinator:
         with self._cv:
             self._punct.setdefault((dest_t, channel, time), set()).add(sender)
             self._cv.notify_all()
+
+    def stamp_local(
+        self, dest_t: int, channel: int, time: int, origin: int, wall: float
+    ) -> None:
+        with self._cv:
+            self._stamps.setdefault((dest_t, channel, time), {})[origin] = wall
 
 
 class _ThreadWorkerCoordinator(Coordinator):
@@ -830,6 +871,44 @@ class _ThreadWorkerCoordinator(Coordinator):
             g.tcp.punctuate_one(
                 dest_p, self._wire(channel, dest_t, self.thread_index), time
             )
+
+    def send_stamp(
+        self, dest: int, channel: int, time: int, origin: int, wall: float
+    ) -> None:
+        g = self.group
+        dest_p, dest_t = divmod(dest, g.threads)
+        if dest_p == g.process_id:
+            if dest_t != self.thread_index:
+                g.stamp_local(dest_t, channel, time, origin, wall)
+        else:
+            g.tcp.send_stamp(
+                dest_p,
+                self._wire(channel, dest_t, self.thread_index),
+                time,
+                origin,
+                wall,
+            )
+
+    def take_stamps(self, channel: int, time: int) -> dict:
+        g = self.group
+        me_t = self.thread_index
+        out: dict = {}
+        with g._cv:
+            local = g._stamps.pop((me_t, channel, time), None)
+        if local:
+            # local handoffs have no socket: receive time is the moment
+            # this worker drains the stamp (≈ queue wait until collect)
+            now = time_mod.time()
+            for origin, wall in local.items():
+                out[origin] = (wall, now)
+        if g.tcp is not None:
+            for sender_t in range(g.threads):
+                out.update(
+                    g.tcp.take_stamps(
+                        self._wire(channel, me_t, sender_t), time
+                    )
+                )
+        return out
 
     def collect(self, channel: int, time: int, timeout: float = 600.0) -> list:
         g = self.group
@@ -983,6 +1062,19 @@ def _make_exchange_node():
                 if reg is not None
                 else None
             )
+            # per-peer transit/queue latency from the tracing stamps
+            # (sampled epochs only — the stamps that feed cross-worker
+            # trace edges also feed this histogram)
+            self._m_transit = (
+                reg.histogram(
+                    "pathway_exchange_transit_seconds",
+                    help="send->receive wall time of exchange stamps "
+                    "(per origin peer, sampled epochs)",
+                    labels=("channel", "peer"),
+                )
+                if reg is not None
+                else None
+            )
 
         def _note_unroutable(self, n: int) -> None:
             if self._m_unroutable is not None:
@@ -999,12 +1091,32 @@ def _make_exchange_node():
 
         def process(self, time: int) -> None:
             deltas = self.take(0)
-            coord = self.engine.coord
+            engine = self.engine
+            coord = engine.coord
             if deltas:
                 self.rows_processed += len(deltas)
                 self.batches_processed += 1
-            own = self._scatter(deltas, coord, time)
+            m = engine.metrics
+            tr = m.trace if m is not None else None
+            # sampling is SPMD-deterministic (time % N), so every worker
+            # stamps exactly the epochs every other worker samples
+            stamp = tr is not None and tr.in_epoch(time)
+            own = self._scatter(deltas, coord, time, stamp)
             received = coord.collect(self.channel, time)
+            # stamps are drained UNCONDITIONALLY so the coordinator's
+            # stamp buffers stay bounded even if a peer's sampling env
+            # diverges; they arrive before collect() returns because they
+            # ride the same per-peer FIFO ahead of the punctuation
+            stamps = coord.take_stamps(self.channel, time)
+            if stamps:
+                transit = self._m_transit
+                for origin, (sw, rw) in sorted(stamps.items()):
+                    if transit is not None:
+                        transit.labels(str(self.channel), str(origin)).observe(
+                            max(0.0, rw - sw)
+                        )
+                    if stamp:
+                        tr.note_edge(time, self.channel, origin, sw, rw)
             # deterministic merge without a per-row sort: received deltas
             # arrive concatenated in sender-id order (each sender's local
             # order is SPMD-deterministic), own part appended last — the
@@ -1017,12 +1129,24 @@ def _make_exchange_node():
             for s in range(0, len(part), _CHUNK):
                 coord.send_data(w, self.channel, time, part[s : s + _CHUNK])
 
-        def _scatter(self, deltas, coord, time: int) -> list:
+        def _send_stamps(self, coord, time: int, w_count: int) -> None:
+            """One tracing stamp per peer, sent right before the
+            punctuation that covers this epoch (per-peer FIFO => stamps
+            land before the receiver's collect() returns)."""
+            me = coord.worker_id
+            channel = self.channel
+            for w in range(w_count):
+                if w != me:
+                    coord.send_stamp(w, channel, time, me, time_mod.time())
+
+        def _scatter(self, deltas, coord, time: int, stamp: bool = False) -> list:
             """Route the batch, ship every remote partition, punctuate.
             Returns the partition this worker keeps for itself."""
             w_count = coord.worker_count
             me = coord.worker_id
             if not deltas:
+                if stamp:
+                    self._send_stamps(coord, time, w_count)
                 coord.punctuate(self.channel, time)
                 return []
             if self.route_fn is None:
@@ -1037,12 +1161,19 @@ def _make_exchange_node():
                         )
                     for w in range(w_count):
                         if w != me:
+                            if stamp:
+                                coord.send_stamp(
+                                    w, self.channel, time, me,
+                                    time_mod.time(),
+                                )
                             coord.punctuate_one(w, self.channel, time)
                 else:
                     self.path = "classic"
                     for w in range(w_count):
                         if w != me:
                             self._send_chunked(coord, w, time, list(deltas))
+                    if stamp:
+                        self._send_stamps(coord, time, w_count)
                     coord.punctuate(self.channel, time)
                 return list(deltas)
             parts = (
@@ -1066,6 +1197,8 @@ def _make_exchange_node():
                 for w in range(w_count):
                     if w != me and parts[w]:
                         self._send_chunked(coord, w, time, parts[w])
+                if stamp:
+                    self._send_stamps(coord, time, w_count)
                 coord.punctuate(self.channel, time)
                 return parts[me]
             self.path = "columnar"
@@ -1087,6 +1220,10 @@ def _make_exchange_node():
                     ):
                         part = consolidate(part)
                     self._send_chunked(coord, w, time, part)
+                if stamp:
+                    coord.send_stamp(
+                        w, self.channel, time, me, time_mod.time()
+                    )
                 # eager punctuation: dest w's collect() can unblock as
                 # soon as ITS partition is on the wire (the per-peer FIFO
                 # keeps data before punct), not after our full fan-out

@@ -14,6 +14,7 @@ Frame layout (inside the existing 4-byte length prefix):
       0x02 data  : u32 channel, zz64 time, deltas
       0x03 punct : u32 channel, zz64 time
       0x04 coord : u64 round, value payload
+      0x05 stamp : u32 channel, zz64 time, u32 origin, f64 send_wall
     deltas  := uvarint n, n x (key(16B LE) zz diff, uvarint ncols, values)
     value   := tag(1B) payload   (tags below)
 
@@ -67,6 +68,13 @@ MSG_HELLO = 0x01
 MSG_DATA = 0x02
 MSG_PUNCT = 0x03
 MSG_COORD = 0x04
+# tracing stamp: u32 channel, zz64 time, u32 origin worker, f64 send
+# wall-time.  Deliberately a SEPARATE message so data frames stay
+# byte-identical whether tracing samples an epoch or not (the exchange
+# parity tests hash data frames; wall-times would break determinism).
+# Python-codec only: the native twin predates it and must keep rejecting
+# unknown types, so encode/decode route 0x05 around the ext explicitly.
+MSG_STAMP = 0x05
 
 _pack_d = struct.Struct("<d")
 _pack_u32 = struct.Struct("<I")
@@ -532,6 +540,12 @@ def py_encode_message(msg: tuple) -> bytes:
         out.append(MSG_COORD)
         out += _pack_u64.pack(msg[1])
         encode_value(out, msg[2])
+    elif kind == "stamp":
+        out.append(MSG_STAMP)
+        out += _pack_u32.pack(msg[1])
+        _zigzag(out, msg[2])
+        out += _pack_u32.pack(msg[3])
+        out += _pack_d.pack(msg[4])
     else:
         raise WireError(f"unknown message kind {kind!r}")
     return bytes(out)
@@ -567,6 +581,12 @@ def _py_decode_message(blob: bytes) -> tuple:
     elif kind == MSG_COORD:
         round_no = _pack_u64.unpack(r.take(8))[0]
         msg = ("coord", round_no, decode_value(r))
+    elif kind == MSG_STAMP:
+        channel = _pack_u32.unpack(r.take(4))[0]
+        time = r.zigzag()
+        origin = _pack_u32.unpack(r.take(4))[0]
+        wall = _pack_d.unpack(r.take(8))[0]
+        msg = ("stamp", channel, time, origin, wall)
     else:
         raise WireError(f"unknown message type {kind}")
     if r.pos != r.end:
@@ -589,6 +609,9 @@ def _load_native():
 
 
 def encode_message(msg: tuple) -> bytes:
+    if msg[0] == "stamp":
+        # newer than the native twin: pure-Python codec only
+        return py_encode_message(msg)
     ext = _load_native()
     if ext is not None:
         return ext.encode_message(msg)
@@ -596,6 +619,8 @@ def encode_message(msg: tuple) -> bytes:
 
 
 def decode_message(blob: bytes) -> tuple:
+    if blob and blob[0] == MSG_STAMP:
+        return py_decode_message(blob)
     ext = _load_native()
     if ext is not None:
         try:
@@ -614,7 +639,7 @@ def encode_frame(msg: tuple) -> bytes:
     """The full length-prefixed wire frame for `msg` in one buffer — the
     native path reserves the 4-byte length slot up front and patches it
     after the body lands, avoiding the `pack(n) + blob` concat copy."""
-    ext = _load_native()
+    ext = None if msg[0] == "stamp" else _load_native()
     if ext is not None and hasattr(ext, "encode_frame"):
         return ext.encode_frame(msg)
     blob = encode_message(msg)

@@ -1,0 +1,177 @@
+"""Accelerator health probe, promoted from bench.py into the runtime.
+
+Round 5's tunnel outage was diagnosed by a hand-built one-off probe;
+this module makes the same signal a standing part of monitoring: the
+probe runs a trivial jit dispatch in a SUBPROCESS with a hard timeout
+(behind the device tunnel a dead backend hangs even trivial dispatches
+indefinitely, and an in-process hang cannot be interrupted), and the
+``DeviceMonitor`` repeats it on a period, exporting
+
+  pathway_device_rtt_ms   gauge — round-trip of one tiny jit dispatch
+  pathway_device_healthy  gauge — 1 healthy / 0 down
+
+plus a ``"device"`` key in the /status JSON.  bench.py delegates its
+pre-flight health check to ``device_healthy`` here (one code path).
+
+Config: ``PATHWAY_DEVICE_PROBE=0`` disables the monitor entirely;
+``PATHWAY_DEVICE_PROBE_INTERVAL_S`` sets the period (default 300 s —
+the probe spawns a Python subprocess, so it must stay rare).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time as time_mod
+from typing import Any, Dict, Optional, Tuple
+
+# compile once, then time a SECOND dispatch: the first call's compile
+# latency is not the tunnel RTT signal we are after
+_PROBE_CODE = (
+    "import time, jax, jax.numpy as jnp, numpy as np;"
+    "f = jax.jit(lambda a: (a@a).sum());"
+    "x = jnp.ones((64,64));"
+    "np.asarray(f(x));"
+    "t0 = time.perf_counter();"
+    "np.asarray(f(x));"
+    "print((time.perf_counter()-t0)*1000.0)"
+)
+
+
+def device_probe(
+    timeout_s: float = 120.0,
+) -> Tuple[Optional[float], Optional[str]]:
+    """One subprocess probe.  Returns ``(rtt_ms, None)`` when healthy,
+    ``(None, error_string)`` when the device is unusable."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+        )
+        if proc.returncode != 0:
+            return None, f"device probe failed: {proc.stderr[-300:]}"
+        try:
+            rtt = float(proc.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            rtt = None
+        return rtt, None
+    except subprocess.TimeoutExpired:
+        return None, f"device probe hung for {timeout_s}s (tunnel down?)"
+
+
+def device_healthy(timeout_s: float = 120.0) -> Optional[str]:
+    """bench.py-compatible wrapper: error string when the device is
+    unusable, None when healthy."""
+    _rtt, err = device_probe(timeout_s)
+    return err
+
+
+class DeviceMonitor:
+    """Periodic device-health prober with its own metrics registry.
+
+    The registry uses pull-time callback gauges over ``self.last``, so a
+    scrape never triggers a probe — the daemon thread owns the cadence.
+    ``probe`` is injectable for tests (the default spawns a subprocess)."""
+
+    def __init__(
+        self,
+        *,
+        interval_s: float | None = None,
+        timeout_s: float = 120.0,
+        probe=device_probe,
+    ):
+        from pathway_tpu.internals.metrics import MetricsRegistry
+
+        if interval_s is None:
+            try:
+                interval_s = float(
+                    os.environ.get("PATHWAY_DEVICE_PROBE_INTERVAL_S", 300)
+                )
+            except ValueError:
+                interval_s = 300.0
+        self.interval_s = max(1.0, interval_s)
+        self.timeout_s = timeout_s
+        self.probe = probe
+        self.last: Dict[str, Any] = {"status": "not_started"}
+        reg = self.metrics = MetricsRegistry()
+        reg.gauge(
+            "pathway_device_rtt_ms",
+            help="round-trip of one tiny jit dispatch on the accelerator "
+            "(subprocess probe; absent until the first probe completes)",
+            callback=lambda: self.last.get("rtt_ms"),
+        )
+        reg.gauge(
+            "pathway_device_healthy",
+            help="1 when the last device probe succeeded, 0 when it "
+            "failed or hung",
+            callback=lambda: (
+                None
+                if "healthy" not in self.last
+                else (1 if self.last["healthy"] else 0)
+            ),
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def probe_once(self) -> Dict[str, Any]:
+        rtt, err = self.probe(self.timeout_s)
+        self.last = {
+            "status": "healthy" if err is None else "down",
+            "healthy": err is None,
+            "rtt_ms": round(rtt, 3) if rtt is not None else None,
+            "error": err,
+            "checked_at": time_mod.time(),
+        }
+        return self.last
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="pw-device-probe"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            try:
+                self.probe_once()
+            except Exception as exc:  # noqa: BLE001 — monitor must survive
+                self.last = {"status": "down", "healthy": False,
+                             "error": f"{type(exc).__name__}: {exc}"}
+            if self._stop.wait(self.interval_s):
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+# one monitor per process, however many PrometheusServers start
+_monitor: Optional[DeviceMonitor] = None
+_monitor_lock = threading.Lock()
+
+
+def ensure_monitor() -> Optional[DeviceMonitor]:
+    """Start (once) and return the process-wide device monitor; None when
+    PATHWAY_DEVICE_PROBE=0."""
+    global _monitor
+    if os.environ.get("PATHWAY_DEVICE_PROBE") == "0":
+        return None
+    with _monitor_lock:
+        if _monitor is None:
+            _monitor = DeviceMonitor()
+            _monitor.start()
+        return _monitor
+
+
+def device_status() -> Dict[str, Any]:
+    """The ``"device"`` key for /status."""
+    if os.environ.get("PATHWAY_DEVICE_PROBE") == "0":
+        return {"status": "disabled"}
+    if _monitor is None:
+        return {"status": "not_started"}
+    return dict(_monitor.last)

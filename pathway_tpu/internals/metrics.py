@@ -334,13 +334,20 @@ class FlightRecorder:
     """Bounded ring of recent engine events.
 
     The hot path appends raw tuples
-    ``(perf_t, engine_time, kind, node_idx, name, duration_s, rows, errors)``
-    straight into a deque (one C-level append per event); ``tail()``
-    converts to dicts with wall-clock timestamps only when a dump is
-    actually requested."""
+    ``(perf_t, engine_time, kind, node_idx, name, duration_s, rows,
+    errors, seq)`` straight into a deque (one C-level append per event);
+    ``tail()`` converts to dicts with wall-clock timestamps only when a
+    dump is actually requested.
 
-    def __init__(self, capacity: int = 512):
+    ``seq`` is a per-recorder monotonic sequence number and every tail
+    entry also carries the worker id, so multi-worker diagnostics merge
+    in causal order by (engine_time, seq, worker) — wall clocks skew
+    across processes, (epoch, seq) does not (SPMD lockstep)."""
+
+    def __init__(self, capacity: int = 512, worker: int = 0):
         self.events: deque = deque(maxlen=capacity)
+        self.worker = worker
+        self.seq = 0
         # perf_counter -> epoch offset, sampled once: events stamp the
         # cheap monotonic clock and dumps convert to wall time
         self._epoch = time_mod.time() - time_mod.perf_counter()
@@ -356,6 +363,7 @@ class FlightRecorder:
         rows: int = 0,
         errors: int = 0,
     ) -> None:
+        self.seq = seq = self.seq + 1
         self.events.append(
             (
                 time_mod.perf_counter(),
@@ -366,12 +374,14 @@ class FlightRecorder:
                 duration_s,
                 rows,
                 errors,
+                seq,
             )
         )
 
     def tail(self, n: int = 128) -> List[Dict[str, Any]]:
         evs = list(self.events)[-n:]
         epoch = self._epoch
+        worker = self.worker
         return [
             {
                 "wall": round(t + epoch, 6),
@@ -382,8 +392,10 @@ class FlightRecorder:
                 "duration_s": round(dur, 6),
                 "rows": rows,
                 "errors": errs,
+                "seq": seq,
+                "worker": worker,
             }
-            for t, tm, kind, node, name, dur, rows, errs in evs
+            for t, tm, kind, node, name, dur, rows, errs, seq in evs
         ]
 
 
@@ -397,11 +409,30 @@ class EngineMetrics:
     pre-resolved children the engine loop bumps directly."""
 
     def __init__(self, engine) -> None:
+        from pathway_tpu.internals.tracing import SlowTickWatchdog, TraceStore
+
         self.engine = engine
         reg = self.registry = MetricsRegistry(worker=str(engine.worker_id))
         self.recorder = FlightRecorder(
-            capacity=int(os.environ.get("PATHWAY_FLIGHT_RECORDER_SIZE", 512))
+            capacity=int(os.environ.get("PATHWAY_FLIGHT_RECORDER_SIZE", 512)),
+            worker=engine.worker_id,
         )
+        # epoch tracing (sampled span store; see internals/tracing.py)
+        self.trace = TraceStore(engine.worker_id)
+        # slow-tick stack sampler: only armed when PATHWAY_SLOW_TICK_MS
+        # is set — the engine loop None-checks it, so the default cost
+        # is a single attribute load per tick
+        self.slow_watch = None
+        slow_ms = os.environ.get("PATHWAY_SLOW_TICK_MS")
+        if slow_ms:
+            try:
+                threshold = float(slow_ms)
+            except ValueError:
+                threshold = 0.0
+            if threshold > 0:
+                self.slow_watch = SlowTickWatchdog(
+                    engine, self.recorder, threshold
+                )
         self.node_hist = reg.histogram(
             "pathway_node_process_seconds",
             help="per-node process() wall time per tick",
@@ -413,6 +444,16 @@ class EngineMetrics:
         ).labels()
         self.ticks = 0
         self.last_tick_monotonic: float | None = None
+        # per-sink freshness: connector runtime stamps ingest wall-time
+        # per epoch, SubscribeNode sinks stamp emit wall-time at
+        # on_time_end — the difference is end-to-end lag through the graph
+        self.sink_freshness = reg.histogram(
+            "pathway_sink_freshness_seconds",
+            help="ingest->emit lag per sink (epoch end-to-end latency)",
+            labels=("sink",),
+        )
+        self._epoch_ingest: Dict[int, float] = {}
+        self._sink_last_ms: Dict[str, float] = {}
 
         reg.counter(
             "pathway_rows_processed",
@@ -482,6 +523,58 @@ class EngineMetrics:
         if last is None:
             return 0.0
         return time_mod.monotonic() - last
+
+    # -- sink freshness ------------------------------------------------------
+    def note_ingest(self, time: int, wall: float | None = None) -> None:
+        """Record the wall-time (monotonic) a batch for epoch ``time``
+        entered the process.  Called by the streaming driver right before
+        ``process_time``; static runs never call it, so freshness simply
+        stays empty there."""
+        ingest = self._epoch_ingest
+        ingest[time] = time_mod.monotonic() if wall is None else wall
+        if len(ingest) > 1024:
+            # bounded: epochs whose sinks never fired (no rows reached
+            # them) would otherwise pin entries forever
+            for t in sorted(ingest)[:256]:
+                del ingest[t]
+
+    def note_sink_emit(
+        self, sink: str, time: int, wall: float | None = None
+    ) -> None:
+        """Record that sink ``sink`` finished emitting epoch ``time`` and
+        observe the ingest->emit lag.  No-op when the epoch has no ingest
+        stamp (static runs, replayed epochs)."""
+        ingest = self._epoch_ingest.get(time)
+        if ingest is None:
+            return
+        now = time_mod.monotonic() if wall is None else wall
+        lag = now - ingest
+        if lag < 0.0:
+            lag = 0.0
+        self.sink_freshness.labels(sink).observe(lag)
+        self._sink_last_ms[sink] = round(lag * 1000, 4)
+
+    def sink_freshness_stats(self) -> List[Dict[str, Any]]:
+        """Per-sink freshness summary (p50/p99 ms) for the dashboard and
+        /status."""
+        out = []
+        for values, child in sorted(self.sink_freshness._children.items()):
+            count = child.count
+            if not count:
+                continue
+            p50 = child.percentile(50)
+            p99 = child.percentile(99)
+            sink = values[0] if values else ""
+            out.append(
+                {
+                    "sink": sink,
+                    "count": count,
+                    "p50_ms": round(p50 * 1000, 4) if p50 is not None else None,
+                    "p99_ms": round(p99 * 1000, 4) if p99 is not None else None,
+                    "last_ms": self._sink_last_ms.get(sink),
+                }
+            )
+        return out
 
     def _path_counts(self, field: str):
         out = []
@@ -577,6 +670,7 @@ def dump_diagnostics(engine, *, reason: str = "manual") -> Dict[str, Any]:
         ],
         "nodes": nodes,
         "flight_recorder": m.recorder.tail() if m is not None else [],
+        "freshness": m.sink_freshness_stats() if m is not None else [],
     }
     engine.last_diagnostics = diag
     dest = os.environ.get("PATHWAY_DIAGNOSTICS_DIR")

@@ -135,6 +135,31 @@ class StatsMonitor:
                     f"p50={s['p50_ms']}ms p99={s['p99_ms']}ms"
                     f" calls={s['calls']} total={s['total_s']:.3f}s",
                 )
+            # per-sink freshness (ingest->emit lag; streaming runs only)
+            for fs in m.sink_freshness_stats():
+                table.add_row(
+                    f"sink {fs['sink']} freshness",
+                    f"p50={fs['p50_ms']}ms p99={fs['p99_ms']}ms"
+                    f" last={fs['last_ms']}ms n={fs['count']}",
+                )
+            # critical-path attribution for the latest sampled epoch
+            tr = getattr(m, "trace", None)
+            cp = tr.critical_path() if tr is not None else None
+            if cp:
+                table.add_row(
+                    "critical path",
+                    f"epoch {cp['epoch']} total={cp['total_ms']}ms",
+                )
+                for ent in cp["entries"]:
+                    table.add_row(
+                        f"  [{ent['kind']}] {ent['name']} w{ent['worker']}",
+                        f"{ent['duration_ms']}ms"
+                        + (
+                            f" ({ent['share_pct']}%)"
+                            if ent.get("share_pct") is not None
+                            else ""
+                        ),
+                    )
         return table
 
     def start_live(self, refresh_per_second: float = 2.0):
@@ -216,6 +241,12 @@ class PrometheusServer:
             # thread facades share one TCP inter-process transport
             tcp = getattr(getattr(coord, "group", None), "tcp", None)
             add(getattr(tcp, "metrics", None))
+        # process-wide device-health gauges (satellite of the tracing PR)
+        from pathway_tpu.internals import device_probe
+
+        monitor = device_probe._monitor
+        if monitor is not None:
+            add(monitor.metrics)
         return regs
 
     def metrics_text(self) -> str:
@@ -261,6 +292,9 @@ class PrometheusServer:
                     "flight_recorder": (
                         m.recorder.tail() if m is not None else []
                     ),
+                    "freshness": (
+                        m.sink_freshness_stats() if m is not None else []
+                    ),
                 }
             )
         e0 = self.engine
@@ -274,16 +308,65 @@ class PrometheusServer:
             }
             for idx, n in enumerate(e0.nodes)
         ]
+        from pathway_tpu.internals.device_probe import device_status
+        from pathway_tpu.internals.tracing import merged_critical_path
+
         return {
             "worker_count": e0.worker_count,
             "graph": topology,
             "workers": workers,
+            # per-sink freshness merged across this process's workers
+            "sinks": self._merged_freshness(),
+            # latency attribution for the latest sampled epoch (all
+            # in-process workers; see internals/tracing.py)
+            "critical_path": merged_critical_path(self._engines()),
+            # accelerator health (internals/device_probe.py)
+            "device": device_status(),
             # findings from pw.run(analysis=...): deployed graphs report
             # their own lint state (None when analysis was off)
             "analysis": getattr(e0, "analysis", None),
         }
 
+    def _merged_freshness(self) -> list:
+        """Per-sink freshness p50/p99 merged across workers (the log2
+        histograms share boundaries, so merging is a counts add)."""
+        from pathway_tpu.internals.metrics import Histogram
+
+        merged: Dict[str, Any] = {}
+        for e in self._engines():
+            m = getattr(e, "metrics", None)
+            if m is None:
+                continue
+            for values, child in m.sink_freshness._children.items():
+                sink = values[0] if values else ""
+                h = merged.get(sink)
+                if h is None:
+                    h = merged[sink] = Histogram()
+                h.merge(child)
+        out = []
+        for sink in sorted(merged):
+            h = merged[sink]
+            count = h.count
+            if not count:
+                continue
+            p50 = h.percentile(50)
+            p99 = h.percentile(99)
+            out.append(
+                {
+                    "sink": sink,
+                    "count": count,
+                    "p50_ms": round(p50 * 1000, 4) if p50 is not None else None,
+                    "p99_ms": round(p99 * 1000, 4) if p99 is not None else None,
+                }
+            )
+        return out
+
     def start(self) -> None:
+        # arm the periodic device-health probe alongside the endpoint
+        # (no-op when PATHWAY_DEVICE_PROBE=0; one monitor per process)
+        from pathway_tpu.internals.device_probe import ensure_monitor
+
+        ensure_monitor()
         monitor = self
 
         class Handler(http.server.BaseHTTPRequestHandler):

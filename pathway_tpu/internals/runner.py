@@ -186,6 +186,8 @@ def run(
             monitor.stop()
         if http_server is not None:
             http_server.stop()
+        # replay sampled spans to OTel (no-op without an endpoint)
+        telemetry.export_engine_trace(engine)
 
 
 def _run_threaded(
@@ -273,6 +275,10 @@ def _run_threaded(
                     monitor.stop()
                 if http_server is not None:
                     http_server.stop()
+                if thread_index == 0:
+                    from pathway_tpu.internals import telemetry as _tm2
+
+                    _tm2.export_engine_trace(engine)
         except BaseException as exc:  # noqa: BLE001 — propagate to caller
             errors.append(exc)
             group.abort()

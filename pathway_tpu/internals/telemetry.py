@@ -92,6 +92,59 @@ def span(name: str, **attributes: Any):
         yield s
 
 
+def export_engine_trace(engine) -> int:
+    """Replay the engine's TraceStore spans as OTel spans (one per tick,
+    node span, watermark phase).  The OTel export reads the SAME span
+    store `engine.dump_trace()` serialises — a single instrumentation
+    path feeds both the Chrome trace and the OTLP backend.
+
+    No-op (returns 0) without a configured endpoint / OTel SDK, or when
+    tracing was off.  Exceptions never propagate: telemetry must not be
+    able to fail a run at shutdown."""
+    tracer = _get_tracer()
+    if isinstance(tracer, _NoopTracer):
+        return 0
+    m = getattr(engine, "metrics", None)
+    tr = getattr(m, "trace", None) if m is not None else None
+    if tr is None:
+        return 0
+    exported = 0
+    try:
+        for ev in tr.export_events():
+            try:
+                kind = ev[0]
+                if kind == "tick":
+                    _kind, worker, epoch, start, dur = ev
+                    name = f"engine.tick[{epoch}]"
+                    attrs = {"worker": worker, "epoch": epoch}
+                elif kind == "span":
+                    _kind, worker, epoch, node, name, start, dur, rows = ev
+                    attrs = {
+                        "worker": worker,
+                        "epoch": epoch,
+                        "node": node,
+                        "rows": rows,
+                    }
+                elif kind == "wm":
+                    _kind, worker, epoch, start, dur = ev
+                    name = f"engine.watermark[{epoch}]"
+                    attrs = {"worker": worker, "epoch": epoch}
+                else:  # "edge" — point events, not spans; skip
+                    continue
+                span_obj = tracer.start_span(
+                    name,
+                    start_time=int(start * 1e9),
+                    attributes=attrs,
+                )
+                span_obj.end(end_time=int((start + dur) * 1e9))
+                exported += 1
+            except Exception:  # noqa: BLE001 — skip malformed event
+                continue
+    except Exception:  # noqa: BLE001 — never fail the run for telemetry
+        return exported
+    return exported
+
+
 # ---------------------------------------------------------------------------
 # Metrics (reference: src/engine/telemetry.rs:49-58 — process memory/cpu,
 # input/output latency gauges over a periodic OTLP reader)

@@ -1,0 +1,195 @@
+"""`pathway-tpu trace` and `pathway-tpu status` implementations.
+
+`trace` runs a user script with epoch tracing forced on (every epoch by
+default), bounds the run with a termination watchdog, then serialises
+the merged span store to a Chrome/Perfetto ``trace_event`` JSON file —
+open it at https://ui.perfetto.dev or chrome://tracing.
+
+`status` fetches the /status JSON a running job serves (pw.run with
+``with_http_server=True``; internals/monitoring.py PrometheusServer)
+and renders a terminal summary: per-worker progress, hottest nodes,
+sink freshness, the critical path of the latest traced epoch, and
+device health.
+
+The trace subcommand is single-process (PATHWAY_THREADS > 1 is fine:
+thread workers share memory, so the dump merges them locally).  For
+multi-process jobs call ``engine.dump_trace()`` from the script itself
+on every worker — it is an SPMD collective.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import runpy
+import sys
+import threading
+from typing import List
+
+
+def trace_script(
+    path: str, *, out: str, duration: float, sample: int
+) -> int:
+    """Execute `path` with tracing on; dump the trace when it finishes
+    (or when the watchdog terminates a streaming run after `duration`).
+    Returns the number of trace events written, or -1 when the script
+    never ran a dataflow."""
+    from pathway_tpu.internals import runner
+    from pathway_tpu.internals.parse_graph import G
+
+    os.environ["PATHWAY_TRACE"] = "1"
+    os.environ["PATHWAY_TRACE_SAMPLE"] = str(max(1, sample))
+    G.clear()
+    ran: List[bool] = []
+
+    real_run, real_run_all = runner.run, runner.run_all
+    import pathway_tpu as pw
+
+    pw_run, pw_run_all = pw.run, pw.run_all
+
+    def _traced_run(**kwargs):
+        ran.append(True)
+        stop = threading.Event()
+
+        def _watchdog():
+            if stop.wait(duration):
+                return
+            eng = runner.last_engine()
+            if eng is not None:
+                eng.terminate_flag.set()
+
+        # bounds streaming scripts; a static run finishes on its own and
+        # the late terminate_flag.set() on a finished engine is harmless
+        t = threading.Thread(
+            target=_watchdog, daemon=True, name="pw-trace-watchdog"
+        )
+        t.start()
+        try:
+            real_run(**kwargs)
+        finally:
+            stop.set()
+
+    runner.run = _traced_run
+    runner.run_all = _traced_run
+    pw.run = _traced_run
+    pw.run_all = _traced_run
+    try:
+        runpy.run_path(path, run_name="__main__")
+    finally:
+        runner.run, runner.run_all = real_run, real_run_all
+        pw.run, pw.run_all = pw_run, pw_run_all
+
+    eng = runner.last_engine()
+    if not ran or eng is None:
+        return -1
+    trace = eng.dump_trace(out)
+    return len(trace.get("traceEvents", []))
+
+
+def main_trace(args) -> int:
+    """Entry point for the cli.py `trace` subcommand."""
+    try:
+        n = trace_script(
+            args.script,
+            out=args.out,
+            duration=args.duration,
+            sample=args.sample,
+        )
+    except SystemExit as exc:  # script called sys.exit()
+        code = exc.code if isinstance(exc.code, int) else 1
+        print(
+            f"error: {args.script} exited with {code} before the trace "
+            "could be dumped",
+            file=sys.stderr,
+        )
+        return 2
+    except Exception as exc:  # noqa: BLE001 — report, don't traceback
+        print(f"error: failed to trace {args.script}: {exc}", file=sys.stderr)
+        return 2
+    if n < 0:
+        print(
+            f"error: {args.script} never called pw.run — nothing to trace",
+            file=sys.stderr,
+        )
+        return 2
+    print(
+        f"wrote {n} trace events to {args.out} — open at "
+        "https://ui.perfetto.dev or chrome://tracing"
+    )
+    return 0
+
+
+def fetch_status(url: str, timeout: float = 5.0) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def render_status(status: dict) -> str:
+    lines = [f"workers: {status.get('worker_count')}"]
+    for w in status.get("workers", []):
+        lines.append(
+            f"  worker {w.get('worker')}: time={w.get('engine_time')} "
+            f"rows={w.get('rows_processed')} ticks={w.get('ticks')} "
+            f"lag={w.get('watermark_lag_s')}s errors={w.get('errors')}"
+        )
+        for name, stats in sorted((w.get("connectors") or {}).items()):
+            lines.append(f"    connector {name}: {stats}")
+        nodes = sorted(
+            w.get("nodes") or [],
+            key=lambda n: n.get("total_s") or 0.0,
+            reverse=True,
+        )
+        for n in nodes[:5]:
+            lines.append(
+                f"    node {n.get('name')}: total={n.get('total_s')}s "
+                f"p99={n.get('p99_ms')}ms rows={n.get('rows_out')}"
+            )
+    sinks = status.get("sinks") or []
+    if sinks:
+        lines.append("sink freshness (ingest -> emit):")
+        for s in sinks:
+            lines.append(
+                f"  {s.get('sink')}: p50={s.get('p50_ms')}ms "
+                f"p99={s.get('p99_ms')}ms n={s.get('count')}"
+            )
+    cp = status.get("critical_path")
+    if cp:
+        lines.append(
+            f"critical path (epoch {cp.get('epoch')}, "
+            f"{cp.get('total_ms')}ms total):"
+        )
+        for ent in cp.get("entries", []):
+            lines.append(
+                f"  [{ent.get('kind')}] {ent.get('name')} "
+                f"w{ent.get('worker')}: {ent.get('duration_ms')}ms "
+                f"({ent.get('share_pct')}%)"
+            )
+    device = status.get("device")
+    if device:
+        rtt = device.get("rtt_ms")
+        lines.append(
+            f"device: {device.get('status')}"
+            + (f" rtt={rtt}ms" if rtt is not None else "")
+            + (f" error={device['error']}" if device.get("error") else "")
+        )
+    analysis = status.get("analysis")
+    if analysis and analysis.get("findings"):
+        lines.append(f"analysis findings: {len(analysis['findings'])}")
+    return "\n".join(lines)
+
+
+def main_status(args) -> int:
+    """Entry point for the cli.py `status` subcommand."""
+    url = args.url or f"http://127.0.0.1:{args.port}/status"
+    try:
+        status = fetch_status(url)
+    except Exception as exc:  # noqa: BLE001 — connection refused etc.
+        print(f"error: could not fetch {url}: {exc}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(status, indent=2, sort_keys=True))
+    else:
+        print(render_status(status))
+    return 0

@@ -703,6 +703,9 @@ class StreamingDriver:
         counters: Dict[LiveSource, int] = {}
         last_flush = time_mod.monotonic()
         last_snapshot = time_mod.monotonic()
+        # sink freshness: when the oldest event of the batch being
+        # accumulated entered the process (None = nothing buffered yet)
+        batch_arrival: Optional[float] = None
         dirty_since_snapshot = False
         snapshot_writers = {
             live.name: self._snapshot_writer(live)
@@ -730,7 +733,7 @@ class StreamingDriver:
             align across workers; agree() itself blocks until the slowest
             worker reaches the same tick — that is the frontier protocol."""
             nonlocal time, last_flush, last_snapshot, done
-            nonlocal dirty_since_snapshot
+            nonlocal dirty_since_snapshot, batch_arrival
             self.engine.flush_ticks = getattr(self.engine, "flush_ticks", 0) + 1
             has_data = any(
                 (committed_upto.get(live, 0) > 0 or not gated(live)
@@ -798,6 +801,19 @@ class StreamingDriver:
                         state["counter"] = counters.get(live, 0)
                         writer.write_batch(batch, state)
                     node_of(live).push(time, batch)
+                # sink freshness: stamp when this epoch's data entered the
+                # process (oldest buffered event, or now for commit-only
+                # flushes) — SubscribeNode sinks close the interval at
+                # on_time_end inside this process_time call
+                m = self.engine.metrics
+                if m is not None:
+                    m.note_ingest(
+                        time,
+                        batch_arrival
+                        if batch_arrival is not None
+                        else flush_started,
+                    )
+                batch_arrival = None
                 self.engine.process_time(time)
                 # observability: batch latency + per-source read counters
                 # (reference: src/connectors/monitoring.rs surfaces the
@@ -894,8 +910,12 @@ class StreamingDriver:
                 last_event[live] = now_ev
                 if kind == "data":
                     pending.setdefault(live, []).append(payload)
+                    if batch_arrival is None:
+                        batch_arrival = now_ev
                 elif kind == "data_batch":
                     pending.setdefault(live, []).extend(payload)
+                    if batch_arrival is None:
+                        batch_arrival = now_ev
                 elif kind in ("commit", "commit_b"):
                     if payload is not None:
                         states[live] = payload

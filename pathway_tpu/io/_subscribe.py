@@ -49,6 +49,7 @@ def subscribe(
             on_time_end=on_time_end,
             on_end=on_end,
             column_names=column_names,
+            sink_name=name,
         )
 
     G.add_sink([table], attach)

@@ -87,6 +87,8 @@ def attach_writer(table, writer: OutputWriter, *, name: str | None = None) -> No
             on_time_end=on_time_end,
             on_end=on_end,
             column_names=column_names,
+            # freshness label: explicit sink name, else the writer class
+            sink_name=name or type(writer).__name__,
         )
 
     G.add_sink([table], attach)

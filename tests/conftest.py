@@ -16,6 +16,11 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# keep the suite hermetic: the device monitor's background probe spawns a
+# jax-importing subprocess per process — tests exercise DeviceMonitor
+# directly with an injected probe instead (tests/test_tracing.py)
+os.environ.setdefault("PATHWAY_DEVICE_PROBE", "0")
+
 import pytest
 
 

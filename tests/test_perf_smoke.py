@@ -147,6 +147,113 @@ def test_observability_overhead_under_5pct():
 
 
 @pytest.mark.perf_smoke
+def test_tracing_overhead_under_5pct(monkeypatch):
+    """Epoch tracing defaults to ON at 1-in-16 sampling, so its cost on
+    top of the metrics layer must also stay under 5%: A/B of
+    PATHWAY_TRACE unset (default sampling) vs =0 (off), both arms with
+    metrics enabled, over the same microbench as the metrics guard."""
+    import gc
+    from time import perf_counter
+
+    from pathway_tpu.engine.engine import InputQueueSource, RowwiseNode
+
+    ROWS, TICKS, REPS = 512, 40, 5
+    deltas = [(ref_scalar("k", i), (i,), 1) for i in range(ROWS)]
+
+    def ident(keys, cols):
+        return cols[0]
+
+    def run_once(trace_default: bool) -> float:
+        if trace_default:
+            monkeypatch.delenv("PATHWAY_TRACE", raising=False)
+        else:
+            monkeypatch.setenv("PATHWAY_TRACE", "0")
+        eng = Engine()  # TraceStore reads the env at construction
+        src = InputQueueSource(eng)
+        node = src
+        for _ in range(3):
+            node = RowwiseNode(eng, [node], ident)
+        try:
+            time = 2
+            for _ in range(8):  # warmup
+                src.push(time, deltas)
+                eng.process_time(time)
+                time += 2
+            t0 = perf_counter()
+            for _ in range(TICKS):
+                src.push(time, deltas)
+                eng.process_time(time)
+                time += 2
+            return perf_counter() - t0
+        finally:
+            eng._gc_unfreeze()
+
+    on, off = [], []
+    gc.collect()
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        for _ in range(REPS):
+            on.append(run_once(True))
+            off.append(run_once(False))
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+    ratio = min(on) / min(off)
+    assert ratio < 1.05, (
+        f"default-sampling tracing overhead {ratio:.3f}x "
+        f"(on={min(on):.4f}s off={min(off):.4f}s)"
+    )
+
+
+@pytest.mark.perf_smoke
+def test_dump_trace_is_valid_chrome_trace(monkeypatch, tmp_path):
+    """A 2-thread-worker wordcount traced at every epoch must export a
+    schema-valid Chrome trace_event document with spans from BOTH
+    workers and paired cross-worker flow edges (the acceptance shape of
+    the tracing layer, kept in tier-1 as a smoke guard)."""
+    from pathway_tpu.internals.config import pathway_config
+    from pathway_tpu.internals.runner import last_engine
+    from pathway_tpu.internals.tracing import validate_chrome_trace
+
+    monkeypatch.setenv("PATHWAY_TRACE", "1")
+    old = pathway_config.threads
+    pathway_config.threads = 2
+    try:
+        t = pw.debug.table_from_markdown(
+            """
+            word
+            the
+            quick
+            the
+            fox
+            """
+        )
+        counts = t.groupby(pw.this.word).reduce(
+            pw.this.word, n=pw.reducers.count()
+        )
+        pw.io.fs.write(counts, str(tmp_path / "out.jsonl"), format="json")
+        pw.run(monitoring_level=None)
+    finally:
+        pathway_config.threads = old
+
+    trace = last_engine().dump_trace(str(tmp_path / "trace.json"))
+    validate_chrome_trace(trace)
+    import json as _json
+
+    validate_chrome_trace(
+        _json.loads((tmp_path / "trace.json").read_text())
+    )
+    evs = trace["traceEvents"]
+    assert {e["pid"] for e in evs if e.get("cat") == "node"} == {0, 1}
+    starts = [e for e in evs if e["ph"] == "s"]
+    finishes = [e for e in evs if e["ph"] == "f"]
+    assert starts and {e["id"] for e in starts} == {
+        e["id"] for e in finishes
+    }
+
+
+@pytest.mark.perf_smoke
 def test_columnar_exchange_selected_on_two_workers(tmp_path):
     """An eligible keyed shuffle on a 2-thread-worker graph must route
     through the columnar scatter (vectorized shard codes + C partition
