@@ -424,6 +424,43 @@ def _exchange_numbers(timeout_s: float = 900.0) -> dict | None:
     return None
 
 
+def _failover_recovery_s(timeout_s: float = 600.0) -> float | None:
+    """Live-failover recovery latency: engine_bench's --failover section
+    (2-thread-worker streaming job, injected worker kill, runner
+    respawns the slot) in a subprocess.  Pure host dataflow — works
+    identically on device-down rounds.  Returns the survivor's measured
+    kill-to-rejoin seconds, or None if the bench fails."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(repo, "benchmarks", "engine_bench.py"),
+                "--failover",
+            ],
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    for line in proc.stdout.splitlines():
+        try:
+            ent = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(ent, dict) and ent.get("metric") == "failover_recovery_s":
+            return ent.get("value")
+    return None
+
+
 def _observability_overhead() -> float | None:
     """Cost of the always-on metrics layer on the pure-host engine loop:
     min-of-N A/B of Engine() vs Engine(metrics=False) over the same
@@ -595,6 +632,7 @@ def main() -> None:
                     "exchange_throughput": exchange,
                     "observability_overhead": _observability_overhead(),
                     "tracing_overhead": _tracing_overhead(),
+                    "failover_recovery_s": _failover_recovery_s(),
                 }
             )
         )
@@ -683,6 +721,7 @@ def main() -> None:
                 "exchange_throughput": _exchange_numbers(),
                 "observability_overhead": _observability_overhead(),
                 "tracing_overhead": _tracing_overhead(),
+                "failover_recovery_s": _failover_recovery_s(),
                 "device": _device_name(),
                 **_mfu_facts(docs_per_sec, docs),
                 "device_phase_docs_per_sec": round(device_rate, 1),

@@ -608,6 +608,97 @@ def bench_tick_overhead(workers=(2, 4), duration_s=3.0):
     return out
 
 
+def bench_failover(kill_epoch=12, n_rows=80):
+    """Live-failover recovery latency: a 2-thread-worker streaming job
+    with operator snapshots takes an injected worker kill mid-run; the
+    surviving worker rolls back, the runner respawns the dead slot, and
+    the job finishes.  Reports the survivor's measured kill-to-rejoin
+    wall time (engine.last_failover_recovery_s)."""
+    import subprocess
+    import sys
+    import tempfile
+    import textwrap
+
+    script = textwrap.dedent(
+        """
+        import os, sys, time
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import pathway_tpu as pw
+        from pathway_tpu.internals import faults
+
+        pstore, kill_epoch, n_rows = (
+            sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+        )
+
+        class Subject(pw.io.python.ConnectorSubject):
+            def run(self):
+                for i in range(n_rows):
+                    self.next(k=i % 4, v=i)
+                    self.commit()
+                    time.sleep(0.005)
+
+        t = pw.io.python.read(
+            Subject(), schema=pw.schema_from_types(k=int, v=int),
+            name="src",
+        )
+        res = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+        got = []
+        pw.io.subscribe(res, on_change=lambda *a, **k: got.append(1))
+        faults.install(f"kill_worker@worker=1,epoch={kill_epoch}")
+        pw.run(
+            monitoring_level=pw.MonitoringLevel.NONE,
+            autocommit_duration_ms=15,
+            persistence_config=pw.persistence.Config(
+                pw.persistence.Backend.filesystem(pstore),
+                snapshot_interval_ms=20,
+            ),
+        )
+        from pathway_tpu.internals.runner import last_engine
+        eng = last_engine()
+        print(f"STATS failovers={eng.failover_count} "
+              f"recovery_s={eng.last_failover_recovery_s}")
+        """
+    )
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    with tempfile.TemporaryDirectory() as tmp:
+        spath = _os.path.join(tmp, "failover.py")
+        with open(spath, "w") as fh:
+            fh.write(script)
+        env = dict(_os.environ)
+        env.update(
+            PATHWAY_THREADS="2", JAX_PLATFORMS="cpu", PYTHONPATH=repo
+        )
+        env.pop("PATHWAY_FAULTS", None)
+        proc = subprocess.run(
+            [
+                sys.executable, spath,
+                _os.path.join(tmp, "pstore"),
+                str(kill_epoch), str(n_rows),
+            ],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+    if proc.returncode != 0:
+        raise RuntimeError(f"failover bench failed: {proc.stderr[-1500:]}")
+    stats = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("STATS"):
+            stats = dict(kv.split("=") for kv in line.split()[1:])
+    assert stats, "failover bench printed no stats"
+    recovery = (
+        None
+        if stats["recovery_s"] == "None"
+        else round(float(stats["recovery_s"]), 4)
+    )
+    print(json.dumps({
+        "metric": "failover_recovery_s",
+        "value": recovery,
+        "unit": "seconds from worker kill to rejoined mesh",
+        "failovers": int(stats["failovers"]),
+        "host_cpus": _os.cpu_count(),
+    }))
+    return recovery
+
+
 if __name__ == "__main__":
     import sys as _sys
 
@@ -615,6 +706,8 @@ if __name__ == "__main__":
         bench_wordcount_multiworker()
     elif "--tick-overhead" in _sys.argv:
         bench_tick_overhead()
+    elif "--failover" in _sys.argv:
+        bench_failover()
     elif "--columnar" in _sys.argv:
         bench_join_columnar()
         bench_flatten_columnar()

@@ -34,6 +34,18 @@ class EngineError(Exception):
     pass
 
 
+class FailoverRequired(EngineError):
+    """Raised out of a coordination wait (agree/collect) when a peer worker
+    died mid-run and the group is rolling back to the last persisted
+    frontier instead of failing the job.  The streaming driver catches it,
+    rendezvouses with the surviving workers, restores operator state and
+    resumes; a replacement worker re-runs the driver from scratch."""
+
+    def __init__(self, message: str, *, dead: Iterable[int] = ()):
+        super().__init__(message)
+        self.dead = tuple(dead)
+
+
 class ErrorLogEntry:
     __slots__ = ("message", "operator", "time", "trace")
 
@@ -209,6 +221,15 @@ class Engine:
         self._timing_dumped = False
         self.current_time: int = 0
         self.stats_rows = 0
+        # transactional sinks (io/_writer.py OutputWriter protocol): the
+        # streaming driver drives prepare/commit around operator snapshots
+        self._txn_sinks: List[Any] = []
+        # fault-tolerance counters, exported via EngineMetrics callbacks
+        # (pathway_failover_total / pathway_sink_txn_commits_total); plain
+        # ints so the driver can bump them with metrics disabled
+        self.failover_count = 0
+        self.sink_txn_commits = 0
+        self.last_failover_recovery_s: float | None = None
         self.now_fn: Callable[[], int] | None = None  # engine-time provider
         self.terminate_flag = threading.Event()
         self.on_error: Callable[[ErrorLogEntry], None] | None = None
@@ -228,6 +249,13 @@ class Engine:
         group = getattr(coord, "group", None)
         if group is not None and hasattr(group, "engines"):
             group.engines.append(self)
+        # dead-peer errors from the coordinator pull this worker's
+        # flight-recorder tail into the message (what was I doing when
+        # the peer died), instead of a bare "peer N dead"
+        try:
+            coord.on_dead_context = self._failure_context
+        except AttributeError:
+            pass
 
     def register(self, node: Node) -> None:
         idx = len(self.nodes)
@@ -240,6 +268,44 @@ class Engine:
             else None
         )
         self.nodes.append(node)
+
+    def register_txn_sink(self, writer) -> None:
+        """Register a transactional sink for the snapshot-aligned
+        exactly-once protocol: the driver calls writer.prepare(F) before
+        each operator-snapshot manifest and writer.commit(F) after it."""
+        self._txn_sinks.append(writer)
+
+    def _failure_context(self) -> str:
+        """Flight-recorder tail for dead-peer diagnostics: what this
+        worker was doing right before the group noticed a peer die.
+        Installed on the coordinator as ``on_dead_context``."""
+        m = self.metrics
+        if m is None:
+            return ""
+        return "; ".join(
+            f"t={ev['time']} {ev['kind']} "
+            f"node={ev['node']}({ev['name']}) {ev['duration_s']}s"
+            for ev in m.recorder.tail(8)
+        )
+
+    def reset_for_rollback(self) -> None:
+        """Failover rollback: drop every in-flight delta and scheduled
+        wakeup so replay from the restored frontier is not double-counted.
+        Node STATE is overwritten by apply_states right after; this clears
+        only transient wiring.  The driver's own pending queues survive —
+        they hold future (never-yet-pushed) data."""
+        for node in self.nodes:
+            node.pending.clear()
+            node._pending_clean.clear()
+            # sink-side buffers outside the node graph (attach_writer's
+            # per-epoch RowEvent batch) register a hook: rows buffered by
+            # an epoch the rollback abandoned must not leak into the new
+            # timeline (their epoch numbers may even collide with it)
+            hook = getattr(node, "on_rollback", None)
+            if hook is not None:
+                hook()
+        self._scheduled_times.clear()
+        self.current_time = 0
 
     def schedule_time(self, time: int) -> None:
         if time > self.current_time:

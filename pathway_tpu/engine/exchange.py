@@ -39,6 +39,7 @@ import os
 import queue
 import socket
 import struct
+import sys
 import threading
 import time as time_mod
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -61,6 +62,25 @@ _CHUNK = 65536
 
 # frames buffered per peer writer before senders block (backpressure)
 _SEND_QUEUE_FRAMES = 64
+
+# failover fence sentinel carried in a coord frame's round slot.  The wire
+# codec packs rounds as u64, so the sentinel must be a positive value no
+# real agree round can reach (rounds restart from 0 after every failover).
+FENCE_ROUND = (1 << 64) - 1
+
+_TRACE = os.environ.get("PATHWAY_EXCHANGE_TRACE") == "1"
+
+
+def _trace(worker_id: int, msg: str) -> None:
+    """Failover-protocol event trace (PATHWAY_EXCHANGE_TRACE=1): hello,
+    EOF, dead-marking, fence and rendezvous steps, with timestamps —
+    mesh-teardown races are invisible without the interleaving."""
+    if _TRACE:
+        print(
+            f"[exch w{worker_id} {time_mod.monotonic():.3f}] {msg}",
+            file=sys.stderr,
+            flush=True,
+        )
 
 
 class ExchangeError(Exception):
@@ -232,6 +252,16 @@ class TcpCoordinator(Coordinator):
         self._round = 0
         self._dead: set[int] = set()
         self._dead_reasons: Dict[int, str] = {}
+        # live failover (enable_failover): peer death/rejoin surfaces as
+        # FailoverRequired so the driver can roll back instead of failing.
+        # _helloed tracks peers that ever identified; a SECOND hello from
+        # one of them is a rejoin (replacement process or re-handshake
+        # after a severed socket).  _conn_gen guards against a stale
+        # connection's late EOF re-killing a rejoined peer.
+        self._failover = False
+        self._helloed: set[int] = set()
+        self._rejoined: set[int] = set()
+        self._conn_gen: Dict[int, int] = {}
         self._closed = False
         self._out: Dict[int, socket.socket] = {}
         self._out_locks: Dict[int, threading.Lock] = {}
@@ -378,6 +408,7 @@ class TcpCoordinator(Coordinator):
 
     def _mark_peer_dead(self, peer: int) -> None:
         with self._cv:
+            _trace(self.worker_id, f"send failure -> mark peer {peer} dead")
             self._dead.add(peer)
             self._cv.notify_all()
 
@@ -421,6 +452,7 @@ class TcpCoordinator(Coordinator):
         )
 
         peer = None
+        conn_gen = 0
         try:
             while True:
                 head = self._recv_exact(conn, _LEN.size)
@@ -460,6 +492,27 @@ class TcpCoordinator(Coordinator):
                             f"peer {peer} belongs to run {msg[2]!r}, "
                             f"expected {self.run_id!r}"
                         )
+                    with self._cv:
+                        conn_gen = self._conn_gen.get(peer, 0) + 1
+                        self._conn_gen[peer] = conn_gen
+                        _trace(
+                            self.worker_id,
+                            f"hello from peer {peer} gen={conn_gen} "
+                            f"rejoin={peer in self._helloed or peer in self._dead}",
+                        )
+                        if self._failover and (
+                            peer in self._helloed or peer in self._dead
+                        ):
+                            # rejoin: the peer (or its replacement) opened a
+                            # fresh connection mid-run.  Purge its old-
+                            # timeline contributions and flag the rejoin so
+                            # this side's agree/collect trigger rollback too
+                            # — epoch-fenced: anything it sent before this
+                            # hello belongs to the abandoned timeline.
+                            self._purge_peer_locked(peer)
+                            self._rejoined.add(peer)
+                        self._helloed.add(peer)
+                        self._cv.notify_all()
                     continue
                 with self._cv:
                     if kind == "data":
@@ -481,7 +534,18 @@ class TcpCoordinator(Coordinator):
                         ] = (wall, time_mod.time())
                     elif kind == "coord":
                         _, round_no, payload = msg
-                        self._coord.setdefault(round_no, {})[peer] = payload
+                        if round_no == FENCE_ROUND:
+                            # failover fence: every frame this peer sent
+                            # before this one is old-timeline.  Purging on
+                            # fence arrival (per-socket FIFO) guarantees
+                            # stale entries are gone before any new-
+                            # timeline frame can alias a (channel, time)
+                            # or round key after the rollback reset.
+                            self._purge_peer_locked(peer)
+                        else:
+                            self._coord.setdefault(round_no, {})[
+                                peer
+                            ] = payload
                     self._cv.notify_all()
         except Exception as exc:  # noqa: BLE001 — socket teardown paths
             if peer is not None:
@@ -491,7 +555,19 @@ class TcpCoordinator(Coordinator):
                     )
         finally:
             with self._cv:
-                if peer is not None and not self._closed:
+                # generation guard: only the CURRENT connection for this
+                # peer may declare it dead — a replaced connection's late
+                # EOF must not re-kill a peer that already rejoined
+                current = (
+                    peer is not None
+                    and self._conn_gen.get(peer, 0) == conn_gen
+                )
+                _trace(
+                    self.worker_id,
+                    f"recv EOF peer={peer} gen={conn_gen} "
+                    f"current={current} closed={self._closed}",
+                )
+                if current and not self._closed:
                     self._dead.add(peer)
                     self._dead_reasons.setdefault(peer, "connection closed")
                 self._cv.notify_all()
@@ -529,15 +605,219 @@ class TcpCoordinator(Coordinator):
             except OSError:
                 self._mark_peer_dead(peer)
 
+    def _purge_peer_locked(self, peer: int) -> None:
+        """Drop every buffered contribution from ``peer`` (caller holds
+        _cv).  Runs on rejoin-hello and fence arrival so old-timeline
+        frames can never alias post-rollback (channel, time)/round keys."""
+        for per_sender in self._data.values():
+            per_sender.pop(peer, None)
+        for got in self._punct.values():
+            got.discard(peer)
+        for stamps in self._stamps.values():
+            stamps.pop(peer, None)
+        for votes in self._coord.values():
+            votes.pop(peer, None)
+
+    def _dead_context(self) -> str:
+        """Flight-recorder tail (installed by the engine as
+        ``on_dead_context``) appended to dead-peer errors: what THIS
+        worker was doing when the peer died, not just 'peer N dead'."""
+        cb = getattr(self, "on_dead_context", None)
+        if cb is None:
+            return ""
+        try:
+            tail = cb()
+        except Exception:  # noqa: BLE001 — diagnostics must not mask
+            return ""
+        return f" | recent engine events: {tail}" if tail else ""
+
     def _check_dead(self) -> None:
-        if self._dead and not self._closed:
+        if (self._dead or self._rejoined) and not self._closed:
             reasons = "; ".join(
                 f"peer {p}: {r}" for p, r in sorted(self._dead_reasons.items())
             )
+            detail = (
+                f" ({reasons})" if reasons else ""
+            ) + self._dead_context()
+            if self._failover:
+                from pathway_tpu.engine.engine import FailoverRequired
+
+                raise FailoverRequired(
+                    f"worker {self.worker_id}: peer(s) "
+                    f"{sorted(self._dead | self._rejoined)} left the mesh"
+                    + detail,
+                    dead=tuple(sorted(self._dead)),
+                )
             raise ExchangeError(
                 f"worker {self.worker_id}: peer(s) {sorted(self._dead)} died"
-                + (f" ({reasons})" if reasons else "")
+                + detail
             )
+
+    # -- live failover -----------------------------------------------------
+    def enable_failover(self) -> None:
+        """Dead/rejoined peers raise FailoverRequired (rollback + rejoin)
+        out of agree/collect instead of a fatal ExchangeError.  The
+        streaming driver enables this only when operator snapshots are on
+        — without a snapshot there is no frontier to roll back to."""
+        self._failover = True
+
+    def sever_peer(self, peer: int) -> None:
+        """Fault injection (faults.sever_peer): hard-close the outbound
+        socket to ``peer``.  Its recv side sees EOF, our next send fails —
+        both sides observe the break and, with failover enabled, roll back
+        and re-handshake through failover_rendezvous."""
+        sock = self._out.get(peer)
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._mark_peer_dead(peer)
+
+    def failover_rendezvous(self, timeout: float | None = None) -> None:
+        """Epoch-fenced rejoin handshake, called by the driver after its
+        rollback.  Order matters:
+
+        1. drain writer queues to intact peers (their frames precede the
+           fence on each socket),
+        2. send the fence (round FENCE_ROUND) to intact peers — they purge our
+           old-timeline frames on arrival, strictly before anything we
+           send afterwards (per-socket FIFO),
+        3. reconnect to every dead/rejoined peer's listener (the
+           replacement rebinds the same port) with a retry deadline,
+        4. wait for each target's fresh hello — a replacement process
+           hellos when it joins the mesh, a surviving peer hellos from
+           its own rendezvous reconnect.  Consuming the hello INSIDE the
+           rendezvous window prevents a late rejoin-hello from triggering
+           a second, spurious rollback, and its _conn_gen bump guarantees
+           stale EOFs from the peer's abandoned sockets can no longer
+           re-mark it dead,
+        5. verify each reconnected socket actually reaches the NEW
+           incarnation.  Step 3 can race the old process's teardown and
+           land in the DYING listener's backlog — its corpse socket
+           swallows our hello and the first vote we send dies with
+           ECONNRESET.  The rejoin hello proves the old process already
+           exited (the port could not rebind before that), so by now a
+           corpse socket has EOF queued and a zero-byte peek
+           discriminates reliably; reconnect goes to the live listener,
+        6. clear dead/rejoin state and reset the agreement round counter
+           — both sides restart at round 0 on the rolled-back timeline.
+           No buffer purge here: the rejoin-hello handler already purged
+           the peer's old-timeline frames, and purging again could eat a
+           round-0 vote the peer sent right after its hello."""
+        if timeout is None:
+            try:
+                timeout = float(os.environ.get("PATHWAY_REJOIN_TIMEOUT", 30))
+            except ValueError:
+                timeout = 30.0
+        with self._cv:
+            targets = set(self._dead) | set(self._rejoined)
+        _trace(self.worker_id, f"rendezvous start targets={sorted(targets)}")
+        for peer, w in list(self._writers.items()):
+            if peer in targets:
+                continue
+            drain_deadline = time_mod.monotonic() + 5.0
+            while w.depth() > 0 and time_mod.monotonic() < drain_deadline:
+                time_mod.sleep(0.005)
+        fence = self._encode_frame(("coord", FENCE_ROUND, self.worker_id))
+        for peer, sock in list(self._out.items()):
+            if peer in targets:
+                continue
+            try:
+                with self._out_locks[peer]:
+                    sock.sendall(fence)
+            except OSError:
+                targets.add(peer)
+        deadline = time_mod.monotonic() + timeout
+
+        def reconnect(peer: int) -> None:
+            old = self._out.pop(peer, None)
+            w = self._writers.pop(peer, None)
+            if w is not None:
+                w.dead = True  # drain mode: unblock queued senders
+                w.close(timeout=0.5)
+            if old is not None:
+                try:
+                    old.close()
+                except OSError:
+                    pass
+            while True:
+                try:
+                    s = socket.create_connection(
+                        (self.host, self.first_port + peer), timeout=2.0
+                    )
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    self._out[peer] = s
+                    self._out_locks[peer] = threading.Lock()
+                    self._send_on(s, ("hello", self.worker_id, self.run_id))
+                    if self._use_writers:
+                        self._writers[peer] = _PeerWriter(
+                            peer, s, self._out_locks[peer],
+                            self._mark_peer_dead,
+                        )
+                    return
+                except OSError:
+                    if time_mod.monotonic() > deadline:
+                        raise ExchangeError(
+                            f"worker {self.worker_id}: failover rendezvous "
+                            f"could not reach replacement worker {peer} on "
+                            f"port {self.first_port + peer}"
+                        ) from None
+                    time_mod.sleep(0.05)
+
+        for peer in sorted(targets):
+            reconnect(peer)
+        with self._cv:
+            while not targets <= self._rejoined:
+                remaining = deadline - time_mod.monotonic()
+                if remaining <= 0:
+                    missing = sorted(targets - self._rejoined)
+                    raise ExchangeError(
+                        f"worker {self.worker_id}: failover rendezvous "
+                        f"timed out waiting for a rejoin hello from "
+                        f"peer(s) {missing}"
+                    )
+                self._cv.wait(min(remaining, 0.1))
+        for peer in sorted(targets):
+            if self._sock_eof(self._out.get(peer)):
+                _trace(
+                    self.worker_id,
+                    f"outbound to {peer} went to the dying incarnation; "
+                    f"reconnecting",
+                )
+                reconnect(peer)
+        with self._cv:
+            for peer in targets:
+                self._dead.discard(peer)
+                self._dead_reasons.pop(peer, None)
+                self._rejoined.discard(peer)
+            self._round = 0
+            _trace(
+                self.worker_id,
+                f"rendezvous done targets={sorted(targets)} round=0",
+            )
+            self._cv.notify_all()
+
+    @staticmethod
+    def _sock_eof(sock: Optional[socket.socket]) -> bool:
+        """True when `sock` is closed/reset by its remote end.  Peers
+        never write on our outbound sockets (the mesh is simplex), so a
+        non-blocking 1-byte peek sees either EAGAIN (alive) or EOF/reset
+        (corpse) — it can never consume payload."""
+        if sock is None:
+            return True
+        try:
+            return (
+                sock.recv(1, socket.MSG_DONTWAIT | socket.MSG_PEEK) == b""
+            )
+        except (BlockingIOError, InterruptedError):
+            return False
+        except OSError:
+            return True
 
     # -- Coordinator API --------------------------------------------------
     def owns(self, shard: int) -> bool:
@@ -593,8 +873,10 @@ class TcpCoordinator(Coordinator):
                 # still be waiting on OTHER peers' frames — only a dead
                 # peer whose punctuation we still lack is fatal (its punct
                 # rides the same per-peer FIFO as its data, so punct
-                # present => all its data arrived)
-                if self._dead - got:
+                # present => all its data arrived).  A rejoined peer means
+                # ITS side already rolled back: this wait can never
+                # complete either.
+                if (self._dead - got) or self._rejoined:
                     break
                 if not self._cv.wait(timeout=min(1.0, deadline - time_mod.monotonic())):
                     if time_mod.monotonic() >= deadline:
@@ -609,6 +891,8 @@ class TcpCoordinator(Coordinator):
     def agree(self, payload: Any, timeout: float = 600.0) -> List[Any]:
         round_no = self._round
         self._round += 1
+        if _TRACE and round_no < 3:
+            _trace(self.worker_id, f"agree round {round_no} send")
         self._broadcast_sync(("coord", round_no, payload))
         t0 = time_mod.monotonic()
         deadline = t0 + timeout
@@ -623,8 +907,10 @@ class TcpCoordinator(Coordinator):
                 # during the FINAL round early finishers exit (clean EOF)
                 # as soon as their agree completes; their vote already
                 # arrived, so only a dead peer whose vote is still missing
-                # means the round can never complete
-                if any(
+                # means the round can never complete.  A rejoined peer is
+                # on the rolled-back timeline — its old-round vote will
+                # never come.
+                if self._rejoined or any(
                     w in self._dead for w in range(self.worker_count)
                     if w != self.worker_id and w not in votes
                 ):
@@ -693,6 +979,23 @@ class ThreadGroupCoordinator:
         self._votes: List[Any] = [None] * threads
         self._result: Any = None
         self._aborted = False
+        # live failover (in-memory thread mode only): when enabled, one
+        # worker thread dying flips _failover_pending instead of aborting;
+        # survivors raise FailoverRequired, roll back, and park in
+        # failover_rendezvous() until the supervisor (runner) swaps in a
+        # replacement thread and bumps _generation
+        self._failover_enabled = False
+        self._failover_pending = False
+        self._failed: set = set()
+        self._parked: set = set()
+        self._generation = 0
+        self._restarts = 0
+        try:
+            self._max_restarts = int(
+                os.environ.get("PATHWAY_MAX_FAILOVERS", 3)
+            )
+        except ValueError:
+            self._max_restarts = 3
         # (dest_thread, channel, time) -> {sender_global: [deltas]}
         self._data: Dict[tuple, dict] = {}
         # (dest_thread, channel, time) -> {sender_global}
@@ -714,8 +1017,95 @@ class ThreadGroupCoordinator:
         with self._cv:
             self._cv.notify_all()
 
+    # -- live failover -----------------------------------------------------
+    def enable_failover(self) -> None:
+        """Worker-thread deaths become live failovers instead of group
+        aborts.  In-memory thread mode only: the hybrid threads x
+        processes topology would need the thread swap AND the TCP fence
+        in one transaction, which is out of scope — it keeps fail-fast."""
+        if self.tcp is None and self.threads > 1:
+            self._failover_enabled = True
+
+    def note_worker_failure(
+        self, thread_index: int, exc: BaseException
+    ) -> bool:
+        """Called by the runner when worker ``thread_index`` died with
+        ``exc``.  True: the group absorbs the death as a live failover
+        and the caller must spawn a replacement (supervise_failover).
+        False: fatal — abort the group as before.  Injected kills
+        (faults.WorkerKilled) are always failover-eligible; organic
+        crashes only under PATHWAY_FAILOVER=1 (an organic crash usually
+        recurs deterministically on replay)."""
+        from pathway_tpu.internals.faults import WorkerKilled
+
+        injected = isinstance(exc, WorkerKilled)
+        with self._cv:
+            if (
+                not self._failover_enabled
+                or self._failover_pending
+                or self._aborted
+                or self._restarts >= self._max_restarts
+                or not (
+                    injected or os.environ.get("PATHWAY_FAILOVER") == "1"
+                )
+            ):
+                return False
+            self._restarts += 1
+            self._failed.add(thread_index)
+            self._failover_pending = True
+            self._cv.notify_all()
+        # wake agree() waiters; they convert the broken barrier into
+        # FailoverRequired while _failover_pending is set
+        self._barrier.abort()
+        return True
+
+    def failover_rendezvous(self, thread_index: int) -> None:
+        """Survivor parks here after its rollback; released when the
+        supervisor has installed the replacement worker, reset the
+        barrier, and bumped the generation."""
+        with self._cv:
+            gen = self._generation
+            self._parked.add(thread_index)
+            self._cv.notify_all()
+            while self._generation == gen and not self._aborted:
+                self._cv.wait(timeout=0.1)
+            if self._aborted:
+                raise ExchangeError(
+                    f"thread worker {thread_index}: group aborted during "
+                    f"failover"
+                )
+
+    def complete_failover(self) -> None:
+        """Supervisor side (runner): called once every survivor is parked
+        and the replacement thread is about to start.  Purges all
+        exchange state from the abandoned timeline, installs a fresh
+        barrier, and releases the parked survivors."""
+        with self._cv:
+            self._data.clear()
+            self._punct.clear()
+            self._stamps.clear()
+            self._votes = [None] * self.threads
+            self._result = None
+            self._barrier = threading.Barrier(self.threads)
+            self._failed.clear()
+            self._parked.clear()
+            self._failover_pending = False
+            self._generation += 1
+            self._cv.notify_all()
+
     # -- called by facades -------------------------------------------------
     def agree(self, thread_index: int, payload: Any) -> List[Any]:
+        if self._failover_pending and not self._aborted:
+            # a failover is in flight: survivors that were not blocked on
+            # the barrier when it broke learn about it here, BEFORE they
+            # could wait on the replacement barrier with a stale vote
+            from pathway_tpu.engine.engine import FailoverRequired
+
+            raise FailoverRequired(
+                f"thread worker {thread_index}: sibling worker(s) "
+                f"{sorted(self._failed)} died; rolling back",
+                dead=tuple(sorted(self._failed)),
+            )
         self._votes[thread_index] = payload
         try:
             idx = self._barrier.wait()
@@ -730,6 +1120,14 @@ class ThreadGroupCoordinator:
                     self._result = local
             self._barrier.wait()
         except threading.BrokenBarrierError:
+            if self._failover_pending and not self._aborted:
+                from pathway_tpu.engine.engine import FailoverRequired
+
+                raise FailoverRequired(
+                    f"thread worker {thread_index}: sibling worker(s) "
+                    f"{sorted(self._failed)} died; rolling back",
+                    dead=tuple(sorted(self._failed)),
+                ) from None
             raise ExchangeError(
                 f"thread worker {thread_index}: a sibling worker died"
             ) from None
@@ -807,9 +1205,30 @@ class _ThreadWorkerCoordinator(Coordinator):
         # only cross-process destinations hit encode + socket
         return dest // self.group.threads != self.group.process_id
 
+    def _ctx(self) -> str:
+        """Flight-recorder tail for dead-sibling errors (installed by the
+        engine as on_dead_context)."""
+        cb = getattr(self, "on_dead_context", None)
+        if cb is None:
+            return ""
+        try:
+            tail = cb()
+        except Exception:  # noqa: BLE001 — diagnostics must not mask
+            return ""
+        return f" | recent engine events: {tail}" if tail else ""
+
+    def enable_failover(self) -> None:
+        self.group.enable_failover()
+
+    def failover_rendezvous(self) -> None:
+        self.group.failover_rendezvous(self.thread_index)
+
     def agree(self, payload: Any) -> List[Any]:
         t0 = time_mod.monotonic()
-        result = self.group.agree(self.thread_index, payload)
+        try:
+            result = self.group.agree(self.thread_index, payload)
+        except ExchangeError as exc:
+            raise ExchangeError(str(exc) + self._ctx()) from None
         self._m_agree_wait.observe(time_mod.monotonic() - t0)
         return result
 
@@ -919,9 +1338,18 @@ class _ThreadWorkerCoordinator(Coordinator):
         key = (me_t, channel, time)
         with g._cv:
             while len(g._punct.get(key, ())) < need_local:
+                if g._failover_pending and not g._aborted:
+                    from pathway_tpu.engine.engine import FailoverRequired
+
+                    raise FailoverRequired(
+                        f"worker {self.worker_id}: sibling worker(s) "
+                        f"{sorted(g._failed)} died; rolling back",
+                        dead=tuple(sorted(g._failed)),
+                    )
                 if g._aborted:
                     raise ExchangeError(
                         f"worker {self.worker_id}: a sibling worker died"
+                        + self._ctx()
                     )
                 if g.tcp is not None:
                     g.tcp._check_dead()

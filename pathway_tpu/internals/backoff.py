@@ -1,0 +1,65 @@
+"""Shared capped-exponential-backoff-with-jitter helper.
+
+Every connector retry site used to roll its own ``min(0.05 * 2**n, cap)``
+sleep (or worse, a bare counter).  This module is the one implementation:
+deterministic when seeded (chaos tests replay identical schedules),
+full-jitter by default (decorrelates a thundering herd of connectors
+retrying the same broker), and metrics-friendly — callers report the
+delay they are about to sleep through ``report_retry`` on the connector
+subject, which exports attempt counts and cumulative backoff seconds.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterator, Optional
+
+
+class Backoff:
+    """Capped exponential backoff with proportional jitter.
+
+    delay(attempt) = min(cap, base * factor**attempt), then scaled by a
+    uniform factor in [1-jitter, 1+jitter].  ``jitter=0`` gives the
+    exact deterministic schedule.
+    """
+
+    def __init__(
+        self,
+        *,
+        base: float = 0.05,
+        cap: float = 5.0,
+        factor: float = 2.0,
+        jitter: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        if base <= 0 or cap <= 0 or factor < 1.0:
+            raise ValueError("base/cap must be > 0 and factor >= 1")
+        if not (0.0 <= jitter < 1.0):
+            raise ValueError("jitter must be in [0, 1)")
+        self.base = base
+        self.cap = cap
+        self.factor = factor
+        self.jitter = jitter
+        self.attempt = 0
+        self._rng = random.Random(seed)
+
+    def next_delay(self) -> float:
+        """The delay for the current attempt; advances the attempt count."""
+        delay = min(self.cap, self.base * self.factor ** self.attempt)
+        self.attempt += 1
+        if self.jitter:
+            delay *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return delay
+
+    def peek_delay(self) -> float:
+        """The un-jittered delay the next next_delay() call is based on."""
+        return min(self.cap, self.base * self.factor ** self.attempt)
+
+    def reset(self) -> None:
+        """Call after a success so the next failure starts from ``base``."""
+        self.attempt = 0
+
+    def delays(self, max_attempts: int) -> Iterator[float]:
+        """At most ``max_attempts`` delays (retry-loop sugar)."""
+        for _ in range(max_attempts):
+            yield self.next_delay()

@@ -97,7 +97,28 @@ class DeviceMonitor:
         self.timeout_s = timeout_s
         self.probe = probe
         self.last: Dict[str, Any] = {"status": "not_started"}
+        # degradation state machine: HEALTHY <-> DEGRADED.  A failed (or
+        # fault-injected) probe flips to DEGRADED — device-phase work
+        # routes to the host path (see stdlib/indexing) — and the monitor
+        # re-probes on a capped exponential backoff instead of the slow
+        # steady-state period, so re-promotion is prompt after a blip but
+        # a hard outage doesn't burn a subprocess per second.
+        from pathway_tpu.internals.backoff import Backoff
+
+        self.state = "healthy"  # optimistic until a probe says otherwise
+        self.flaps = 0  # healthy->degraded transitions
+        self.promotions = 0  # degraded->healthy transitions
+        self.degraded_since: Optional[float] = None
+        self._reprobe = Backoff(
+            base=1.0, cap=self.interval_s, jitter=0.25, seed=0
+        )
         reg = self.metrics = MetricsRegistry()
+        reg.gauge(
+            "pathway_device_degraded",
+            help="1 while device-phase work is routed to the host path "
+            "(probe failed or fault-injected flap), 0 when healthy",
+            callback=lambda: 1 if self.state == "degraded" else 0,
+        )
         reg.gauge(
             "pathway_device_rtt_ms",
             help="round-trip of one tiny jit dispatch on the accelerator "
@@ -118,15 +139,38 @@ class DeviceMonitor:
         self._thread: Optional[threading.Thread] = None
 
     def probe_once(self) -> Dict[str, Any]:
-        rtt, err = self.probe(self.timeout_s)
+        from pathway_tpu.internals import faults
+
+        if faults.ACTIVE and faults.probe_flap():
+            rtt, err = None, "injected device flap (PATHWAY_FAULTS)"
+        else:
+            rtt, err = self.probe(self.timeout_s)
+        self._transition(err is None)
         self.last = {
             "status": "healthy" if err is None else "down",
             "healthy": err is None,
+            "state": self.state,
             "rtt_ms": round(rtt, 3) if rtt is not None else None,
             "error": err,
             "checked_at": time_mod.time(),
+            "flaps": self.flaps,
+            "promotions": self.promotions,
+            "degraded_since": self.degraded_since,
         }
         return self.last
+
+    def _transition(self, healthy: bool) -> None:
+        if healthy:
+            if self.state == "degraded":
+                self.promotions += 1
+            self.state = "healthy"
+            self.degraded_since = None
+            self._reprobe.reset()
+        else:
+            if self.state != "degraded":
+                self.flaps += 1
+                self.degraded_since = time_mod.time()
+            self.state = "degraded"
 
     def start(self) -> None:
         if self._thread is not None:
@@ -141,9 +185,17 @@ class DeviceMonitor:
             try:
                 self.probe_once()
             except Exception as exc:  # noqa: BLE001 — monitor must survive
+                self._transition(False)
                 self.last = {"status": "down", "healthy": False,
+                             "state": self.state,
                              "error": f"{type(exc).__name__}: {exc}"}
-            if self._stop.wait(self.interval_s):
+            # degraded: re-probe on capped exponential backoff so
+            # re-promotion doesn't wait out the steady-state period
+            if self.state == "degraded":
+                delay = min(self._reprobe.next_delay(), self.interval_s)
+            else:
+                delay = self.interval_s
+            if self._stop.wait(delay):
                 return
 
     def stop(self) -> None:
@@ -175,3 +227,12 @@ def device_status() -> Dict[str, Any]:
     if _monitor is None:
         return {"status": "not_started"}
     return dict(_monitor.last)
+
+
+def device_degraded() -> bool:
+    """Hot-path gate for host-path fallback: True while the monitor holds
+    the device DEGRADED.  One global read + one attribute read when no
+    monitor is running, so device-phase consumers can consult it per
+    dispatch batch."""
+    m = _monitor
+    return m is not None and m.state == "degraded"

@@ -500,6 +500,17 @@ class EngineMetrics:
             labels=("node", "name", "path"),
             callback=lambda: self._path_counts("batches_processed"),
         )
+        # fault tolerance (engine ints so they work with metrics off)
+        reg.counter(
+            "pathway_failover_total",
+            help="live worker-failover recoveries completed by this worker",
+            callback=lambda: getattr(engine, "failover_count", 0),
+        )
+        reg.counter(
+            "pathway_sink_txn_commits_total",
+            help="snapshot-aligned transactional sink commits",
+            callback=lambda: getattr(engine, "sink_txn_commits", 0),
+        )
         # connector runtime (reference: src/connectors/monitoring.rs)
         for metric, key, kind, hlp in (
             ("pathway_connector_rows_read", "rows_read", "counter",
@@ -510,6 +521,8 @@ class EngineMetrics:
              "seconds since the source last produced an event"),
             ("pathway_connector_retries", "retries", "counter",
              "reader retry/reconnect attempts"),
+            ("pathway_connector_backoff_seconds", "backoff_s", "counter",
+             "total seconds the reader spent in retry backoff"),
         ):
             getattr(reg, kind)(
                 metric,

@@ -295,6 +295,13 @@ class PrometheusServer:
                     "freshness": (
                         m.sink_freshness_stats() if m is not None else []
                     ),
+                    # fault-tolerance counters (engine/engine.py): live
+                    # failovers survived and snapshot-aligned sink commits
+                    "failovers": getattr(e, "failover_count", 0),
+                    "failover_recovery_s": getattr(
+                        e, "last_failover_recovery_s", None
+                    ),
+                    "sink_txn_commits": getattr(e, "sink_txn_commits", 0),
                 }
             )
         e0 = self.engine

@@ -134,8 +134,12 @@ def run(
     """pw.run — execute every registered sink (reference:
     internals/run.py:11)."""
     global _last_engine
-    from pathway_tpu.internals import telemetry
+    from pathway_tpu.internals import faults, telemetry
     from pathway_tpu.internals.config import pathway_config as cfg
+
+    # Arm the chaos harness once per run, before any worker starts
+    # (per-worker arming would race and reset fire-once budgets).
+    faults.install_from_env()
 
     if cfg.threads > 1:
         return _run_threaded(
@@ -280,19 +284,20 @@ def _run_threaded(
 
                     _tm2.export_engine_trace(engine)
         except BaseException as exc:  # noqa: BLE001 — propagate to caller
+            if group.note_worker_failure(thread_index, exc):
+                return  # absorbed: the supervisor loop respawns this slot
             errors.append(exc)
             group.abort()
 
-    ts = [
-        threading_mod.Thread(
+    ts = {
+        i: threading_mod.Thread(
             target=worker, args=(i,), name=f"pw-worker-{i}"
         )
         for i in range(threads)
-    ]
-    for t in ts:
+    }
+    for t in ts.values():
         t.start()
-    for t in ts:
-        t.join()
+    _supervise_thread_group(group, ts, worker, threads)
     if errors:
         from pathway_tpu.analysis import AnalysisError
 
@@ -302,6 +307,63 @@ def _run_threaded(
             if isinstance(e, AnalysisError):
                 raise e
         raise errors[0]
+
+
+def _supervise_thread_group(group, ts, worker, threads: int) -> None:
+    """Join the worker threads, respawning dead ones mid-job when the
+    group absorbed their failure (live failover: note_worker_failure
+    aborted the barrier, survivors roll back and park in
+    failover_rendezvous; we join the corpse, reset the group state and
+    start a replacement thread on the same slot)."""
+    import os
+    import time as time_mod
+
+    try:
+        rejoin_timeout = float(os.environ.get("PATHWAY_REJOIN_TIMEOUT", "30"))
+    except ValueError:
+        rejoin_timeout = 30.0
+    while True:
+        if group._failover_pending and not group._aborted:
+            failed = sorted(group._failed)
+            survivors = set(range(threads)) - set(failed)
+            deadline = time_mod.monotonic() + rejoin_timeout
+            parked = True
+            with group._cv:
+                while (
+                    not group._aborted
+                    and not survivors <= group._parked
+                ):
+                    remaining = deadline - time_mod.monotonic()
+                    if remaining <= 0:
+                        parked = False
+                        break
+                    group._cv.wait(min(remaining, 0.1))
+            if group._aborted:
+                continue
+            if not parked:
+                # a survivor never reached the rendezvous (wedged in user
+                # code, or its own rollback failed): give up on failover
+                group.abort()
+                continue
+            for i in failed:
+                ts[i].join(timeout=5.0)
+            # releases the parked survivors (generation bump) and resets
+            # barrier/votes/buffers for the new timeline
+            group.complete_failover()
+            import threading as threading_mod
+
+            for i in failed:
+                t = threading_mod.Thread(
+                    target=worker, args=(i,), name=f"pw-worker-{i}"
+                )
+                ts[i] = t
+                t.start()
+            continue
+        if all(not t.is_alive() for t in ts.values()):
+            break
+        time_mod.sleep(0.02)
+    for t in ts.values():
+        t.join()
 
 
 def _maybe_start_dashboard(engine: Engine, monitoring_level):

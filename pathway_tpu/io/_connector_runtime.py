@@ -284,14 +284,16 @@ class ConnectorSubjectBase:
 
     _worker_id = 0
     _worker_count = 1
-    # class-level default so report_retry works even when a subclass
+    # class-level defaults so report_retry works even when a subclass
     # forgets to call super().__init__()
     _retries = 0
+    _backoff_s = 0.0
 
     def __init__(self):
         self._sink = None
         self._closed = False
         self._retries = 0
+        self._backoff_s = 0.0
         self._object_cache = None  # CachedObjectStorage under persistence
 
     def _bind(self, sink) -> None:
@@ -326,11 +328,15 @@ class ConnectorSubjectBase:
         else:
             self.next_batch([dict(zip(names, v)) for v in values_list])
 
-    def report_retry(self) -> None:
+    def report_retry(self, delay: float = 0.0) -> None:
         """Count a transient read failure that the subject retried
-        (network hiccup, rate limit). Surfaces as the per-connector
-        ``retries`` stat / ``pathway_connector_retries`` series."""
+        (network hiccup, rate limit) and the backoff it cost.  Retry
+        sites compute ``delay`` with internals/backoff.Backoff (capped
+        exponential + jitter) and pass it here so every connector
+        surfaces uniform ``retries`` / ``backoff_s`` stats
+        (``pathway_connector_retries`` / ``_backoff_seconds``)."""
         self._retries += 1
+        self._backoff_s += delay
 
     def next_json(self, message: dict) -> None:
         self.next(**message)
@@ -545,6 +551,11 @@ class StreamingDriver:
             self.engine._gc_unfreeze()
 
     def _run(self, sources: List[LiveSource]) -> None:
+        import os
+
+        from pathway_tpu.engine.engine import EngineError, FailoverRequired
+        from pathway_tpu.internals import faults
+
         threads = []
         active = 0
         replayed: Dict[LiveSource, List] = {}
@@ -557,7 +568,7 @@ class StreamingDriver:
         # appended after the last compaction
         op_mgr = None
         snap_interval = 0.0
-        restored_time = None
+        manifest = None
         snap_ms = (
             getattr(self.persistence_config, "snapshot_interval_ms", 0)
             if self.persistence_config is not None
@@ -574,6 +585,14 @@ class StreamingDriver:
                 self.engine.worker_id,
             )
             snap_interval = snap_ms / 1000.0
+
+        def restore_states():
+            """Load + apply the newest commonly-restorable operator
+            snapshot; returns the restored frontier or None.  Runs once at
+            startup and again after each failover rollback."""
+            nonlocal manifest
+            if op_mgr is None:
+                return None
             manifest = op_mgr.load_manifest()
             # phase 1 loads blobs without mutating; phase 2 applies only if
             # EVERY worker can restore the same frontier — a one-sided
@@ -596,12 +615,53 @@ class StreamingDriver:
                 agreed = local_time
             if agreed >= 0:
                 op_mgr.apply_states(self.engine, states)
-                restored_time = agreed
+                return agreed
+            return None
+
+        restored_time = restore_states()
+        # exactly-once sinks: truncate/roll back anything staged past the
+        # restored frontier (post-restore epochs renumber and would
+        # collide) and idempotently re-run any commit the previous run's
+        # crash interrupted
+        for w in self.engine._txn_sinks:
+            w.recover(restored_time if restored_time is not None else -1)
 
         engine_nodes = getattr(self.engine, "_live_nodes", {})
 
         def node_of(live):
             return engine_nodes.get(live, live.node)
+
+        def compute_replay() -> Dict[LiveSource, List]:
+            """(Re-)read the event-log tail each source must replay on top
+            of the restored state.  Called at startup and again after a
+            failover rollback — the log is written BEFORE batches are
+            pushed into the engine (see flush), so it is complete for any
+            frontier the group rolls back to."""
+            out: Dict[LiveSource, List] = {}
+            for live in sinks:
+                writer = self._snapshot_writer(live)
+                if writer is None:
+                    continue
+                if restored_time is not None:
+                    # operator state restored: replay only the segments
+                    # appended after the manifest's folded frontier
+                    folded = (manifest or {}).get("folded_through", {})
+                    events = writer.read_events(
+                        after_segment=folded.get(live.name, -1)
+                    )
+                elif op_mgr is not None:
+                    # restore refused (fresh run, graph change, diverged
+                    # workers): consolidated base + every later segment is
+                    # the complete history
+                    base, base_seg = op_mgr.read_base(live.name)
+                    events = base + writer.read_events(
+                        after_segment=base_seg
+                    )
+                else:
+                    events = writer.read_events()
+                if events:
+                    out[live] = events
+            return out
 
         for live in sources:
             if node_of(live) is None:
@@ -642,25 +702,6 @@ class StreamingDriver:
                 )
             writer = self._snapshot_writer(live)
             if writer is not None:
-                if restored_time is not None:
-                    # operator state restored: replay only the segments
-                    # appended after the manifest's folded frontier
-                    folded = (manifest or {}).get("folded_through", {})
-                    events = writer.read_events(
-                        after_segment=folded.get(live.name, -1)
-                    )
-                elif op_mgr is not None:
-                    # restore refused (fresh run, graph change, diverged
-                    # workers): consolidated base + every later segment is
-                    # the complete history
-                    base, base_seg = op_mgr.read_base(live.name)
-                    events = base + writer.read_events(
-                        after_segment=base_seg
-                    )
-                else:
-                    events = writer.read_events()
-                if events:
-                    replayed[live] = events
                 state = writer.read_state()
                 if state is not None:
                     sink._counter = state.get("counter", 0)
@@ -676,27 +717,9 @@ class StreamingDriver:
             t = threading.Thread(target=runner, daemon=True, name=live.name)
             threads.append(t)
             active += 1
-        # initial time 0 processes static parts of the graph (a restored
-        # run re-runs it harmlessly: restored source state marks static
-        # rows as already emitted)
-        self.engine.process_time(0)
-        # replay persisted input snapshots as the first batch (reference:
-        # rewind_from_disk_snapshot, connectors/mod.rs:256). After an
-        # operator-snapshot restore the log holds only the tail appended
-        # since the last compaction; it replays on top of restored state.
-        # Multi-worker: the replay step happens on every worker if it
-        # happens anywhere so the lockstep time sequence stays identical.
-        time = 2 if restored_time is None else restored_time + 2
-        if self.engine.global_any(bool(replayed)):
-            for live, events in replayed.items():
-                node_of(live).push(time, events)
-            self.engine.process_time(time)
-            time += 2
-        start_t = time_mod.monotonic()
-        for live in sinks:
-            last_event[live] = start_t
-        for t in threads:
-            t.start()
+        replayed = compute_replay()
+        time = 2  # set per attempt in the run loop below
+        started = False
 
         pending: Dict[LiveSource, List] = {}
         states: Dict[LiveSource, Any] = {}
@@ -734,6 +757,12 @@ class StreamingDriver:
             worker reaches the same tick — that is the frontier protocol."""
             nonlocal time, last_flush, last_snapshot, done
             nonlocal dirty_since_snapshot, batch_arrival
+            if faults.ACTIVE:
+                # deterministic chaos: may raise WorkerKilled (this worker
+                # dies at its scheduled epoch, BEFORE voting — peers see a
+                # dead peer mid-agree, exactly like a real crash) or sever
+                # a peer socket
+                faults.on_epoch(my_worker, time, self.engine.coord)
             self.engine.flush_ticks = getattr(self.engine, "flush_ticks", 0) + 1
             has_data = any(
                 (committed_upto.get(live, 0) > 0 or not gated(live)
@@ -832,6 +861,9 @@ class StreamingDriver:
                         "pending": len(pending.get(live_, ())),
                         "read_lag_s": now_ - last_event.get(live_, now_),
                         "retries": getattr(subj, "_retries", 0),
+                        "backoff_s": round(
+                            getattr(subj, "_backoff_s", 0.0), 6
+                        ),
                     }
                 dirty_since_snapshot = True
                 processed_batch = time
@@ -840,10 +872,44 @@ class StreamingDriver:
                 # quiescent frontier: the last time is fully processed and
                 # queues are drained — checkpoint operator state + compact
                 # logs (multi-worker: snap_due was agreed, and any_data is
-                # agreed, so every worker saves the same frontier)
-                op_mgr.save(self.engine, time - 2, snapshot_writers)
+                # agreed, so every worker saves the same frontier).
+                # Exactly-once sinks ride the same commit point: staged
+                # BEFORE the manifest, finalized only after the manifest
+                # landed — a crash anywhere in between either replays the
+                # epoch (pre-manifest) or idempotently re-finalizes
+                # (post-manifest), never both.
+                frontier = time - 2
+                txn = self.engine._txn_sinks
+                saved = False
+                try:
+                    for w in txn:
+                        w.prepare(frontier)
+                    saved = op_mgr.save(
+                        self.engine, frontier, snapshot_writers
+                    )
+                    if saved:
+                        for w in txn:
+                            w.commit(frontier)
+                        if txn:
+                            self.engine.sink_txn_commits += 1
+                except Exception as exc:  # noqa: BLE001 — store failure
+                    # a failed stage/finalize never kills the job: staged
+                    # blobs stay provisional, and the next successful
+                    # snapshot (or recover on restart) finalizes or rolls
+                    # them back idempotently
+                    self.engine.warn_once(
+                        f"sink-txn-{type(exc).__name__}",
+                        "snapshot sink transaction at frontier %s failed "
+                        "(%s: %s) — continuing, the next snapshot retries",
+                        frontier,
+                        type(exc).__name__,
+                        exc,
+                    )
+                if saved:
+                    dirty_since_snapshot = False
+                # failed save: staged sink blobs stay; the next successful
+                # commit (or recover on restart) finalizes them
                 last_snapshot = time_mod.monotonic()
-                dirty_since_snapshot = False
             # run scheduled times that are due.  Multi-worker: the first
             # due time came from the tick vote (no extra round) — times
             # scheduled DURING this tick surface on the next vote, one
@@ -869,70 +935,163 @@ class StreamingDriver:
                 nxt = self.engine.global_next_time()
             last_flush = time_mod.monotonic()
 
-        while not done:
-            timeout = max(
-                0.0, self.autocommit_s - (time_mod.monotonic() - last_flush)
-            )
-            if timeout == 0.0:
-                # autocommit deadline passed — flush even if the queue never
-                # drains (a hot source must not starve the global barrier
-                # that idle peers are blocked on)
-                flush()
-                continue
+        # live failover: with snapshots on and a failover-capable
+        # coordinator, a peer death surfaces as FailoverRequired out of a
+        # coordination wait instead of a fatal error; survivors roll back
+        # to the last persisted frontier and a replacement worker rejoins
+        # the SAME run — the job never restarts.
+        coord = self.engine.coord
+        if (
+            op_mgr is not None
+            and self.engine.worker_count > 1
+            and hasattr(coord, "enable_failover")
+        ):
+            coord.enable_failover()
+        max_failovers = int(os.environ.get("PATHWAY_MAX_FAILOVERS", "3"))
+        failovers = 0
+        failover_started = 0.0
+        while True:
             try:
-                events = [self.queue.get(timeout=timeout)]
-            except queue_mod.Empty:
-                flush()
-                continue
-            # drain whatever already queued up: events that arrived while
-            # the engine was busy coalesce into ONE batch — server-side
-            # micro-batching that amortizes the per-dispatch device round
-            # trip across concurrent requests (reference: commit ticks
-            # group per-duration; here load itself sets the batch size).
-            # Bounded so a hot source cannot starve the autocommit
-            # deadline / multi-worker barrier.
-            while len(events) < 4096:
-                try:
-                    ev = self.queue.get_nowait()
-                except queue_mod.Empty:
-                    break
-                events.append(ev)
-                if ev[0] == "commit_b" and not multiworker:
-                    # barrier commit: later rows must not coalesce into
-                    # this tick — deterministic batch boundaries for the
-                    # bulk-ingest pipeline (multi-worker keeps timer ticks
-                    # so the agreement cadence stays identical everywhere)
-                    break
-            needs_flush = False
-            now_ev = time_mod.monotonic()
-            for kind, live, payload, counter in events:
-                counters[live] = max(counters.get(live, 0), counter)
-                last_event[live] = now_ev
-                if kind == "data":
-                    pending.setdefault(live, []).append(payload)
-                    if batch_arrival is None:
-                        batch_arrival = now_ev
-                elif kind == "data_batch":
-                    pending.setdefault(live, []).extend(payload)
-                    if batch_arrival is None:
-                        batch_arrival = now_ev
-                elif kind in ("commit", "commit_b"):
-                    if payload is not None:
-                        states[live] = payload
-                    committed_upto[live] = len(pending.get(live, []))
-                    ever_committed.add(live)
-                    # multi-worker: commits buffer until the timer tick so
-                    # every worker performs the same number of
-                    # coordination rounds
-                    needs_flush = True
-                elif kind == "close":
-                    active -= 1
-                    # close is an implicit final commit: the source is gone,
-                    # its uncommitted tail is final data
-                    committed_upto[live] = len(pending.get(live, []))
-                    needs_flush = True
-            if needs_flush and not multiworker:
-                flush()
-            if not multiworker and self.engine.terminate_flag.is_set():
+                if failovers:
+                    # roll back: drop in-flight engine state, re-restore the
+                    # snapshot every worker (incl. the replacement) agrees
+                    # on, re-read the event-log tail past that frontier.
+                    # The driver's own pending/queues survive — they hold
+                    # data never yet pushed into the engine.
+                    self.engine.reset_for_rollback()
+                    restored_time = restore_states()
+                    if restored_time is None:
+                        raise EngineError(
+                            "failover rollback failed: no commonly "
+                            "restorable operator snapshot"
+                        )
+                    for w in self.engine._txn_sinks:
+                        w.recover(restored_time)
+                    replayed = compute_replay()
+                    done = False
+                    dirty_since_snapshot = False
+                    last_snapshot = time_mod.monotonic()
+                # initial time 0 processes static parts of the graph (a
+                # restored run re-runs it harmlessly: restored source state
+                # marks static rows as already emitted)
+                self.engine.process_time(0)
+                # replay persisted input snapshots as the first batch
+                # (reference: rewind_from_disk_snapshot,
+                # connectors/mod.rs:256). After an operator-snapshot restore
+                # the log holds only the tail appended since the last
+                # compaction; it replays on top of restored state.
+                # Multi-worker: the replay step happens on every worker if
+                # it happens anywhere so the lockstep time sequence stays
+                # identical.
+                time = 2 if restored_time is None else restored_time + 2
+                if self.engine.global_any(bool(replayed)):
+                    for live, events in replayed.items():
+                        node_of(live).push(time, events)
+                    self.engine.process_time(time)
+                    time += 2
+                if failovers:
+                    self.engine.failover_count = failovers
+                    self.engine.last_failover_recovery_s = (
+                        time_mod.monotonic() - failover_started
+                    )
+                if not started:
+                    start_t = time_mod.monotonic()
+                    for live in sinks:
+                        last_event[live] = start_t
+                    for t in threads:
+                        t.start()
+                    started = True
+                while not done:
+                    timeout = max(
+                        0.0,
+                        self.autocommit_s
+                        - (time_mod.monotonic() - last_flush),
+                    )
+                    if timeout == 0.0:
+                        # autocommit deadline passed — flush even if the
+                        # queue never drains (a hot source must not starve
+                        # the global barrier that idle peers are blocked on)
+                        flush()
+                        continue
+                    try:
+                        events = [self.queue.get(timeout=timeout)]
+                    except queue_mod.Empty:
+                        flush()
+                        continue
+                    # drain whatever already queued up: events that arrived
+                    # while the engine was busy coalesce into ONE batch —
+                    # server-side micro-batching that amortizes the
+                    # per-dispatch device round trip across concurrent
+                    # requests (reference: commit ticks group per-duration;
+                    # here load itself sets the batch size).  Bounded so a
+                    # hot source cannot starve the autocommit deadline /
+                    # multi-worker barrier.
+                    while len(events) < 4096:
+                        try:
+                            ev = self.queue.get_nowait()
+                        except queue_mod.Empty:
+                            break
+                        events.append(ev)
+                        if ev[0] == "commit_b" and not multiworker:
+                            # barrier commit: later rows must not coalesce
+                            # into this tick — deterministic batch
+                            # boundaries for the bulk-ingest pipeline
+                            # (multi-worker keeps timer ticks so the
+                            # agreement cadence stays identical everywhere)
+                            break
+                    needs_flush = False
+                    now_ev = time_mod.monotonic()
+                    for kind, live, payload, counter in events:
+                        counters[live] = max(counters.get(live, 0), counter)
+                        last_event[live] = now_ev
+                        if kind == "data":
+                            pending.setdefault(live, []).append(payload)
+                            if batch_arrival is None:
+                                batch_arrival = now_ev
+                        elif kind == "data_batch":
+                            pending.setdefault(live, []).extend(payload)
+                            if batch_arrival is None:
+                                batch_arrival = now_ev
+                        elif kind in ("commit", "commit_b"):
+                            if payload is not None:
+                                states[live] = payload
+                            committed_upto[live] = len(
+                                pending.get(live, [])
+                            )
+                            ever_committed.add(live)
+                            # multi-worker: commits buffer until the timer
+                            # tick so every worker performs the same number
+                            # of coordination rounds
+                            needs_flush = True
+                        elif kind == "close":
+                            active -= 1
+                            # close is an implicit final commit: the source
+                            # is gone, its uncommitted tail is final data
+                            committed_upto[live] = len(
+                                pending.get(live, [])
+                            )
+                            needs_flush = True
+                    if needs_flush and not multiworker:
+                        flush()
+                    if not multiworker and self.engine.terminate_flag.is_set():
+                        break
                 break
+            except FailoverRequired as exc:
+                failovers += 1
+                if (
+                    op_mgr is None
+                    or failovers > max_failovers
+                    or not hasattr(coord, "failover_rendezvous")
+                ):
+                    raise
+                self.engine.warn_once(
+                    f"failover{failovers}",
+                    "worker failover %s/%s (%s) — rolling back to the "
+                    "last snapshot and waiting for the replacement",
+                    failovers,
+                    max_failovers,
+                    exc,
+                )
+                failover_started = time_mod.monotonic()
+                coord.failover_rendezvous()
         self.engine.finish()

@@ -169,22 +169,25 @@ class MessageQueueSubject(ConnectorSubjectBase):
             # resume from the persisted cursor instead of replaying the
             # stream (reference: Reader::seek, data_storage.rs:398)
             self._client.seek(self._resume_position)
+        from pathway_tpu.internals.backoff import Backoff
+
         try:
-            failures = 0
+            # transient broker hiccups: shared capped-exponential backoff
+            # (surfaced as pathway_connector_retries / _backoff_seconds)
+            # before a persistent failure kills the reader
+            backoff = Backoff(base=0.05, cap=1.0, seed=0)
             while True:
                 try:
                     batch = self._client.poll(self.poll_timeout)
                 except Exception:
-                    # transient broker hiccup: back off and retry a few
-                    # times (surfaced as pathway_connector_retries) before
-                    # letting a persistent failure kill the reader
-                    failures += 1
-                    self.report_retry()
-                    if failures > 5:
+                    if backoff.attempt >= 5:
+                        self.report_retry(0.0)
                         raise
-                    time_mod.sleep(min(0.05 * 2**failures, 1.0))
+                    delay = backoff.next_delay()
+                    self.report_retry(delay)
+                    time_mod.sleep(delay)
                     continue
-                failures = 0
+                backoff.reset()
                 if batch is None:
                     return  # stream finished
                 got = False
@@ -246,15 +249,34 @@ def mq_read(
 class MessageQueueOutputWriter(OutputWriter):
     """Formats each delta as a message and produces to a topic (reference:
     Kafka/NATS/MQTT writers in data_storage.rs; JsonLines formatter
-    data_format.rs:2059)."""
+    data_format.rs:2059).
+
+    Under a persistent run with snapshots enabled, epochs buffer until
+    the snapshot-aligned commit: `prepare(F)` durably stages messages
+    <= F in the SinkCommitLog before the manifest, `commit(F)` produces
+    every staged epoch past the log's committed frontier and then
+    advances the marker.  Replayed epochs <= the committed frontier are
+    suppressed on resume (they are never re-staged).  Brokers without
+    transactions leave one race — a crash between the final produce and
+    the marker write re-produces that window on recovery — so the MQ
+    sink is exactly-once up to that documented at-least-once edge.
+    """
 
     def __init__(self, client, topic: str, *, format: str = "json", key_column: str | None = None):
         self.client = client
         self.topic = topic
         self.format = format
         self.key_column = key_column
+        self.log = None
+        self._epochs: list = []
 
-    def write_batch(self, events: Sequence[RowEvent]) -> None:
+    transactional = True
+
+    def bind_commit_log(self, log) -> None:
+        self.log = log
+
+    def _messages(self, events: Sequence[RowEvent]) -> list:
+        msgs = []
         for ev in events:
             obj = {k: jsonable(v) for k, v in ev.values.items()}
             obj["time"] = ev.time
@@ -264,10 +286,52 @@ class MessageQueueOutputWriter(OutputWriter):
             if self.key_column is not None:
                 kv = ev.values.get(self.key_column)
                 key = str(jsonable(kv)).encode() if kv is not None else None
-            self.client.produce(self.topic, key, payload)
+            msgs.append((key, payload))
+        return msgs
+
+    def write_batch(self, events: Sequence[RowEvent]) -> None:
+        msgs = self._messages(events)
+        if self.log is None:
+            for key, payload in msgs:
+                self.client.produce(self.topic, key, payload)
+            return
+        self._epochs.append((events[0].time, msgs))
+
+    def prepare(self, frontier: int) -> None:
+        import pickle
+
+        ready = [(t, m) for t, m in self._epochs if t <= frontier]
+        self._epochs = [(t, m) for t, m in self._epochs if t > frontier]
+        self.log.stage(frontier, pickle.dumps(ready))
+
+    def commit(self, frontier: int) -> None:
+        self._finalize(frontier)
+
+    def _finalize(self, frontier: int) -> None:
+        import pickle
+
+        committed = self.log.committed_frontier()
+        for _f, blob in self.log.read_staged(committed, frontier):
+            for _t, msgs in pickle.loads(blob):
+                for key, payload in msgs:
+                    self.client.produce(self.topic, key, payload)
+        self.client.commit()
+        self.log.mark_committed(frontier)
+
+    def recover(self, frontier: int) -> None:
+        self._epochs.clear()
+        if self.log is None:
+            return
+        self.log.rollback_to(frontier)
+        if frontier >= 0:
+            self._finalize(frontier)
+
+    def committed_frontier(self) -> int:
+        return -1 if self.log is None else self.log.committed_frontier()
 
     def flush(self) -> None:
-        self.client.commit()
+        if self.log is None:
+            self.client.commit()
 
     def close(self) -> None:
         self.client.close()

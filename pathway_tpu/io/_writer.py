@@ -35,10 +35,51 @@ class OutputWriter:
     """Backend writer interface (reference: data_storage.rs:660).
 
     `write_batch` receives all deltas of one closed engine time, in order.
+
+    Transactional contract (exactly-once sinks): a writer that sets
+    `transactional = True` and is bound to a `SinkCommitLog` participates
+    in the engine's snapshot-aligned two-phase commit.  Output for epoch
+    T becomes durable only when the operator-snapshot frontier reaches
+    >= T; the streaming driver drives the protocol around each snapshot:
+
+        begin_epoch(T)   before the events of epoch T arrive
+        prepare(F)       BEFORE the snapshot manifest is written —
+                         durably stage everything <= F
+        commit(F)        AFTER the manifest — idempotent finalize
+        recover(M)       at (re)start / rollback — discard everything
+                         past the restore frontier M (M = -1 on a full
+                         replay) and re-run any unfinished finalize
+
+    All defaults are no-ops so existing writers are unaffected.
     """
+
+    transactional = False
+
+    def fork(self, worker_id: int) -> "OutputWriter":
+        """Per-worker instance (multi-worker runs attach each worker's
+        own session; default: shared instance, as before)."""
+        return self
+
+    def bind_commit_log(self, log) -> None:
+        """Receive this worker's SinkCommitLog when persistence is on."""
+
+    def begin_epoch(self, time: int) -> None:
+        pass
 
     def write_batch(self, events: Sequence[RowEvent]) -> None:
         raise NotImplementedError
+
+    def prepare(self, frontier: int) -> None:
+        pass
+
+    def commit(self, frontier: int) -> None:
+        pass
+
+    def recover(self, frontier: int) -> None:
+        pass
+
+    def committed_frontier(self) -> int:
+        return -1
 
     def flush(self) -> None:  # called after each time
         pass
@@ -55,7 +96,21 @@ def attach_writer(table, writer: OutputWriter, *, name: str | None = None) -> No
     def attach(ctx, nodes):
         from pathway_tpu.engine.engine import SubscribeNode
 
+        engine = ctx.engine
         (node,) = nodes
+        sink_name = name or type(writer).__name__
+        w = writer.fork(engine.worker_id)
+        if getattr(w, "transactional", False):
+            pcfg = getattr(engine, "_persistence_config", None)
+            if pcfg is not None and getattr(pcfg, "snapshot_interval_ms", 0) > 0:
+                from pathway_tpu.persistence import SinkCommitLog
+
+                w.bind_commit_log(
+                    SinkCommitLog(
+                        pcfg.backend._backend, sink_name, engine.worker_id
+                    )
+                )
+                engine.register_txn_sink(w)
         pending: List[RowEvent] = []
 
         def on_change(key, row, time, is_addition):
@@ -69,18 +124,19 @@ def attach_writer(table, writer: OutputWriter, *, name: str | None = None) -> No
             )
 
         def on_time_end(time):
+            w.begin_epoch(time)
             if pending:
-                writer.write_batch(list(pending))
+                w.write_batch(list(pending))
                 pending.clear()
-            writer.flush()
+            w.flush()
 
         def on_end():
             if pending:
-                writer.write_batch(list(pending))
+                w.write_batch(list(pending))
                 pending.clear()
-            writer.close()
+            w.close()
 
-        SubscribeNode(
+        sub = SubscribeNode(
             ctx.engine,
             node,
             on_change=on_change,
@@ -88,8 +144,12 @@ def attach_writer(table, writer: OutputWriter, *, name: str | None = None) -> No
             on_end=on_end,
             column_names=column_names,
             # freshness label: explicit sink name, else the writer class
-            sink_name=name or type(writer).__name__,
+            sink_name=sink_name,
         )
+        # failover rollback (Engine.reset_for_rollback): rows buffered for
+        # an epoch the rollback abandoned are regenerated by replay — drop
+        # them here so they cannot double-write into the new timeline
+        sub.on_rollback = pending.clear
 
     G.add_sink([table], attach)
 

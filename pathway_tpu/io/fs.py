@@ -426,18 +426,101 @@ def worker_output_path(filename: str, engine) -> str:
     return f"{filename}.{engine.worker_id}"
 
 
+class _TxnFileSink:
+    """Transactional wrapper around one worker's output file.
+
+    Exactly-once by offset truncation: at every snapshot the driver calls
+    `prepare(F)` BEFORE the manifest (fsync + record the byte length of
+    everything <= F in the sink commit log) and `commit(F)` after it.  On
+    recovery at restore frontier M the file is truncated back to the
+    length recorded for M — the entry always exists, because the sink
+    record of frontier F precedes the manifest of the same F — and the
+    replayed epochs regenerate the tail.  `recover(-1)` (full replay)
+    truncates to zero: the whole stream is rewritten, still exactly once.
+    """
+
+    transactional = True
+
+    def __init__(self, path: str, commit_log, write_header=None):
+        self.path = path
+        self.log = commit_log
+        self._write_header = write_header
+        self.fh = open(path, "a+", newline="")
+        self.fh.seek(0, os.SEEK_END)
+        if self.fh.tell() == 0 and write_header is not None:
+            write_header()
+
+    def prepare(self, frontier: int) -> None:
+        self.fh.flush()
+        os.fsync(self.fh.fileno())
+        self.log.record_offset(frontier, self.fh.tell())
+
+    def commit(self, frontier: int) -> None:
+        self.log.mark_committed(frontier)
+
+    def recover(self, frontier: int) -> None:
+        offset = self.log.offset_for(frontier) if frontier >= 0 else 0
+        if offset is None:
+            offset = 0
+        self.log.rollback_to(frontier)
+        self.fh.flush()
+        self.fh.truncate(offset)
+        self.fh.seek(offset)
+        if offset == 0 and self._write_header is not None:
+            self._write_header()
+
+    def committed_frontier(self) -> int:
+        return self.log.committed_frontier()
+
+
 def write(table, filename: str, *, format: str = "json", name: str | None = None, **kwargs) -> None:
-    """Write a table's change stream to a file (reference: io/fs write)."""
+    """Write a table's change stream to a file (reference: io/fs write).
+
+    Under a persistent run with operator snapshots enabled the sink is
+    exactly-once across crash/failover (see _TxnFileSink); otherwise the
+    file is truncated at open and written through, as before."""
     column_names = table.column_names()
 
     def attach(ctx, nodes):
         from pathway_tpu.engine.engine import SubscribeNode
 
+        engine = ctx.engine
         (node,) = nodes
-        fh = open(worker_output_path(filename, ctx.engine), "w", newline="")
+        path = worker_output_path(filename, engine)
+        pcfg = getattr(engine, "_persistence_config", None)
+        txn = (
+            pcfg is not None
+            and getattr(pcfg, "snapshot_interval_ms", 0) > 0
+        )
+        if txn:
+            from pathway_tpu.persistence import SinkCommitLog
+
+            sink_name = name or f"fs:{filename}"
+            sink = _TxnFileSink(
+                path,
+                SinkCommitLog(
+                    pcfg.backend._backend, sink_name, engine.worker_id
+                ),
+                write_header=None,  # bound below for csv
+            )
+            fh = sink.fh
+            engine.register_txn_sink(sink)
+        else:
+            sink = None
+            fh = open(path, "w", newline="")
         if format == "csv":
             writer = csv_mod.writer(fh)
-            writer.writerow(column_names + ["time", "diff"])
+            header_row = column_names + ["time", "diff"]
+
+            def header():
+                writer.writerow(header_row)
+
+            if sink is not None:
+                sink._write_header = header
+                if fh.tell() == 0:
+                    header()
+            else:
+                header()
 
             def on_change(key, row, time, is_addition):
                 writer.writerow(

@@ -55,8 +55,21 @@ class _HttpSubject(ConnectorSubjectBase):
             self.next(data=parsed)
 
     def run(self) -> None:
+        from pathway_tpu.internals.backoff import Backoff
+
+        backoff = Backoff(base=0.5, cap=30.0, seed=0)
         while True:
-            self._fetch()
+            try:
+                self._fetch()
+            except Exception:  # noqa: BLE001 — network/HTTP errors
+                if backoff.attempt >= 5:
+                    self.report_retry(0.0)
+                    raise
+                delay = backoff.next_delay()
+                self.report_retry(delay)
+                time_mod.sleep(delay)
+                continue
+            backoff.reset()
             self.commit()
             if self.mode == "static":
                 return

@@ -158,6 +158,13 @@ def write(
     if _client is None:
         _client = _ConfluentClient(rdkafka_settings, topic_name, for_read=False)
     key_column = getattr(key, "name", key) if key is not None else None
+    # default sink name carries the topic: the exactly-once commit log is
+    # keyed on it, and two unnamed sinks must not share a log
     _mq.mq_write(
-        table, _client, topic_name, format=format, key_column=key_column, name=name
+        table,
+        _client,
+        topic_name,
+        format=format,
+        key_column=key_column,
+        name=name or f"kafka:{topic_name}",
     )

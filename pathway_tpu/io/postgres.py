@@ -70,21 +70,149 @@ def build_snapshot_statements(
     return upsert, delete
 
 
+_COMMIT_TABLE_DDL = (
+    "CREATE TABLE IF NOT EXISTS __pathway_commit "
+    "(sink TEXT PRIMARY KEY, frontier BIGINT)"
+)
+
+
 class PostgresUpdatesWriter(OutputWriter):
+    """Append-updates writer, exactly-once under a persistent run.
+
+    Without persistence it writes through per batch, as before.  With a
+    bound SinkCommitLog, epochs buffer in memory; `prepare(F)` durably
+    stages everything <= F in the commit log BEFORE the snapshot
+    manifest, and `commit(F)` finalizes: in one DB transaction it applies
+    every staged epoch past the `__pathway_commit` frontier row and
+    advances that row to F.  The conditional apply makes finalize
+    idempotent — `recover(M)` after a crash simply re-runs it — so rows
+    land exactly once however the run dies.
+
+    `connection` may be a live DBAPI connection or a zero-arg factory;
+    multi-worker runs must pass a factory so each worker's fork opens its
+    own connection.
+    """
+
+    transactional = True
+
     def __init__(self, connection, table_name: str, columns: Sequence[str], *, placeholder: str = "%s"):
-        self.conn = connection
+        self._conn_src = connection
+        # DBAPI connections can themselves be callable (sqlite3.Connection
+        # has a __call__), so "factory" means callable AND not a connection.
+        self._is_factory = callable(connection) and not hasattr(
+            connection, "cursor"
+        )
+        self._conn = None if self._is_factory else connection
+        self.table_name = table_name
         self.columns = list(columns)
+        self.placeholder = placeholder
         self.stmt = build_insert_statement(table_name, columns, placeholder=placeholder)
+        self.log = None
+        self._worker_id = 0
+        self._epochs: List[Tuple[int, List[list]]] = []
+
+    # a live injected connection is shared (single-worker tests); a
+    # factory gives every worker its own session
+    def fork(self, worker_id: int) -> "PostgresUpdatesWriter":
+        if self._is_factory:
+            w = PostgresUpdatesWriter(
+                self._conn_src,
+                self.table_name,
+                self.columns,
+                placeholder=self.placeholder,
+            )
+        else:
+            w = self
+        w._worker_id = worker_id
+        return w
+
+    @property
+    def conn(self):
+        if self._conn is None:
+            self._conn = self._conn_src()
+        return self._conn
+
+    def bind_commit_log(self, log) -> None:
+        self.log = log
 
     def write_batch(self, events: Sequence[RowEvent]) -> None:
+        rows = [
+            [jsonable(ev.values[c]) for c in self.columns] + [ev.time, ev.diff]
+            for ev in events
+        ]
+        if self.log is None:
+            cur = self.conn.cursor()
+            for row in rows:
+                cur.execute(self.stmt, row)
+            self.conn.commit()
+            return
+        self._epochs.append((events[0].time, rows))
+
+    # -- transactional protocol ------------------------------------------
+
+    def _sink_key(self) -> str:
+        return f"{self.table_name}/{self._worker_id}"
+
+    def _ensure_commit_table(self, cur) -> None:
+        cur.execute(_COMMIT_TABLE_DDL)
+
+    def _db_frontier(self, cur) -> int:
+        ph = self.placeholder
+        cur.execute(
+            f"SELECT frontier FROM __pathway_commit WHERE sink={ph}",
+            [self._sink_key()],
+        )
+        row = cur.fetchone()
+        return int(row[0]) if row else -1
+
+    def prepare(self, frontier: int) -> None:
+        import pickle
+
+        ready = [(t, rows) for t, rows in self._epochs if t <= frontier]
+        self._epochs = [(t, rows) for t, rows in self._epochs if t > frontier]
+        self.log.stage(frontier, pickle.dumps(ready))
+
+    def commit(self, frontier: int) -> None:
+        self._finalize(frontier)
+
+    def _finalize(self, frontier: int) -> None:
+        import pickle
+
         cur = self.conn.cursor()
-        for ev in events:
-            vals = [jsonable(ev.values[c]) for c in self.columns]
-            cur.execute(self.stmt, vals + [ev.time, ev.diff])
-        self.conn.commit()
+        self._ensure_commit_table(cur)
+        db_frontier = self._db_frontier(cur)
+        if db_frontier < frontier:
+            # one transaction: staged epochs + the frontier row — atomic
+            # with respect to any crash, conditional so re-runs are no-ops
+            for _f, blob in self.log.read_staged(db_frontier, frontier):
+                for _t, rows in pickle.loads(blob):
+                    for row in rows:
+                        cur.execute(self.stmt, row)
+            ph = self.placeholder
+            cur.execute(
+                f"INSERT INTO __pathway_commit (sink, frontier) "
+                f"VALUES ({ph}, {ph}) "
+                f"ON CONFLICT (sink) DO UPDATE SET frontier=EXCLUDED.frontier",
+                [self._sink_key(), frontier],
+            )
+            self.conn.commit()
+        self.log.mark_committed(frontier)
+
+    def recover(self, frontier: int) -> None:
+        self._epochs.clear()
+        if self.log is None:
+            return
+        self.log.rollback_to(frontier)
+        if frontier >= 0:
+            # re-run any finalize the crash interrupted (idempotent)
+            self._finalize(frontier)
+
+    def committed_frontier(self) -> int:
+        return -1 if self.log is None else self.log.committed_frontier()
 
     def close(self) -> None:
-        self.conn.close()
+        if self._conn is not None:
+            self._conn.close()
 
 
 class PostgresSnapshotWriter(OutputWriter):
@@ -128,8 +256,13 @@ def write(
     **kwargs,
 ) -> None:
     """Append the change stream (with time/diff columns) to a Postgres table
-    (reference: io/postgres write:22)."""
-    conn = _connection if _connection is not None else _connect(postgres_settings)
+    (reference: io/postgres write:22). Exactly-once when the run is
+    persistent with operator snapshots enabled (see PostgresUpdatesWriter)."""
+    conn = (
+        _connection
+        if _connection is not None
+        else (lambda: _connect(postgres_settings))
+    )
     attach_writer(
         table,
         PostgresUpdatesWriter(

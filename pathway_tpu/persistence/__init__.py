@@ -18,6 +18,15 @@ import re
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from pathway_tpu.internals import faults
+
+
+def _store_fault(key: str) -> None:
+    """Fault-injection hook on every backend write (store_fail directive);
+    one boolean read when the harness is disarmed."""
+    if faults.ACTIVE:
+        faults.store_put(key)
+
 
 # Bump whenever the meaning of persisted state changes — key derivation
 # schemes, delta encodings, snapshot layouts.  Restores from a different
@@ -73,6 +82,7 @@ class FilesystemBackend(PersistenceBackend):
         return os.path.join(self.root, safe)
 
     def put_value(self, key: str, value: bytes) -> None:
+        _store_fault(key)
         path = self._path(key)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
@@ -87,6 +97,7 @@ class FilesystemBackend(PersistenceBackend):
             return f.read()
 
     def append(self, key: str, value: bytes) -> None:
+        _store_fault(key)
         with open(self._path(key), "ab") as f:
             f.write(len(value).to_bytes(8, "little"))
             f.write(value)
@@ -127,12 +138,14 @@ class MockBackend(PersistenceBackend):
             self.logs = store.setdefault("logs", {})
 
     def put_value(self, key, value):
+        _store_fault(key)
         self.values[key] = value
 
     def get_value(self, key):
         return self.values.get(key)
 
     def append(self, key, value):
+        _store_fault(key)
         self.logs.setdefault(key, []).append(value)
 
     def read_appended(self, key):
@@ -166,12 +179,14 @@ class ObjectStoreBackend(PersistenceBackend):
         return f"{self.prefix}/{key}" if self.prefix else key
 
     def put_value(self, key, value):
+        _store_fault(key)
         self.client.put(self._full(key), value)
 
     def get_value(self, key):
         return self.client.get(self._full(key))
 
     def append(self, key, value):
+        _store_fault(key)
         n = self._counters.get(key)
         if n is None:
             existing = self.client.list(self._full(key) + "/log.")
@@ -370,8 +385,12 @@ class OperatorSnapshotManager:
     graph changed, a blob is missing), full replay of base + tail loses
     nothing. Restore is two-phase: `load_states` reads and unpickles
     without mutating (multi-worker agreement can veto), `apply_states`
-    commits. If any node's state fails to pickle, the whole snapshot
-    aborts and the logs are kept."""
+    commits. A node whose state fails to pickle is skipped (warn-once)
+    and recorded in the manifest as `skipped_nodes`; such a snapshot
+    still compacts the logs but restore refuses it (full replay of the
+    consolidated base loses nothing). A backend write failure aborts the
+    save entirely — the previous manifest and the event logs stay intact
+    and the job continues."""
 
     def __init__(self, backend: PersistenceBackend, worker_id: int = 0):
         self.backend = backend
@@ -407,6 +426,7 @@ class OperatorSnapshotManager:
         import logging
 
         states: List[Tuple[int, bytes]] = []
+        skipped: List[int] = []
         for idx, node in enumerate(engine.nodes):
             state = node.snapshot_state()
             if state is None:
@@ -414,15 +434,42 @@ class OperatorSnapshotManager:
             try:
                 states.append((idx, pickle.dumps(state)))
             except Exception as exc:  # noqa: BLE001 — unpicklable state
-                logging.getLogger("pathway_tpu").warning(
-                    "operator snapshot disabled: node %d (%s) state does "
-                    "not pickle: %s",
-                    idx,
-                    node.name,
-                    exc,
+                # skip only this node: the manifest records it so restore
+                # refuses the partial snapshot and full-replays instead
+                skipped.append(idx)
+                warn_once = getattr(engine, "warn_once", None)
+                msg = (
+                    "operator snapshot skips node %d (%s): state does not "
+                    "pickle: %s"
                 )
-                return False
+                if warn_once is not None:
+                    warn_once(f"snapshot-unpicklable-{idx}", msg, idx,
+                              node.name, exc)
+                else:
+                    logging.getLogger("pathway_tpu").warning(
+                        msg, idx, node.name, exc
+                    )
 
+        try:
+            return self._save_committed(engine, time, writers, states, skipped)
+        except Exception as exc:  # noqa: BLE001 — backend write failed
+            logging.getLogger("pathway_tpu").warning(
+                "operator snapshot at frontier %s failed (%s: %s); job "
+                "continues, previous snapshot and event logs kept",
+                time,
+                type(exc).__name__,
+                exc,
+            )
+            return False
+
+    def _save_committed(
+        self,
+        engine,
+        time: int,
+        writers: Dict[str, "InputSnapshotWriter"],
+        states: List[Tuple[int, bytes]],
+        skipped: List[int],
+    ) -> bool:
         from pathway_tpu.engine.stream import consolidate
 
         epoch = time
@@ -461,6 +508,7 @@ class OperatorSnapshotManager:
                     "node_count": len(engine.nodes),
                     "graph_fingerprint": graph_fingerprint(engine),
                     "state_nodes": [idx for idx, _ in states],
+                    "skipped_nodes": skipped,
                     "folded_through": folded_through,
                 }
             ),
@@ -503,6 +551,11 @@ class OperatorSnapshotManager:
         # the caller falls back to consolidated-base full replay (the
         # reference keys snapshots by stable persistent operator ids).
         if manifest.get("graph_fingerprint") != graph_fingerprint(engine):
+            return None
+        # a snapshot that skipped unpicklable nodes is incomplete by
+        # construction — replaying the consolidated base rebuilds every
+        # node's state, restoring the others by index would not
+        if manifest.get("skipped_nodes"):
             return None
         epoch = manifest.get("epoch", manifest.get("time"))
         states: Dict[int, dict] = {}
@@ -634,6 +687,126 @@ class InputSnapshotWriter:
             return pickle.loads(blob)
         except Exception:  # noqa: BLE001
             return None
+
+
+class SinkCommitLog:
+    """Durable per-(worker, sink) commit metadata for exactly-once output.
+
+    Output written for epoch T only becomes durable when the operator
+    snapshot frontier reaches >= T — everything newer is provisional and
+    rolled back on recovery, then regenerated by replay.  The commit log
+    carries that protocol: one atomically-replaced marker record
+
+        {"frontier": F,          # highest finalized commit frontier
+         "offsets": {F: bytes},  # file length per frontier (truncate
+                                 # recovery for append-style sinks)
+         "staged": [F...]}       # staged-payload frontiers awaiting
+                                 # finalize (buffered sinks: postgres/mq)
+
+    plus one staged-payload blob per prepared frontier.  Atomicity comes
+    from the ordering against the operator-snapshot manifest, the run's
+    single commit point:
+
+      prepare(F):  record_offset / stage — BEFORE the manifest, so the
+                   restore frontier M always has its entry;
+      commit(F):   mark_committed / apply staged — AFTER the manifest,
+                   idempotent, re-runnable by recover(M) after a crash.
+    """
+
+    _KEEP_OFFSETS = 8
+
+    def __init__(
+        self, backend: PersistenceBackend, name: str, worker_id: int = 0
+    ):
+        self.backend = backend
+        self.prefix = f"sinkcommit/{worker_id}/{name}"
+        self._marker_key = f"{self.prefix}/marker"
+        self._rec = self._load()
+
+    def _load(self) -> Dict[str, Any]:
+        blob = self.backend.get_value(self._marker_key)
+        if blob is not None:
+            try:
+                rec = pickle.loads(blob)
+                if isinstance(rec, dict):
+                    rec.setdefault("frontier", -1)
+                    rec.setdefault("offsets", {})
+                    rec.setdefault("staged", [])
+                    return rec
+            except Exception:  # noqa: BLE001 — torn write
+                pass
+        return {"frontier": -1, "offsets": {}, "staged": []}
+
+    def _write(self) -> None:
+        self.backend.put_value(self._marker_key, pickle.dumps(self._rec))
+
+    def _stage_key(self, frontier: int) -> str:
+        return f"{self.prefix}/stage.{frontier:016d}"
+
+    def committed_frontier(self) -> int:
+        return self._rec["frontier"]
+
+    # -- file-offset protocol (append-style sinks: jsonlines/csv) --------
+
+    def record_offset(self, frontier: int, offset: int) -> None:
+        offsets = self._rec["offsets"]
+        offsets[frontier] = offset
+        for f in sorted(offsets)[: -self._KEEP_OFFSETS]:
+            del offsets[f]
+        self._write()
+
+    def offset_for(self, frontier: int) -> Optional[int]:
+        return self._rec["offsets"].get(frontier)
+
+    # -- staged-payload protocol (buffered sinks: postgres/kafka) --------
+
+    def stage(self, frontier: int, payload: bytes) -> None:
+        self.backend.put_value(self._stage_key(frontier), payload)
+        if frontier not in self._rec["staged"]:
+            self._rec["staged"].append(frontier)
+            self._rec["staged"].sort()
+        self._write()
+
+    def read_staged(
+        self, lo_exclusive: int, hi_inclusive: int
+    ) -> List[Tuple[int, bytes]]:
+        out: List[Tuple[int, bytes]] = []
+        for f in self._rec["staged"]:
+            if lo_exclusive < f <= hi_inclusive:
+                blob = self.backend.get_value(self._stage_key(f))
+                if blob is not None:
+                    out.append((f, blob))
+        return out
+
+    def rollback_to(self, frontier: int) -> None:
+        """Recovery: drop staged payloads and offsets recorded past the
+        restore frontier.  Post-restore epochs renumber from the restore
+        frontier, so a stale staged blob at a colliding frontier number
+        would later be applied as if it were regenerated output."""
+        keep = []
+        for f in self._rec["staged"]:
+            if f > frontier:
+                self.backend.truncate(self._stage_key(f))
+            else:
+                keep.append(f)
+        self._rec["staged"] = keep
+        offsets = self._rec["offsets"]
+        for f in [f for f in offsets if f > frontier]:
+            del offsets[f]
+        self._write()
+
+    def mark_committed(self, frontier: int) -> None:
+        """Finalize: advance the marker and prune staged payloads the
+        sink has durably applied."""
+        self._rec["frontier"] = max(self._rec["frontier"], frontier)
+        keep = []
+        for f in self._rec["staged"]:
+            if f <= self._rec["frontier"]:
+                self.backend.truncate(self._stage_key(f))
+            else:
+                keep.append(f)
+        self._rec["staged"] = keep
+        self._write()
 
 
 class CachedObjectStorage:

@@ -35,37 +35,83 @@ class USearchMetricKind(enum.Enum):
 
 
 class _KnnIndexImpl(IndexImpl):
+    """Device KNN with a degradation host path.
+
+    ``DeviceKnnIndex.add``/``remove`` only mutate host-side staging (the
+    device scatter happens lazily inside ``search``), so while the device
+    monitor reports DEGRADED this impl serves searches from a numpy
+    brute-force pass over a host mirror of the vectors and never issues a
+    device dispatch — a dead tunnel would hang one indefinitely.  On
+    re-promotion the next device search flushes everything staged in the
+    interim.  The mirror costs one float32 copy per live vector."""
+
     def __init__(self, dimensions: int, metric: str, reserved_space: int, mesh=None):
         self.knn = DeviceKnnIndex(
             dimensions, metric=metric, reserved_space=reserved_space, mesh=mesh
         )
+        self.metric = metric
         self.metadata: dict = {}
+        self._host_vecs: dict = {}
 
     def add(self, key, value, metadata) -> None:
-        self.knn.add(key, np.asarray(value, dtype=np.float32))
+        vec = np.asarray(value, dtype=np.float32)
+        self.knn.add(key, vec)
+        self._host_vecs[key] = vec.reshape(-1)
         if metadata is not None:
             self.metadata[key] = metadata
 
     def remove(self, key) -> None:
         self.knn.remove(key)
+        self._host_vecs.pop(key, None)
         self.metadata.pop(key, None)
+
+    def _host_search(self, queries: np.ndarray, fetch: int) -> list:
+        """Numpy brute force over the host mirror; same (key, score) row
+        shape as DeviceKnnIndex.search_keys, higher-is-better scores."""
+        keys = list(self._host_vecs.keys())
+        mat = np.stack([self._host_vecs[k] for k in keys])
+        if self.metric == "cos":
+            qn = queries / (
+                np.linalg.norm(queries, axis=1, keepdims=True) + 1e-30
+            )
+            mn = mat / (np.linalg.norm(mat, axis=1, keepdims=True) + 1e-30)
+            scores = qn @ mn.T
+        elif self.metric == "ip":
+            scores = queries @ mat.T
+        else:  # l2sq: negated squared distance so higher is better
+            scores = -(
+                (queries**2).sum(axis=1, keepdims=True)
+                - 2.0 * queries @ mat.T
+                + (mat**2).sum(axis=1)[None, :]
+            )
+        fetch = min(fetch, len(keys))
+        order = np.argsort(-scores, axis=1)[:, :fetch]
+        return [
+            [(keys[j], float(scores[i, j])) for j in row]
+            for i, row in enumerate(order)
+        ]
 
     def search(self, value, k, metadata_filter):
         return self.search_many([value], [k], [metadata_filter])[0]
 
     def search_many(self, values, ks, filters):
+        from pathway_tpu.internals.device_probe import device_degraded
+
         if not values:
             return []
-        if len(self.knn) == 0:
+        if not self._host_vecs:
             return [[] for _ in values]
         k_max = max(ks) if ks else 3
         # over-fetch when filtering so post-filter top-k stays full
         fetch = min(
-            len(self.knn),
+            len(self._host_vecs),
             max(k_max, k_max * 4 if any(f for f in filters) else k_max),
         )
         queries = np.stack([np.asarray(v, dtype=np.float32) for v in values])
-        rows = self.knn.search_keys(queries, fetch)
+        if device_degraded():
+            rows = self._host_search(queries, fetch)
+        else:
+            rows = self.knn.search_keys(queries, fetch)
         out = []
         for row, k, filt in zip(rows, ks, filters):
             if filt:

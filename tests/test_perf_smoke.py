@@ -396,3 +396,68 @@ def test_scaling_bench_graph_lints_clean(tmp_path):
     assert [
         (p["op"], p["predicted"]) for p in result.predictions
     ] == [("reduce", "columnar")]
+
+
+def test_fault_harness_overhead_under_5pct():
+    """The chaos harness guard sits on the driver's flush hot path
+    (`if faults.ACTIVE: faults.on_epoch(...)`).  Disabled — and even
+    armed with directives that never match — it must cost under 5% on
+    the engine microbench loop.  Same min-of-N interleaved protocol as
+    the metrics guard above."""
+    import gc
+    from time import perf_counter
+
+    from pathway_tpu.engine.engine import InputQueueSource, RowwiseNode
+    from pathway_tpu.internals import faults
+
+    ROWS, TICKS, REPS = 512, 40, 5
+    deltas = [(ref_scalar("k", i), (i,), 1) for i in range(ROWS)]
+
+    def ident(keys, cols):
+        return cols[0]
+
+    def run_once(armed: bool) -> float:
+        if armed:
+            # directives that can never fire: wrong worker, far epoch
+            faults.install("kill_worker@worker=99,epoch=1000000000")
+        else:
+            faults.clear()
+        eng = Engine(metrics=False)
+        src = InputQueueSource(eng)
+        node = src
+        for _ in range(3):
+            node = RowwiseNode(eng, [node], ident)
+        try:
+            time = 2
+            for _ in range(8):  # warmup
+                src.push(time, deltas)
+                eng.process_time(time)
+                time += 2
+            t0 = perf_counter()
+            for _ in range(TICKS):
+                src.push(time, deltas)
+                if faults.ACTIVE:
+                    faults.on_epoch(0, time, None)
+                eng.process_time(time)
+                time += 2
+            return perf_counter() - t0
+        finally:
+            eng._gc_unfreeze()
+
+    on, off = [], []
+    gc.collect()
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        for _ in range(REPS):
+            on.append(run_once(True))
+            off.append(run_once(False))
+    finally:
+        faults.clear()
+        if gc_was_enabled:
+            gc.enable()
+    ratio = min(on) / min(off)
+    assert ratio < 1.05, (
+        f"fault-harness overhead {ratio:.3f}x "
+        f"(armed={min(on):.4f}s off={min(off):.4f}s)"
+    )

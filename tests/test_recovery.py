@@ -541,3 +541,355 @@ def test_persistence_resume_over_fake_s3_backend():
     assert final == ("a", 7, True)
     # the log really lives in the object store
     assert any(k.startswith("persist/run") for k in client.objects)
+
+
+# -- chaos: fault-injection harness, live failover, exactly-once sinks ---
+# (pathway_tpu/internals/faults.py; engine/exchange.py failover protocol;
+# io/_writer.py transactional sink contract)
+
+
+@pytest.fixture
+def two_thread_workers():
+    import pathway_tpu as pw
+    from pathway_tpu.internals import faults
+    from pathway_tpu.internals.config import pathway_config
+
+    old = pathway_config.threads
+    pathway_config.threads = 2
+    try:
+        yield
+    finally:
+        pathway_config.threads = old
+        faults.clear()
+        pw.G.clear()
+
+
+def _read_json_parts(tmp, stem):
+    import glob
+
+    rows = []
+    for p in sorted(glob.glob(os.path.join(tmp, stem + "*"))):
+        with open(p) as fh:
+            for line in fh:
+                if line.strip():
+                    rows.append(json.loads(line))
+    return rows
+
+
+def test_thread_failover_exactly_once_sinks(two_thread_workers, tmp_path):
+    """Seeded random worker kill mid-run (thread mode): the surviving
+    worker rolls back to the last snapshot, the runner respawns the dead
+    slot, the SAME job finishes — and both transactional sinks (jsonlines
+    file, postgres-mock over sqlite) hold exactly the never-crashed
+    output."""
+    import random
+    import sqlite3
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals import faults
+    from pathway_tpu.internals.runner import last_engine
+
+    rng = random.Random(7)
+    kill_epoch = rng.randrange(10, 18)
+    n_rows = 60
+    tmp = str(tmp_path)
+    db = os.path.join(tmp, "mockpg.db")
+    with sqlite3.connect(db) as conn:
+        conn.execute(
+            "CREATE TABLE agg_rows "
+            "(k INTEGER, s INTEGER, time INTEGER, diff INTEGER)"
+        )
+
+    def pg_conn():
+        return sqlite3.connect(db, timeout=30, check_same_thread=False)
+
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time as time_mod
+
+            for i in range(n_rows):
+                self.next(k=i % 4, v=i)
+                self.commit()
+                time_mod.sleep(0.01)
+
+    t = pw.io.python.read(
+        Subject(),
+        schema=pw.schema_from_types(k=int, v=int),
+        name="chaos_src",
+    )
+    sel = t.select(pw.this.k, pw.this.v)
+    agg = t.groupby(pw.this.k).reduce(
+        pw.this.k, s=pw.reducers.sum(pw.this.v)
+    )
+    pw.io.fs.write(sel, os.path.join(tmp, "out.jsonl"), format="json")
+    pw.io.postgres.write(
+        agg, {}, "agg_rows", _connection=pg_conn, _placeholder="?", name="pg"
+    )
+
+    faults.install(f"kill_worker@worker=1,epoch={kill_epoch}")
+    pw.run(
+        monitoring_level=None,
+        autocommit_duration_ms=15,
+        persistence_config=pw.persistence.Config(
+            pw.persistence.Backend.filesystem(os.path.join(tmp, "pstore")),
+            snapshot_interval_ms=20,
+        ),
+    )
+
+    # the kill really fired and the job survived it in-process
+    assert any(k == "kill_worker" for k, _d, _t in faults.events)
+    engine = last_engine()
+    assert engine is not None and engine.failover_count >= 1
+    assert engine.last_failover_recovery_s is not None
+
+    # jsonlines: every input row exactly once across the part files
+    rows = _read_json_parts(tmp, "out.jsonl")
+    assert all(r["diff"] == 1 for r in rows)
+    got = sorted((r["k"], r["v"]) for r in rows)
+    assert got == sorted((i % 4, i) for i in range(n_rows))
+
+    # postgres-mock: consolidated change stream nets to the final
+    # aggregate — a duplicated or lost epoch leaves a dangling row
+    expected = {
+        k: sum(i for i in range(n_rows) if i % 4 == k) for k in range(4)
+    }
+    with sqlite3.connect(db) as conn:
+        cons: dict = {}
+        for k, s, _time, diff in conn.execute(
+            "SELECT k, s, time, diff FROM agg_rows"
+        ):
+            cons[(k, s)] = cons.get((k, s), 0) + diff
+        final = {k: s for (k, s), net in cons.items() if net == 1}
+        assert final == expected, cons
+        assert all(net in (0, 1) for net in cons.values()), cons
+        committed = dict(
+            conn.execute("SELECT sink, frontier FROM __pathway_commit")
+        )
+    assert committed, "no transactional sink commit reached the database"
+
+
+CHAOS_TCP_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, "@@REPO@@")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+from pathway_tpu.internals.faults import WorkerKilled
+
+out_dir, pstore, n_rows = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+class Subject(pw.io.python.ConnectorSubject):
+    def run(self):
+        import time as time_mod
+        for i in range(n_rows):
+            self.next(k=i % 4, v=i)
+            self.commit()
+            time_mod.sleep(0.01)
+
+t = pw.io.python.read(
+    Subject(), schema=pw.schema_from_types(k=int, v=int), name="chaos_src"
+)
+sel = t.select(pw.this.k, pw.this.v)
+pw.io.fs.write(sel, out_dir + "/out.jsonl", format="json")
+try:
+    pw.run(
+        monitoring_level=None,
+        autocommit_duration_ms=15,
+        persistence_config=pw.persistence.Config(
+            pw.persistence.Backend.filesystem(pstore),
+            snapshot_interval_ms=20,
+        ),
+    )
+except WorkerKilled:
+    sys.exit(43)
+"""
+
+
+def test_tcp_failover_process_respawn_exactly_once(tmp_path):
+    """TCP mode: worker 1 dies from an injected kill (exit 43), a
+    ProcessSupervisor respawns it, and it rejoins the RUNNING job —
+    worker 0 never restarts, and the jsonlines output is exactly-once."""
+    import subprocess
+
+    from _fakes import free_port_base
+
+    from pathway_tpu.internals.supervisor import (
+        WORKER_KILLED_EXIT,
+        ProcessSupervisor,
+        scrubbed_env,
+    )
+
+    tmp = str(tmp_path)
+    pstore = os.path.join(tmp, "pstore")
+    n_rows = 60
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(tmp, "chaos_worker.py")
+    with open(script, "w") as f:
+        f.write(CHAOS_TCP_SCRIPT.replace("@@REPO@@", repo))
+    base = free_port_base(2)
+
+    def env_for(pid):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            PATHWAY_PROCESSES="2",
+            PATHWAY_PROCESS_ID=str(pid),
+            PATHWAY_FIRST_PORT=str(base),
+        )
+        return env
+
+    env1 = env_for(1)
+    env1["PATHWAY_FAULTS"] = "kill_worker@worker=1,epoch=12"
+    spawned = {"n": 0}
+
+    def spawn1():
+        # the replacement must not re-trigger the same injected kill
+        env = env1 if spawned["n"] == 0 else scrubbed_env(env1)
+        spawned["n"] += 1
+        return subprocess.Popen(
+            [sys.executable, script, tmp, pstore, str(n_rows)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+
+    proc0 = subprocess.Popen(
+        [sys.executable, script, tmp, pstore, str(n_rows)],
+        env=env_for(0),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+    )
+    sup = ProcessSupervisor(spawn1)
+    sup.start()
+    rc1 = sup.watch(timeout_s=150)
+    last = sup.proc
+    out1, err1 = last.communicate(timeout=30)
+    assert rc1 == 0, err1.decode()[-2000:]
+    # first incarnation died from the injected kill, second finished
+    assert sup.exit_codes == [WORKER_KILLED_EXIT, 0], sup.exit_codes
+    out0, err0 = proc0.communicate(timeout=150)
+    assert proc0.returncode == 0, err0.decode()[-2000:]
+
+    rows = _read_json_parts(tmp, "out.jsonl")
+    assert all(r["diff"] == 1 for r in rows)
+    got = sorted((r["k"], r["v"]) for r in rows)
+    assert got == sorted((i % 4, i) for i in range(n_rows))
+
+
+def test_store_failure_mid_snapshot_job_continues(tmp_path):
+    """Injected persistence-backend write failures mid-snapshot: the save
+    aborts, the previous snapshot and event logs stay intact, the job
+    keeps running and a later snapshot succeeds — output is unaffected."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import faults
+
+    tmp = str(tmp_path)
+
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time as time_mod
+
+            for i in range(30):
+                self.next(k=i % 3, v=i)
+                self.commit()
+                time_mod.sleep(0.01)
+
+    t = pw.io.python.read(
+        Subject(),
+        schema=pw.schema_from_types(k=int, v=int),
+        name="sf_src",
+    )
+    pw.io.fs.write(
+        t.select(pw.this.k, pw.this.v),
+        os.path.join(tmp, "out.jsonl"),
+        format="json",
+    )
+    faults.install("store_fail@count=3,match=opsnap")
+    try:
+        pw.run(
+            monitoring_level=None,
+            autocommit_duration_ms=10,
+            persistence_config=pw.persistence.Config(
+                pw.persistence.Backend.filesystem(
+                    os.path.join(tmp, "pstore")
+                ),
+                snapshot_interval_ms=15,
+            ),
+        )
+        fired = [k for k, _d, _t in faults.events if k == "store_fail"]
+        assert fired, "store_fail directive never fired"
+    finally:
+        faults.clear()
+        pw.G.clear()
+
+    rows = _read_json_parts(tmp, "out.jsonl")
+    assert all(r["diff"] == 1 for r in rows)
+    assert sorted((r["k"], r["v"]) for r in rows) == sorted(
+        (i % 3, i) for i in range(30)
+    )
+    # a later snapshot DID land despite the injected failures
+    assert os.path.exists(
+        os.path.join(tmp, "pstore", "opsnap__0__manifest")
+    )
+
+
+def test_device_flap_degrades_and_repromotes():
+    """Injected device-probe flaps walk the monitor HEALTHY -> DEGRADED
+    (host fallback gate flips on) -> HEALTHY again, without erroring."""
+    from pathway_tpu.internals import device_probe, faults
+    from pathway_tpu.internals.device_probe import DeviceMonitor
+
+    monitor = DeviceMonitor(
+        interval_s=1.0, probe=lambda _timeout: (0.5, None)
+    )
+    old = device_probe._monitor
+    device_probe._monitor = monitor
+    faults.install("device_flap@probes=2")
+    try:
+        assert monitor.probe_once()["state"] == "degraded"
+        assert device_probe.device_degraded()
+        assert monitor.flaps == 1
+        # second flap keeps it degraded without recounting the transition
+        assert monitor.probe_once()["state"] == "degraded"
+        assert monitor.flaps == 1
+        # budget exhausted: the injected outage ends, next probe promotes
+        last = monitor.probe_once()
+        assert last["state"] == "healthy" and last["healthy"]
+        assert not device_probe.device_degraded()
+        assert monitor.promotions == 1
+        assert monitor.degraded_since is None
+        assert [k for k, _d, _t in faults.events] == [
+            "device_flap",
+            "device_flap",
+        ]
+    finally:
+        device_probe._monitor = old
+        faults.clear()
+
+
+def test_knn_search_uses_host_path_while_degraded():
+    """The KNN index answers queries from its host-side mirror while the
+    device is degraded, and returns to the device path on re-promotion."""
+    import numpy as np
+
+    from pathway_tpu.internals import device_probe
+    from pathway_tpu.internals.device_probe import DeviceMonitor
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import _KnnIndexImpl
+
+    idx = _KnnIndexImpl(2, "l2sq", 16)
+    for key, vec in [("a", [0.0, 0.0]), ("b", [1.0, 0.0]), ("c", [5.0, 5.0])]:
+        idx.add(key, np.asarray(vec, dtype=np.float32), None)
+
+    query = np.asarray([0.9, 0.1], dtype=np.float32)
+    monitor = DeviceMonitor(interval_s=1.0, probe=lambda _t: (0.5, None))
+    monitor._transition(False)  # force DEGRADED
+    old = device_probe._monitor
+    device_probe._monitor = monitor
+    try:
+        assert device_probe.device_degraded()
+        rows = idx.search_many([query], [2], [None])
+        assert [k for k, _s in rows[0]] == ["b", "a"]
+    finally:
+        device_probe._monitor = old
+    # healthy again: device path serves the same neighbors
+    rows = idx.search_many([query], [2], [None])
+    assert [k for k, _s in rows[0]] == ["b", "a"]
