@@ -27,6 +27,7 @@ from pathway_tpu.analysis.passes import (
     columnar_pass,
     dead_pass,
     dtype_pass,
+    embedder_pass,
     state_pass,
     udf_pass,
     verify_against_plan,
@@ -74,6 +75,7 @@ def analyze(
     columnar_pass(view, result, workers=workers)
     dead_pass(view, result)
     udf_pass(view, result, workers=workers)
+    embedder_pass(view, result, workers=workers)
     return result
 
 

@@ -52,6 +52,8 @@ CODES: Dict[str, tuple] = {
     "PWT305": (Severity.WARNING, "non-deterministic UDF feeds stateful operator"),
     "PWT306": (Severity.WARNING, "async/blocking UDF on exchange-crossing path"),
     "PWT399": (Severity.ERROR, "analyzer prediction disagrees with built plan"),
+    # PWT4xx — accelerator utilization
+    "PWT401": (Severity.WARNING, "embedder batch shape wastes MXU on padding"),
 }
 
 

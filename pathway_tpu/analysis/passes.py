@@ -456,6 +456,70 @@ def udf_pass(
 
 
 # ---------------------------------------------------------------------------
+# Pass 6 — embedder batch-shape waste (PWT401)
+# ---------------------------------------------------------------------------
+
+# Deterministic stand-in for typical short-document corpora (final token
+# counts per doc, CLS/SEP included — roughly what bench.py's synthetic
+# ingest feeds the embedder). The lint is a shape argument, not a data
+# argument: any distribution with mean/max in this range predicts the
+# same verdict, and determinism keeps the golden matrix stable.
+_SAMPLE_TOKEN_LENGTHS = (18, 24, 30, 34, 38, 42, 48, 56)
+_PAD_WASTE_THRESHOLD = 0.5
+
+
+def embedder_pass(
+    view: GraphView, result: AnalysisResult, *, workers: int = 1
+) -> None:
+    """PWT401: embedder configs whose max_batch_size / bucket shape force
+    most MXU cycles onto pad tokens. Embedder UDFs carry a `_pw_embedder`
+    marker dict (xpacks/llm/embedders.py) with the shape facts, so the
+    pass never builds a model."""
+    from pathway_tpu.models.tokenizer import predict_pad_waste
+
+    for table, op in view.ops():
+        if op.synthetic:
+            continue
+        seen: Set[int] = set()
+        for expr in op_exprs(op):
+            for node in walk_expr(expr):
+                if not isinstance(node, ApplyExpression):
+                    continue
+                if id(node) in seen:
+                    continue
+                seen.add(id(node))
+                marker = getattr(node._fun, "_pw_embedder", None)
+                if not isinstance(marker, dict):
+                    continue
+                batch = int(marker.get("max_batch_size") or 0)
+                max_len = int(marker.get("max_len") or 512)
+                if batch <= 0:
+                    continue
+                waste = predict_pad_waste(
+                    _SAMPLE_TOKEN_LENGTHS, batch, max_len=max_len
+                )
+                if waste <= _PAD_WASTE_THRESHOLD:
+                    continue
+                fname = getattr(node._fun, "__name__", "<udf>")
+                result.add(make_diag(
+                    "PWT401",
+                    f"embedder {fname!r} with max_batch_size={batch} "
+                    f"predicts {round(100 * waste)}% padding waste on "
+                    "sampled input lengths: the batch buckets to a power "
+                    "of two (minimum 8) and every doc pads to the bucket "
+                    "max, so most MXU cycles process pad tokens; raise "
+                    "max_batch_size or keep packed ragged batching on "
+                    "(PATHWAY_PACK_TOKEN_BUDGET > 0 with the default "
+                    "PATHWAY_DEVICE_PIPELINE=1)",
+                    trace=_trace_or_none(table),
+                    operator=view.op_label(table),
+                    udf=fname,
+                    predicted_waste=round(waste, 3),
+                    max_batch_size=batch,
+                ))
+
+
+# ---------------------------------------------------------------------------
 # Plan verification (PWT399)
 # ---------------------------------------------------------------------------
 

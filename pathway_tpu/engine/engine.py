@@ -499,6 +499,13 @@ class Engine:
                     errs_tick += n_err
                 if rows or n_err or dt > 1e-5:
                     spans_append((node._idx, node.name, t_prev, dt, rows))
+                take_aux = getattr(node, "take_aux_spans", None)
+                if take_aux is not None:
+                    # device-pipeline attribution: host-prep / dispatch /
+                    # wait spans accrue on pipeline threads between ticks
+                    # and ride the owning node's idx in the span store
+                    for a_name, a_t0, a_dur, a_rows in take_aux():
+                        spans_append((node._idx, a_name, a_t0, a_dur, a_rows))
                 if rows or n_err or dt > 1e-4:
                     rec.seq = seq = rec.seq + 1
                     rec_append(

@@ -19,6 +19,9 @@ from typing import Any, Callable, List, Sequence, Tuple
 
 from pathway_tpu.engine.value import ERROR, Error, Json, Pointer, ref_scalar
 from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.device_pipeline import (
+    pipeline_enabled as _pipeline_enabled,
+)
 from pathway_tpu.internals import expression as expr_mod
 from pathway_tpu.internals.expression import (
     ApplyExpression,
@@ -526,27 +529,74 @@ def _compile_apply(expr: ApplyExpression, ctx: EvalContext) -> BatchProgram:
             return out
 
         if max_batch_size is not None:
-            # batched sync UDF: fun receives column lists, returns a column
-            for start in range(0, len(live), max_batch_size or len(live)):
-                chunk = live[start : start + max_batch_size]
-                batch_args = [[c[i] for i in chunk] for c in arg_cols]
-                batch_kwargs = {
-                    k: [c[i] for i in chunk]
-                    for k, c in zip(kwarg_names, kwarg_cols)
-                }
-                try:
-                    res = fun(*batch_args, **batch_kwargs)
-                    if len(res) != len(chunk):
-                        raise ValueError(
-                            f"batched UDF returned {len(res)} results "
-                            f"for {len(chunk)} rows"
+            chunks = [
+                live[start : start + max_batch_size]
+                for start in range(0, len(live), max_batch_size or len(live))
+            ]
+
+            def _chunk_inputs(chunk):
+                return (
+                    [[c[i] for i in chunk] for c in arg_cols],
+                    {
+                        k: [c[i] for i in chunk]
+                        for k, c in zip(kwarg_names, kwarg_cols)
+                    },
+                )
+
+            def _assign(chunk, res):
+                if len(res) != len(chunk):
+                    raise ValueError(
+                        f"batched UDF returned {len(res)} results "
+                        f"for {len(chunk)} rows"
+                    )
+                for i, r in zip(chunk, res):
+                    out[i] = r
+
+            def _chunk_error(chunk, exc):
+                logger.error_logger(_udf_error_message(exc))
+                for i in chunk:
+                    out[i] = ERROR
+
+            submit = getattr(fun, "submit_batch", None)
+            awaitf = getattr(fun, "await_batch", None)
+            if (
+                submit is not None
+                and awaitf is not None
+                and len(chunks) > 1
+                and _pipeline_enabled()
+            ):
+                # two-phase async batched UDF (device-pipelined embedders):
+                # submit every chunk first — each submit tokenizes and
+                # enqueues an async device dispatch — then await in order,
+                # overlapping chunk i+1's host prep with chunk i's device
+                # execution. Same chunk boundaries and same computation as
+                # the sync loop below, so results are identical.
+                handles = []
+                for chunk in chunks:
+                    batch_args, batch_kwargs = _chunk_inputs(chunk)
+                    try:
+                        handles.append(
+                            (chunk, submit(*batch_args, **batch_kwargs), None)
                         )
-                    for i, r in zip(chunk, res):
-                        out[i] = r
+                    except Exception as exc:  # noqa: BLE001
+                        handles.append((chunk, None, exc))
+                for chunk, handle, exc in handles:
+                    if exc is None:
+                        try:
+                            _assign(chunk, awaitf(handle))
+                            continue
+                        except Exception as a_exc:  # noqa: BLE001
+                            exc = a_exc
+                    _chunk_error(chunk, exc)
+                return out
+
+            # batched sync UDF: fun receives column lists, returns a column
+            for chunk in chunks:
+                batch_args, batch_kwargs = _chunk_inputs(chunk)
+                try:
+                    _assign(chunk, fun(*batch_args, **batch_kwargs))
                 except Exception as exc:  # noqa: BLE001
-                    logger.error_logger(_udf_error_message(exc))
-                    for i in chunk:
-                        out[i] = ERROR
+                    _chunk_error(chunk, exc)
             return out
 
         for i in live:

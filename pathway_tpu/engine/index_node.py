@@ -106,6 +106,36 @@ class ExternalIndexNode(Node):
     # batched dispatch (cheap: one device round trip per restart)
     snapshot_attrs = ("data_rows", "query_rows", "cache", "_emitted_asof")
 
+    # -- async device pipeline integration --------------------------------
+
+    def _drain_index(self) -> None:
+        drain = getattr(self.index, "drain", None)
+        if drain is not None:
+            drain()
+
+    def on_rollback(self) -> None:
+        # failover rollback (PR 6 contract): in-flight pipelined embed
+        # batches must finish before the snapshot re-restore replays rows
+        # — an async scatter landing after reset would double-count
+        self._drain_index()
+
+    def on_flush(self) -> None:
+        # end-of-stream: quiesce the pipeline so finish() observes every
+        # document before sink completion callbacks fire
+        self._drain_index()
+
+    def snapshot_state(self) -> dict | None:
+        # snapshots capture host-side rows only, but the commit point
+        # must not advance past device work still in flight
+        self._drain_index()
+        return super().snapshot_state()
+
+    def take_aux_spans(self):
+        """Pipeline host-prep/dispatch/wait spans for the epoch tracer
+        (engine._process_time_traced pulls these on sampled epochs)."""
+        taker = getattr(self.index, "take_aux_spans", None)
+        return taker() if taker is not None else []
+
     def _after_restore(self) -> None:
         if not self.data_rows:
             return

@@ -1,0 +1,386 @@
+"""Asynchronous device pipeline for the embedding/ingest hot path.
+
+Bench r04 measured ~13% device-phase MFU: the TPU idled while the host
+tokenized, bucketed, and synchronously round-tripped every batch. This
+module is the WindVE-style fix — a collaborative host/device queue:
+
+  * a PREPARE stage (worker threads) tokenizes + packs batch N+2 while
+  * a single DISPATCHER thread enqueues batch N+1 on the device while
+  * batch N executes — JAX dispatch is async, so the dispatcher only
+    blocks when the in-flight window (default 2, i.e. double-buffered)
+    is full, and then only on the oldest handle.
+
+Ordering: the dispatcher consumes strictly in submission order, which the
+donated-buffer index scatter chain requires (ops/knn.py serializes
+updates by donating the previous buffer into the next dispatch).
+Synchronization points are explicit: `barrier()` (everything submitted
+has been *dispatched* — searches reading the device buffer need nothing
+more, XLA's data dependencies do the rest) and `drain()` (everything has
+*executed*; the snapshot/rollback/finish contract from PR 6).
+
+Completion waits use the repo's scalar-readback idiom (a 4-byte
+`jnp.sum` transfer) instead of `block_until_ready`, which has proven
+unreliable behind a tunneled chip.
+
+Failure model mirrors the columnar-exchange fallback: a prepare/dispatch
+exception parks the failing item plus everything still queued in a
+`take_failed()` list, surfaces as DevicePipelineError at the next
+submit/barrier/drain, and the caller replays those items on the classic
+synchronous path exactly once.
+
+`PATHWAY_DEVICE_PIPELINE=0` restores the classic synchronous per-batch
+path wholesale (read per call, like the other runtime gates).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+import weakref
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pathway_tpu.internals.metrics import MetricsRegistry
+
+
+def pipeline_enabled() -> bool:
+    """PATHWAY_DEVICE_PIPELINE gate, read per call: default on, "0"
+    restores the classic synchronous per-batch ingest path."""
+    return os.environ.get("PATHWAY_DEVICE_PIPELINE", "1") != "0"
+
+
+def _env_int(name: str, default: int, floor: int = 1) -> int:
+    try:
+        return max(floor, int(os.environ.get(name, "") or default))
+    except ValueError:
+        return default
+
+
+class DevicePipelineError(RuntimeError):
+    """A prepare or dispatch stage failed; the failed items are waiting
+    in take_failed() for a synchronous replay."""
+
+
+def _default_wait(handle) -> None:
+    # tiny scalar readback: forces completion of everything `handle`
+    # depends on while moving 4 bytes over the wire (vs np.asarray's
+    # full-array transfer, vs block_until_ready's tunnel flakiness)
+    if handle is None:
+        return
+    import jax.numpy as jnp
+
+    np.asarray(jnp.sum(jnp.ravel(handle)[:1].astype(jnp.float32)))
+
+
+class DevicePipeline:
+    """prepare (host worker threads) -> bounded queue -> dispatch
+    (single thread, submission order) -> bounded in-flight window.
+
+    prepare(item) -> (payload, meta) where meta may carry "rows",
+    "real_tokens", "slab_tokens" for the pad-waste accounting.
+    dispatch(payload) -> a device handle the default wait can readback.
+    quiesce() (optional) -> extra device sync run at the end of drain()
+    (e.g. a readback on the KNN buffer to cover the scatter chain).
+    """
+
+    def __init__(
+        self,
+        prepare: Callable[[Any], Tuple[Any, Dict[str, Any]]],
+        dispatch: Callable[[Any], Any],
+        *,
+        prep_workers: Optional[int] = None,
+        max_prepared: Optional[int] = None,
+        max_in_flight: Optional[int] = None,
+        wait: Optional[Callable[[Any], None]] = None,
+        quiesce: Optional[Callable[[], None]] = None,
+        name: str = "device-pipeline",
+    ):
+        self.name = name
+        self._prepare = prepare
+        self._dispatch = dispatch
+        self._wait = wait or _default_wait
+        self._quiesce = quiesce
+        self.max_prepared = max_prepared or _env_int("PATHWAY_PIPELINE_QUEUE", 4)
+        self.max_in_flight = max_in_flight or _env_int(
+            "PATHWAY_PIPELINE_IN_FLIGHT", 2
+        )
+        workers = prep_workers or _env_int("PATHWAY_PIPELINE_PREP_WORKERS", 2)
+        self._pool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix=f"{name}-prep"
+        )
+        self._cond = threading.Condition()
+        self._pending: Deque[Tuple[int, Any, Any]] = collections.deque()
+        self._inflight: Deque[Any] = collections.deque()
+        self._submitted = 0
+        self._dispatched = 0
+        self._drains = 0
+        self._rows = 0
+        self._real_tokens = 0
+        self._slab_tokens = 0
+        self._error: Optional[BaseException] = None
+        self._failed: List[Any] = []
+        self._stop = False
+        self._spans: Deque[Tuple[str, float, float, int]] = collections.deque(
+            maxlen=512
+        )
+        self._thread = threading.Thread(
+            target=self._run, name=f"{name}-dispatch", daemon=True
+        )
+        self._thread.start()
+        _PIPELINES.add(self)
+
+    # -- producer side ----------------------------------------------------
+
+    def submit(self, item: Any) -> None:
+        """Hand one batch to the pipeline. Blocks (backpressure) while the
+        prepared queue is full; raises DevicePipelineError if a previous
+        batch failed (the caller then replays take_failed() synchronously)."""
+        with self._cond:
+            self._raise_if_failed()
+            while len(self._pending) >= self.max_prepared:
+                self._cond.wait()
+                self._raise_if_failed()
+            self._submitted += 1
+            seq = self._submitted
+            fut = self._pool.submit(self._prep_timed, item)
+            self._pending.append((seq, item, fut))
+            self._cond.notify_all()
+
+    def barrier(self) -> None:
+        """Wait until every submitted batch has been DISPATCHED to the
+        device. Readers of device buffers produced by the dispatch chain
+        need only this — XLA data dependencies order the rest."""
+        with self._cond:
+            while self._dispatched < self._submitted and self._error is None:
+                self._cond.wait()
+            self._raise_if_failed()
+
+    def drain(self) -> None:
+        """Barrier, then wait until every in-flight dispatch has EXECUTED
+        on device (snapshot / rollback / failover / finish contract)."""
+        self.barrier()
+        t0 = time.perf_counter()
+        waited = False
+        while True:
+            with self._cond:
+                if not self._inflight:
+                    break
+                handle = self._inflight.popleft()
+            waited = True
+            self._wait(handle)
+        if self._quiesce is not None:
+            self._quiesce()
+            waited = True
+        with self._cond:
+            self._drains += 1
+            if waited:
+                self._note_span("pipeline:drain", t0, 0)
+
+    def take_failed(self) -> List[Any]:
+        """Return (and clear) the items that never made it to the device,
+        in submission order, resetting the error state. The caller owns
+        replaying them on the synchronous path."""
+        with self._cond:
+            failed, self._failed = self._failed, []
+            self._error = None
+            return failed
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5.0)
+        self._pool.shutdown(wait=False)
+        _PIPELINES.discard(self)
+
+    # -- observability -----------------------------------------------------
+
+    def take_aux_spans(self) -> List[Tuple[str, float, float, int]]:
+        """Pop accumulated (name, start_perf, duration_s, rows) spans —
+        host-prep vs device-dispatch vs wait/drain attribution for the
+        epoch tracer."""
+        with self._cond:
+            spans = list(self._spans)
+            self._spans.clear()
+            return spans
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cond:
+            slab = self._slab_tokens
+            return {
+                "submitted": self._submitted,
+                "dispatched": self._dispatched,
+                "queue_depth": len(self._pending),
+                "in_flight": len(self._inflight),
+                "drains": self._drains,
+                "rows": self._rows,
+                "real_tokens": self._real_tokens,
+                "slab_tokens": slab,
+                "pad_waste_ratio": (
+                    1.0 - self._real_tokens / slab if slab else None
+                ),
+            }
+
+    # -- internals ---------------------------------------------------------
+
+    def _raise_if_failed(self) -> None:
+        if self._error is not None:
+            raise DevicePipelineError(
+                f"{self.name}: {len(self._failed)} batch(es) need a "
+                f"synchronous replay ({type(self._error).__name__}: "
+                f"{self._error})"
+            ) from self._error
+
+    def _note_span(self, kind: str, t0: float, rows: int) -> None:
+        self._spans.append((kind, t0, time.perf_counter() - t0, rows))
+
+    def _prep_timed(self, item: Any) -> Tuple[Any, Dict[str, Any]]:
+        t0 = time.perf_counter()
+        payload, meta = self._prepare(item)
+        with self._cond:
+            self._note_span("pipeline:prep", t0, int(meta.get("rows", 0)))
+        return payload, meta
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._stop:
+                    self._cond.wait()
+                if not self._pending:
+                    return
+                seq, item, fut = self._pending.popleft()
+                self._cond.notify_all()
+            try:
+                payload, meta = fut.result()
+                # window: wait the OLDEST handle only when double-buffering
+                # is exhausted — batch N executes while N+1 enqueues
+                while True:
+                    with self._cond:
+                        if len(self._inflight) < self.max_in_flight:
+                            break
+                        handle = self._inflight.popleft()
+                    t0 = time.perf_counter()
+                    self._wait(handle)
+                    with self._cond:
+                        self._note_span("pipeline:wait", t0, 0)
+                t0 = time.perf_counter()
+                handle = self._dispatch(payload)
+                with self._cond:
+                    self._note_span(
+                        "pipeline:dispatch", t0, int(meta.get("rows", 0))
+                    )
+                    self._inflight.append(handle)
+                    self._dispatched = seq
+                    self._rows += int(meta.get("rows", 0))
+                    self._real_tokens += int(meta.get("real_tokens", 0))
+                    self._slab_tokens += int(meta.get("slab_tokens", 0))
+                    self._cond.notify_all()
+            except BaseException as exc:  # noqa: BLE001 — parked for replay
+                with self._cond:
+                    self._failed.append(item)
+                    while self._pending:
+                        _seq, p_item, p_fut = self._pending.popleft()
+                        p_fut.cancel()
+                        self._failed.append(p_item)
+                    self._dispatched = self._submitted
+                    self._error = exc
+                    _STATS["fallbacks"] += 1
+                    self._cond.notify_all()
+
+
+# -- module registry / gauges ---------------------------------------------
+
+_PIPELINES: "weakref.WeakSet[DevicePipeline]" = weakref.WeakSet()
+_STATS: Dict[str, int] = {"fallbacks": 0}
+# The pipeline is a process-wide resource (one set of gauges regardless of
+# how many engine workers share the process), so its series carry the
+# conventional worker="0" constant label the exposition contract requires.
+_REGISTRY = MetricsRegistry(worker="0")
+
+
+def _sum_stat(key: str) -> Optional[float]:
+    pipes = list(_PIPELINES)
+    if not pipes:
+        return None
+    return float(sum(p.stats()[key] or 0 for p in pipes))
+
+
+def _pad_waste() -> Optional[float]:
+    pipes = list(_PIPELINES)
+    real = sum(p.stats()["real_tokens"] for p in pipes)
+    slab = sum(p.stats()["slab_tokens"] for p in pipes)
+    if not slab:
+        return None
+    return 1.0 - real / slab
+
+
+def _occupancy() -> Optional[float]:
+    pipes = list(_PIPELINES)
+    cap = sum(p.max_in_flight for p in pipes)
+    if not cap:
+        return None
+    return sum(p.stats()["in_flight"] for p in pipes) / cap
+
+
+_REGISTRY.gauge(
+    "pathway_device_pad_waste_ratio",
+    help="Fraction of dispatched slab tokens that were padding "
+    "(pipelined ingest batches, cumulative)",
+    callback=_pad_waste,
+)
+_REGISTRY.gauge(
+    "pathway_device_pipeline_queue_depth",
+    help="Prepared batches waiting for device dispatch",
+    callback=lambda: _sum_stat("queue_depth"),
+)
+_REGISTRY.gauge(
+    "pathway_device_pipeline_in_flight",
+    help="Batches dispatched to the device and not yet retired",
+    callback=lambda: _sum_stat("in_flight"),
+)
+_REGISTRY.gauge(
+    "pathway_device_pipeline_occupancy",
+    help="In-flight batches over the double-buffer window (0..1)",
+    callback=_occupancy,
+)
+_REGISTRY.gauge(
+    "pathway_device_pipeline_fallbacks_total",
+    help="Pipeline batches replayed on the classic synchronous path",
+    callback=lambda: float(_STATS["fallbacks"]) if _PIPELINES or _STATS["fallbacks"] else None,
+)
+
+
+def pipeline_metrics() -> MetricsRegistry:
+    """Registry holding the pipeline gauges (scraped by PrometheusServer
+    alongside the engine/device registries)."""
+    return _REGISTRY
+
+
+def pipeline_status() -> Dict[str, Any]:
+    """/status payload: aggregate view over live pipelines."""
+    pipes = list(_PIPELINES)
+    out: Dict[str, Any] = {
+        "enabled": pipeline_enabled(),
+        "active": len(pipes),
+        "fallbacks": _STATS["fallbacks"],
+    }
+    if pipes:
+        agg = {
+            k: sum(p.stats()[k] or 0 for p in pipes)
+            for k in (
+                "submitted",
+                "dispatched",
+                "queue_depth",
+                "in_flight",
+                "drains",
+                "rows",
+            )
+        }
+        out.update(agg)
+        out["pad_waste_ratio"] = _pad_waste()
+        out["occupancy"] = _occupancy()
+    return out

@@ -142,6 +142,26 @@ class StatsMonitor:
                     f"p50={fs['p50_ms']}ms p99={fs['p99_ms']}ms"
                     f" last={fs['last_ms']}ms n={fs['count']}",
                 )
+            # async device pipeline (ingest hot path): queue/in-flight
+            # occupancy + how much of each dispatched slab was padding
+            from pathway_tpu.internals.device_pipeline import pipeline_status
+
+            ps = pipeline_status()
+            if ps.get("active"):
+                waste = ps.get("pad_waste_ratio")
+                occ = ps.get("occupancy")
+                row = (
+                    f"queued={ps.get('queue_depth', 0)}"
+                    f" in_flight={ps.get('in_flight', 0)}"
+                    f" dispatched={ps.get('dispatched', 0)}"
+                )
+                if occ is not None:
+                    row += f" occ={occ:.2f}"
+                table.add_row("device pipeline", row)
+                if waste is not None:
+                    table.add_row(
+                        "device pad waste", f"{100.0 * waste:.1f}%"
+                    )
             # critical-path attribution for the latest sampled epoch
             tr = getattr(m, "trace", None)
             cp = tr.critical_path() if tr is not None else None
@@ -247,6 +267,11 @@ class PrometheusServer:
         monitor = device_probe._monitor
         if monitor is not None:
             add(monitor.metrics)
+        # async device-pipeline gauges (pad-waste ratio, queue depth,
+        # in-flight window occupancy; internals/device_pipeline.py)
+        from pathway_tpu.internals.device_pipeline import pipeline_metrics
+
+        add(pipeline_metrics())
         return regs
 
     def metrics_text(self) -> str:
@@ -315,6 +340,7 @@ class PrometheusServer:
             }
             for idx, n in enumerate(e0.nodes)
         ]
+        from pathway_tpu.internals.device_pipeline import pipeline_status
         from pathway_tpu.internals.device_probe import device_status
         from pathway_tpu.internals.tracing import merged_critical_path
 
@@ -329,6 +355,9 @@ class PrometheusServer:
             "critical_path": merged_critical_path(self._engines()),
             # accelerator health (internals/device_probe.py)
             "device": device_status(),
+            # async ingest pipeline (internals/device_pipeline.py):
+            # queue depth, in-flight window, cumulative pad-waste ratio
+            "device_pipeline": pipeline_status(),
             # findings from pw.run(analysis=...): deployed graphs report
             # their own lint state (None when analysis was off)
             "analysis": getattr(e0, "analysis", None),

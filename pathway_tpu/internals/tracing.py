@@ -187,7 +187,15 @@ def critical_path_from_events(
             _, w, _ep, idx, name, _ts, dur, rows = ev
             entries.append(
                 {
-                    "kind": "node",
+                    # aux spans from the async device pipeline
+                    # (pipeline:prep / pipeline:dispatch / pipeline:wait /
+                    # pipeline:drain) ride the owning node's idx but are
+                    # attributed as their own kind: they run on pipeline
+                    # threads CONCURRENT with the tick, so "node" would
+                    # misread as serial engine-loop time
+                    "kind": (
+                        "pipeline" if name.startswith("pipeline:") else "node"
+                    ),
                     "worker": w,
                     "node": idx,
                     "name": name,

@@ -13,7 +13,13 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from pathway_tpu.models.tokenizer import HashTokenizer, encode_batch
+from pathway_tpu.models.tokenizer import (
+    PACK_MAX_SEGMENTS,
+    HashTokenizer,
+    encode_batch,
+    pack_batch,
+    pack_token_budget,
+)
 from pathway_tpu.models.transformer import (
     MINILM_L6,
     TransformerConfig,
@@ -59,8 +65,13 @@ class SentenceEncoder:
             if n_dev & (n_dev - 1):
                 raise ValueError(
                     f"SentenceEncoder mesh axis {axis!r} has {n_dev} "
-                    "devices; a power of two is required (batches bucket "
-                    "to powers of two and would never shard evenly)"
+                    f"devices, which is not a power of two: encode_batch "
+                    f"buckets every batch to a power of two (minimum 8), "
+                    f"so a {n_dev}-way '{axis}' shard would never divide "
+                    f"the batch axis evenly. Use a power-of-two device "
+                    f"count on that axis, or drop the mesh and run the "
+                    f"single-device async pipeline "
+                    f"(PATHWAY_DEVICE_PIPELINE=1, the default)"
                 )
         self.mesh = mesh
 
@@ -76,8 +87,17 @@ class SentenceEncoder:
         return self.config.hidden
 
     def encode(self, texts: Sequence[str]) -> np.ndarray:
+        return self.encode_await(self.encode_submit(texts))
+
+    def encode_submit(self, texts: Sequence[str]):
+        """Async half of encode(): tokenize and ENQUEUE the device encode,
+        returning an opaque handle without forcing the result. JAX
+        dispatch is asynchronous, so the caller can tokenize the next
+        batch while this one executes; encode_await transfers the pooled
+        vectors. encode() is exactly encode_await(encode_submit(...)), so
+        the two paths cannot drift numerically."""
         if not texts:
-            return np.zeros((0, self.config.hidden), dtype=np.float32)
+            return None
         ids, mask = encode_batch(
             self.tokenizer, list(texts), max_len=self.max_len
         )
@@ -96,8 +116,39 @@ class SentenceEncoder:
                 sharding = NamedSharding(self.mesh, P(axis, None))
                 ids = jax.device_put(ids, sharding)
                 mask = jax.device_put(mask, sharding)
-        pooled = self.lm(ids, mask)
-        return np.asarray(pooled)[: len(texts)]
+        return (self.lm(ids, mask), len(texts))
+
+    def encode_await(self, handle) -> np.ndarray:
+        """Force a handle from encode_submit: one host transfer of the
+        pooled [B, hidden] block, trimmed to the real batch."""
+        if handle is None:
+            return np.zeros((0, self.config.hidden), dtype=np.float32)
+        pooled, n = handle
+        return np.asarray(pooled)[:n]
+
+    def encode_packed(self, texts: Sequence[str]) -> np.ndarray:
+        """Packed ragged encode for the ingest hot path: docs concatenate
+        into token-budget slabs (tokenizer.pack_batch) so the MXU runs on
+        real tokens instead of per-doc pad. Falls back to the classic
+        bucketed `encode` when packing is disabled
+        (PATHWAY_PACK_TOKEN_BUDGET=0) or a mesh is attached — the mesh
+        path needs the power-of-two batch-axis contract that packed row
+        counts do not honor."""
+        budget = pack_token_budget()
+        if budget <= 0 or self.mesh is not None or not texts:
+            return self.encode(texts)
+        ids, seg, slots = pack_batch(
+            self.tokenizer,
+            list(texts),
+            max_len=self.max_len,
+            token_budget=budget,
+        )
+        pooled = np.asarray(
+            self.lm.encode_packed(ids, seg, PACK_MAX_SEGMENTS)
+        )
+        rows = np.fromiter((r for r, _ in slots), dtype=np.int64, count=len(slots))
+        segs = np.fromiter((s for _, s in slots), dtype=np.int64, count=len(slots))
+        return pooled[rows, segs]
 
     def encode_one(self, text: str) -> np.ndarray:
         return self.encode([text])[0]

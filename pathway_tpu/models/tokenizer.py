@@ -11,6 +11,7 @@ a small set of shapes.
 
 from __future__ import annotations
 
+import os
 import re
 import zlib
 from typing import List, Optional, Sequence, Tuple
@@ -238,6 +239,109 @@ def encode_batch(
         ids[i, : len(e)] = e
         mask[i, : len(e)] = 1
     return ids, mask
+
+
+PACK_MAX_SEGMENTS = 32
+
+
+def pack_token_budget(default: int = 256) -> int:
+    """Slab length for packed ragged batching (PATHWAY_PACK_TOKEN_BUDGET,
+    read per call like PATHWAY_INGEST_CHUNK). 0 disables packing and the
+    ingest path falls back to the classic one-doc-per-row bucketed
+    encode."""
+    raw = os.environ.get("PATHWAY_PACK_TOKEN_BUDGET", "")
+    if not raw:
+        return default
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return default
+
+
+def pack_batch(
+    tokenizer,
+    texts: Sequence[str],
+    *,
+    max_len: int = 512,
+    token_budget: int = 256,
+    max_segments: int = PACK_MAX_SEGMENTS,
+    row_bucket: bool = True,
+) -> Tuple[np.ndarray, np.ndarray, List[Tuple[int, int]]]:
+    """Packed ragged batching: concatenate variable-length docs into
+    fixed token-budget slabs with a segment-ids mask instead of padding
+    each doc to the bucket max, so the MXU runs on real tokens.
+
+    Returns (ids [R, L], seg [R, L], slots). seg holds 1..max_segments
+    per document within a row (0 = padding); slots[d] = (row, seg - 1)
+    locates document d's pooled vector in the encoder's [R, S, H] output.
+
+    Packing is greedy first-fit in arrival order: deterministic, and the
+    XLA shape set stays tiny because L is the fixed budget (raised to the
+    sequence bucket of the longest doc only when one overflows it) and
+    the row count buckets like a sequence axis — packed rows are never
+    mesh-sharded, so the power-of-two batch contract does not apply.
+    """
+    encoded = [tokenizer.encode(t, max_len) for t in texts]
+    longest = max((len(e) for e in encoded), default=1)
+    slab = max(1, int(token_budget))
+    if longest > slab:
+        slab = seq_bucket_length(longest, maximum=max(max_len, longest))
+    rows: List[List[List[int]]] = []
+    used: List[int] = []
+    slots: List[Tuple[int, int]] = []
+    for e in encoded:
+        need = len(e)
+        row = -1
+        for r in range(len(rows)):
+            if used[r] + need <= slab and len(rows[r]) < max_segments:
+                row = r
+                break
+        if row < 0:
+            rows.append([])
+            used.append(0)
+            row = len(rows) - 1
+        slots.append((row, len(rows[row])))
+        rows[row].append(e)
+        used[row] += need
+    n_rows = max(len(rows), 1)
+    padded_rows = (
+        seq_bucket_length(n_rows, minimum=8, maximum=1 << 16)
+        if row_bucket
+        else n_rows
+    )
+    pad_id = getattr(tokenizer, "pad_id", PAD_ID)
+    dtype = _wire_dtype(tokenizer)
+    ids = np.full((padded_rows, slab), pad_id, dtype=dtype)
+    seg = np.zeros((padded_rows, slab), dtype=dtype)
+    for r, docs in enumerate(rows):
+        at = 0
+        for s, e in enumerate(docs):
+            ids[r, at : at + len(e)] = e
+            seg[r, at : at + len(e)] = s + 1
+            at += len(e)
+    return ids, seg, slots
+
+
+def predict_pad_waste(
+    lengths: Sequence[int], batch_size: int, *, max_len: int = 512
+) -> float:
+    """Predicted padding-waste fraction of the CLASSIC (unpacked) encode
+    path for a UDF batch of `batch_size` docs drawn from the sampled
+    token `lengths`: real tokens vs the bucketed [B', L'] slab that
+    encode_batch would dispatch. Used by the PWT401 analyzer lint to flag
+    embedder configs whose batch/bucket shape burns most of the MXU on
+    pad tokens."""
+    if not lengths or batch_size <= 0:
+        return 0.0
+    batch = [
+        max(1, min(int(lengths[i % len(lengths)]), max_len))
+        for i in range(batch_size)
+    ]
+    seq_len = seq_bucket_length(max(batch), maximum=max_len)
+    padded_batch = bucket_length(batch_size, minimum=8, maximum=1 << 16)
+    real = sum(batch)
+    total = padded_batch * seq_len
+    return 1.0 - (real / float(total)) if total else 0.0
 
 
 def _wire_dtype(tokenizer):

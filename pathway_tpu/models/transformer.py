@@ -177,6 +177,49 @@ def _attention(q, k, v, mask, causal: bool, use_flash):
     )
 
 
+def _segment_attention(q, k, v, seg, sm_scale):
+    """Dense attention with a pairwise same-segment mask for packed
+    ragged batches. q,k,v: [B,H,L,D]; seg: [B,L] int32, 1..S per packed
+    document, 0 = padding. Mirrors `_reference_attention`'s numerics
+    (f32 scores, NEG_INF additive mask, +1e-30 softmax denominator) so a
+    doc packed with neighbors attends over exactly the tokens it would
+    see alone. At packed slab lengths (<=512) the O(L^2) scores are the
+    dense-MXU regime where flash loses (see `_attention`'s measured
+    gate), so no Pallas variant is needed. Pad rows produce finite
+    garbage that per-segment pooling never reads."""
+    import jax.numpy as jnp
+
+    from pathway_tpu.ops.kernels.flash_attention import NEG_INF
+
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    same = (seg[:, None, :, None] == seg[:, None, None, :]) & (
+        seg[:, None, :, None] > 0
+    )
+    s = jnp.where(same, s, NEG_INF)
+    p = jnp.exp(s - s.max(-1, keepdims=True))
+    p = p / (p.sum(-1, keepdims=True) + 1e-30)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+def _packed_positions(seg):
+    """Per-token positions that RESTART at every segment boundary, so a
+    packed doc reads the same pos_embed rows it would alone. Computed on
+    device from seg (no third wire upload): a token starts a segment
+    where seg differs from its left neighbor; cummax propagates each
+    segment's start index rightward."""
+    import jax
+    import jax.numpy as jnp
+
+    l = seg.shape[1]
+    pos = jnp.broadcast_to(jnp.arange(l, dtype=jnp.int32)[None, :], seg.shape)
+    is_start = jnp.concatenate(
+        [jnp.ones_like(seg[:, :1], dtype=bool), seg[:, 1:] != seg[:, :-1]],
+        axis=1,
+    )
+    seg_start = jax.lax.cummax(jnp.where(is_start, pos, 0), axis=1)
+    return pos - seg_start
+
+
 def forward(
     params,
     config: TransformerConfig,
@@ -185,16 +228,30 @@ def forward(
     *,
     return_hidden: bool = False,
     use_flash: Optional[bool] = None,
+    seg=None,
+    max_segments: int = 0,
 ):
     """Encoder/decoder forward. ids, mask: [B, L] int32. Returns pooled
-    embeddings [B, H] (pooling != none), else logits [B, L, V]."""
+    embeddings [B, H] (pooling != none), else logits [B, L, V].
+
+    Packed mode (seg is not None): rows hold several concatenated docs
+    distinguished by segment ids; attention is confined within segments,
+    positions restart per segment, and pooling returns [B, max_segments,
+    H] — one L2-normalized vector per packed doc slot. mask is ignored
+    (seg > 0 is the validity mask); causal packed decode is unsupported."""
     import jax
     import jax.numpy as jnp
 
     compute_dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
     post_ln = config.norm_style == "post"
     b, l = ids.shape
-    x = params["embed"][ids] + params["pos_embed"][:l][None, :, :]
+    if seg is not None:
+        if config.causal:
+            raise ValueError("packed segment batching requires a bidirectional encoder")
+        pos = _packed_positions(seg)
+        x = params["embed"][ids] + params["pos_embed"][pos]
+    else:
+        x = params["embed"][ids] + params["pos_embed"][:l][None, :, :]
     if post_ln and "type_embed" in params:
         x = x + params["type_embed"][0][None, None, :]
     if post_ln and "embed_ln" in params:
@@ -219,7 +276,10 @@ def forward(
         q = q.reshape(b, l, heads, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, l, heads, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, l, heads, hd).transpose(0, 2, 1, 3)
-        ctx = _attention(q, k, v, mask, config.causal, use_flash)
+        if seg is not None:
+            ctx = _segment_attention(q, k, v, seg, 1.0 / np.sqrt(hd))
+        else:
+            ctx = _attention(q, k, v, mask, config.causal, use_flash)
         ctx = ctx.astype(compute_dtype)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, l, config.hidden)
         attn_out = (
@@ -268,7 +328,18 @@ def forward(
             "blh,vh->blv", x.astype(jnp.float32), params["embed"]
         )
         return logits
-    if config.pooling == "cls":
+    if seg is not None:
+        # per-segment mean pooling: one-hot the segment ids and contract
+        # the token axis on the MXU — [B, L, H] x [B, L, S] -> [B, S, H].
+        # Same dtype discipline as the classic branch (sum in x.dtype,
+        # normalize in f32); empty slots pool to the zero vector.
+        oh = (
+            seg[:, :, None] == jnp.arange(1, max_segments + 1)[None, None, :]
+        ).astype(x.dtype)
+        pooled = jnp.einsum("blh,bls->bsh", x, oh) / (
+            oh.sum(axis=1)[:, :, None] + 1e-9
+        )
+    elif config.pooling == "cls":
         pooled = x[:, 0, :]
     else:  # mean over valid tokens
         m = mask[:, :, None].astype(x.dtype)
@@ -306,6 +377,31 @@ class TransformerLM:
             )
 
         self._encode_jit = jax.jit(_fwd)
+
+        def _fwd_packed(params, ids, seg, max_segments):
+            import jax.numpy as jnp
+
+            return forward(
+                params,
+                config=self.config,
+                ids=ids.astype(jnp.int32),
+                mask=None,
+                seg=seg.astype(jnp.int32),
+                max_segments=max_segments,
+            )
+
+        # max_segments is a static one-hot width; callers pass a fixed
+        # constant (tokenizer.PACK_MAX_SEGMENTS) so there is one compile
+        # per (R, L) slab shape, same cache discipline as the classic path
+        self._packed_jit = jax.jit(_fwd_packed, static_argnums=(3,))
+
+    def encode_packed(self, ids, seg, max_segments: int):
+        """Packed ragged encode: ids/seg from tokenizer.pack_batch (wire
+        dtypes; upcast on device). Returns [R, max_segments, H] pooled
+        L2-normalized vectors; empty slots are zero. Inputs are NOT
+        donated — the device-side int upcast changes the buffer dtype, so
+        XLA could never reuse them and would warn on every dispatch."""
+        return self._packed_jit(self.params, ids, seg, int(max_segments))
 
     def __call__(self, ids, mask):
         # ids/mask arrive already wire-narrowed by encode_batch (tokenizer

@@ -429,15 +429,73 @@ class FusedEmbedSearch:
 
     def embed_and_add(self, keys, texts) -> None:
         """Embed a doc batch and scatter into the index, fully device-side
-        (the embeddings never leave HBM)."""
-        from pathway_tpu.models.tokenizer import encode_batch
+        (the embeddings never leave HBM). Classic synchronous entry:
+        prepare (unpacked — preserves pre-pipeline behavior exactly) and
+        dispatch back-to-back on the calling thread."""
+        self.dispatch_batch(self.prepare_batch(keys, texts, pack=False)[0])
+
+    def prepare_batch(self, keys, texts, *, pack: bool = True):
+        """Host-side PREPARE stage of the device pipeline: tokenize (and
+        pack into token-budget slabs when enabled and no mesh is
+        attached) off the dispatch thread. Returns (payload, meta) —
+        payload is opaque to the pipeline and consumed by dispatch_batch;
+        meta carries rows/real-token/slab-token accounting for the
+        pad-waste gauge."""
+        from pathway_tpu.models.tokenizer import (
+            PACK_MAX_SEGMENTS,
+            encode_batch,
+            pack_batch,
+            pack_token_budget,
+        )
 
         texts = list(texts)
-        ids, mask = encode_batch(
-            self.encoder.tokenizer, texts, max_len=self.encoder.max_len
-        )
-        emb = self.encoder.lm(ids, mask)  # device array [B', d]
-        self.index.add_batch(keys, emb[: len(texts)])
+        keys = list(keys)
+        budget = pack_token_budget() if pack and self.index.mesh is None else 0
+        if budget > 0 and texts:
+            ids, seg, slots = pack_batch(
+                self.encoder.tokenizer,
+                texts,
+                max_len=self.encoder.max_len,
+                token_budget=budget,
+                max_segments=PACK_MAX_SEGMENTS,
+            )
+            payload = ("packed", keys, ids, seg, slots)
+            real, total = int(np.count_nonzero(seg)), int(seg.size)
+        else:
+            ids, mask = encode_batch(
+                self.encoder.tokenizer, texts, max_len=self.encoder.max_len
+            )
+            payload = ("classic", keys, ids, mask, None)
+            real, total = int(np.asarray(mask).sum()), int(mask.size)
+        return payload, {
+            "rows": len(keys),
+            "real_tokens": real,
+            "slab_tokens": total,
+        }
+
+    def dispatch_batch(self, payload):
+        """Device DISPATCH stage: enqueue encode (+ per-segment gather for
+        packed slabs) and the index scatter; returns the embeddings handle
+        (JAX dispatch is async — the caller blocks only at barriers).
+        Ordering matters: the scatter donates the previous index buffer,
+        so batches must dispatch in submission order."""
+        from pathway_tpu.models.tokenizer import PACK_MAX_SEGMENTS
+
+        kind, keys, ids, second, slots = payload
+        if kind == "packed":
+            pooled = self.encoder.lm.encode_packed(ids, second, PACK_MAX_SEGMENTS)
+            rows = np.fromiter(
+                (r for r, _ in slots), dtype=np.int64, count=len(slots)
+            )
+            segs = np.fromiter(
+                (s for _, s in slots), dtype=np.int64, count=len(slots)
+            )
+            emb = pooled[rows, segs]  # device-side gather, [B, d]
+        else:
+            emb = self.encoder.lm(ids, second)[: len(keys)]
+        if keys:
+            self.index.add_batch(keys, emb)
+        return emb
 
     def search_texts(self, texts, k: int) -> list:
         from pathway_tpu.models.tokenizer import encode_batch

@@ -9,6 +9,7 @@ unchanged."""
 
 from __future__ import annotations
 
+import logging
 import os
 import enum
 from dataclasses import dataclass
@@ -142,6 +143,8 @@ class _FusedKnnIndexImpl(IndexImpl):
         )
         self.fused = FusedEmbedSearch(encoder, self.knn)
         self.metadata: dict = {}
+        self._pipeline = None
+        self._pipeline_broken = False
 
     def add(self, key, value, metadata) -> None:
         self.add_many([key], [value], [metadata])
@@ -160,17 +163,132 @@ class _FusedKnnIndexImpl(IndexImpl):
         except ValueError:
             return 0
 
+    # -- async device pipeline wiring --------------------------------------
+
+    def _use_pipeline(self) -> bool:
+        from pathway_tpu.internals.device_pipeline import pipeline_enabled
+        from pathway_tpu.internals.device_probe import device_degraded
+
+        # mesh path keeps the classic dispatch (sharded inputs would need
+        # per-shard donation bookkeeping); DEGRADED devices bypass the
+        # pipeline so in-flight work drains and new batches take the
+        # synchronous path the monitor already guards
+        return (
+            pipeline_enabled()
+            and not self._pipeline_broken
+            and self.knn.mesh is None
+            and not device_degraded()
+        )
+
+    def _ensure_pipeline(self):
+        if self._pipeline is None:
+            from pathway_tpu.internals.device_pipeline import DevicePipeline
+
+            self._pipeline = DevicePipeline(
+                prepare=lambda item: self.fused.prepare_batch(*item),
+                dispatch=self.fused.dispatch_batch,
+                quiesce=self._quiesce_device,
+                name="knn-ingest",
+            )
+        return self._pipeline
+
+    def _quiesce_device(self) -> None:
+        # scalar readback on the index buffer: completion of this sum
+        # implies completion of every scatter in the donated-buffer chain
+        import jax.numpy as jnp
+
+        self.knn._flush()
+        buf = getattr(self.knn, "_buffer", None)
+        if buf is not None:
+            np.asarray(jnp.sum(buf[:1, :4].astype(jnp.float32)))
+
+    def _pipeline_step(self, n: int) -> int:
+        # finer chunks than the monolithic sync default: prepare of chunk
+        # i+1 overlaps device execution of chunk i (the whole point);
+        # PATHWAY_INGEST_CHUNK still wins when set
+        return self._ingest_chunk() or min(max(n, 1), 1024)
+
+    def _disable_pipeline(self, exc) -> None:
+        """Per-batch fallback, columnar-exchange style: disable the
+        pipeline for this impl and replay every parked batch on the
+        classic synchronous path (exactly once — parked batches never
+        reached the device)."""
+        self._pipeline_broken = True
+        failed = self._pipeline.take_failed() if self._pipeline else []
+        logging.getLogger(__name__).warning(
+            "device pipeline disabled after %s: %s; replaying %d "
+            "batch(es) synchronously",
+            type(getattr(exc, "__cause__", None) or exc).__name__,
+            exc,
+            len(failed),
+        )
+        for keys_c, texts_c in failed:
+            self.fused.embed_and_add(keys_c, texts_c)
+
+    def _sync_pipeline(self, *, full: bool = False) -> None:
+        """barrier (dispatched) or full drain (executed) of the ingest
+        pipeline; pipeline failures downgrade to the sync replay path."""
+        from pathway_tpu.internals.device_pipeline import DevicePipelineError
+
+        pipe = self._pipeline
+        if pipe is None:
+            return
+        try:
+            if full:
+                pipe.drain()
+            else:
+                pipe.barrier()
+        except DevicePipelineError as exc:
+            self._disable_pipeline(exc)
+
+    def drain(self) -> None:
+        """Complete all in-flight pipeline batches and quiesce the device
+        — the snapshot / rollback / failover / finish contract."""
+        self._sync_pipeline(full=True)
+
+    def take_aux_spans(self):
+        if self._pipeline is None:
+            return []
+        return self._pipeline.take_aux_spans()
+
     def add_many(self, keys, values, metas) -> None:
+        from pathway_tpu.internals.device_pipeline import DevicePipelineError
+
         texts = [v if isinstance(v, str) else str(v) for v in values]
         keys = list(keys)
-        step = self._ingest_chunk() or len(texts) or 1
-        for s in range(0, len(texts), step):
-            self.fused.embed_and_add(keys[s : s + step], texts[s : s + step])
+        if texts and self._use_pipeline():
+            pipe = self._ensure_pipeline()
+            step = self._pipeline_step(len(texts))
+            chunks = [
+                (keys[s : s + step], texts[s : s + step])
+                for s in range(0, len(texts), step)
+            ]
+            for i, chunk in enumerate(chunks):
+                try:
+                    pipe.submit(chunk)
+                except DevicePipelineError as exc:
+                    self._disable_pipeline(exc)
+                    for keys_c, texts_c in chunks[i:]:
+                        self.fused.embed_and_add(keys_c, texts_c)
+                    break
+        elif texts:
+            # classic synchronous path (PATHWAY_DEVICE_PIPELINE=0, mesh,
+            # degraded device, or prior pipeline failure); finish any
+            # still-pipelined work first so delta order is preserved
+            self._sync_pipeline(full=True)
+            step = self._ingest_chunk() or len(texts) or 1
+            for s in range(0, len(texts), step):
+                self.fused.embed_and_add(
+                    keys[s : s + step], texts[s : s + step]
+                )
         for key, meta in zip(keys, metas):
             if meta is not None:
                 self.metadata[key] = meta
 
     def remove(self, key) -> None:
+        # removes mutate the slot maps the dispatcher also writes — order
+        # behind everything already submitted
+        self._sync_pipeline()
         self.knn.remove(key)
         self.metadata.pop(key, None)
 
@@ -178,6 +296,9 @@ class _FusedKnnIndexImpl(IndexImpl):
         return self.search_many([value], [k], [metadata_filter])[0]
 
     def search_many(self, values, ks, filters):
+        # searches read the device buffer: a dispatch barrier suffices —
+        # XLA's data dependency on the scatter chain orders the rest
+        self._sync_pipeline()
         if not values:
             return []
         if len(self.knn) == 0:

@@ -88,9 +88,21 @@ def build_lintful_graph():
     wide = t.select(name=t.name, age=t.age, score=t.score)
     narrow = wide.select(name=wide.name)
 
+    # PWT401: embedder whose tiny max_batch_size buckets to 8 rows and
+    # pads every doc to the bucket max (>50% predicted waste). The pass
+    # reads the _pw_embedder marker, so a plain marked function works —
+    # no model build, and the trace stays in this file.
+    def tiny_embed(text: str) -> str:
+        return text
+
+    tiny_embed._pw_embedder = {
+        "model": "tiny", "max_batch_size": 3, "max_len": 256,
+    }
+    emb = t.select(name=t.name, e=pw.apply_with_type(tiny_embed, str, t.name))
+
     _sink(
         lossy, bad_cmp, arith, by_float, tup, joined, nd_red, au_red,
-        win, it, narrow,
+        win, it, narrow, emb,
     )
     # PWT110: computed after the sinks, read by nobody.  Returned so the
     # caller keeps it alive — the parse graph tracks tables by weakref,

@@ -1,0 +1,342 @@
+"""Async device pipeline + packed ragged batching (tier-1).
+
+Covers the PR's acceptance list: pack_batch packing invariants,
+sync-vs-async EXACT ingest value parity, packed-vs-classic encoder
+parity, the device_flap chaos drain (in-flight batches complete, new
+work degrades to the sync path cleanly), and the pipeline-failure
+synchronous replay.  Everything runs on the CPU backend with tiny
+hash-tokenizer models — no 'slow' marks."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from pathway_tpu.models.minilm import SentenceEncoder
+from pathway_tpu.models.tokenizer import (
+    PACK_MAX_SEGMENTS,
+    encode_batch,
+    pack_batch,
+)
+from pathway_tpu.models.transformer import TransformerConfig
+
+TINY = TransformerConfig(
+    vocab_size=512, hidden=32, layers=1, heads=2, mlp_dim=64, max_len=64
+)
+
+
+def _encoder(name: str, max_len: int = 32) -> SentenceEncoder:
+    # fresh (uncached) encoder; seed=0 default makes params deterministic,
+    # so two constructions with the same name/config agree exactly
+    return SentenceEncoder(name, config=TINY, max_len=max_len)
+
+
+@contextlib.contextmanager
+def _env(**kv):
+    saved = {k: os.environ.get(k) for k in kv}
+    for k, v in kv.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# -- packing ----------------------------------------------------------------
+
+
+def test_pack_batch_slots_and_invariants():
+    tok = _encoder("pack-tiny").tokenizer
+    texts = [
+        f"alpha bravo charlie doc{i} " + "word " * (i % 7) for i in range(11)
+    ]
+    ids, seg, slots = pack_batch(tok, texts, max_len=32, token_budget=64)
+    ids, seg = np.asarray(ids), np.asarray(seg)
+    assert ids.shape == seg.shape
+    assert len(slots) == len(texts)
+    rows, slab = ids.shape
+    assert slab == 64  # short docs: the budget holds
+    assert rows % 8 == 0  # bucketed row count
+    # every doc's tokens land verbatim at its (row, segment) slot
+    for (r, s), text in zip(slots, texts):
+        want_ids, want_mask = encode_batch(tok, [text], max_len=32)
+        want = np.asarray(want_ids)[0][np.asarray(want_mask)[0] > 0]
+        got = ids[r][seg[r] == s + 1]
+        assert np.array_equal(got.astype(np.int64), want.astype(np.int64))
+    # segment ids are 1..k per row (0 = pad), non-decreasing runs
+    for r in range(rows):
+        nz = seg[r][seg[r] > 0]
+        if nz.size:
+            uniq = np.unique(nz)
+            assert uniq[0] == 1
+            assert np.array_equal(uniq, np.arange(1, uniq.size + 1))
+            assert np.all(np.diff(nz) >= 0)
+    assert seg.max() <= PACK_MAX_SEGMENTS
+
+
+def test_pack_batch_budget_overflow_grows_slab():
+    tok = _encoder("pack-long", max_len=64).tokenizer
+    long_doc = "stream table engine " * 20
+    _ids1, mask1 = encode_batch(tok, [long_doc], max_len=64)
+    need = int(np.asarray(mask1).sum())
+    assert need > 16
+    ids, seg, slots = pack_batch(
+        tok, [long_doc], max_len=64, token_budget=16
+    )
+    # a doc longer than the budget grows the slab instead of truncating
+    assert np.asarray(ids).shape[1] >= need
+    (r, s) = slots[0]
+    assert int((np.asarray(seg)[r] == s + 1).sum()) == need
+
+
+def test_pack_batch_max_segments_spill():
+    tok = _encoder("pack-many").tokenizer
+    texts = [f"w{i}" for i in range(PACK_MAX_SEGMENTS + 8)]
+    _ids, _seg, slots = pack_batch(
+        tok, texts, max_len=32, token_budget=4096
+    )
+    rows_used = {r for r, _s in slots}
+    assert len(rows_used) >= 2  # spilled past one row's segment limit
+    for r in rows_used:
+        assert sum(1 for rr, _s in slots if rr == r) <= PACK_MAX_SEGMENTS
+
+
+def test_packed_positions_restart_per_segment():
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.transformer import _packed_positions
+
+    seg = jnp.asarray(
+        [[1, 1, 1, 2, 2, 0, 0, 0], [1, 2, 2, 2, 3, 3, 0, 0]]
+    )
+    pos = np.asarray(_packed_positions(seg))
+    assert pos[0, :5].tolist() == [0, 1, 2, 0, 1]
+    assert pos[1, :6].tolist() == [0, 0, 1, 2, 0, 1]
+
+
+# -- value parity -----------------------------------------------------------
+
+
+def test_sync_async_ingest_value_parity():
+    """PATHWAY_DEVICE_PIPELINE=1 vs =0 produce byte-identical index
+    buffers when packing is pinned off: identical chunk boundaries feed
+    identical compiled dispatches, async only reorders WHEN they run."""
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+        _FusedKnnIndexImpl,
+    )
+
+    texts = [f"alpha bravo doc{i} charlie delta" for i in range(48)]
+    keys = list(range(len(texts)))
+
+    def ingest(flag: str):
+        with _env(
+            PATHWAY_DEVICE_PIPELINE=flag,
+            PATHWAY_PACK_TOKEN_BUDGET="0",
+            PATHWAY_INGEST_CHUNK="16",
+        ):
+            impl = _FusedKnnIndexImpl(
+                _encoder("parity-tiny"), "cos", len(texts)
+            )
+            impl.add_many(keys, texts, [None] * len(keys))
+            impl.drain()
+            used_pipeline = impl._pipeline is not None
+            return np.asarray(
+                impl.knn._buffer.astype("float32")
+            )[: len(keys)], used_pipeline
+
+    sync_buf, sync_used = ingest("0")
+    async_buf, async_used = ingest("1")
+    assert not sync_used and async_used
+    assert np.array_equal(sync_buf, async_buf)
+
+
+def test_packed_vs_classic_encoder_parity():
+    enc = _encoder("packed-parity")
+    texts = [
+        "alpha bravo charlie",
+        "delta " * 12,
+        "echo foxtrot golf hotel india juliet",
+        "kilo",
+    ]
+    classic = enc.encode(texts)
+    with _env(PATHWAY_PACK_TOKEN_BUDGET="64"):
+        packed = enc.encode_packed(texts)
+    assert packed.shape == classic.shape
+    np.testing.assert_allclose(packed, classic, atol=2e-2, rtol=0)
+    # both are L2-normalized
+    np.testing.assert_allclose(
+        np.linalg.norm(packed, axis=1), 1.0, atol=1e-3
+    )
+
+
+# -- chaos: device flap mid-pipeline ---------------------------------------
+
+
+def test_device_flap_mid_pipeline_drains_and_degrades():
+    """A device_flap firing mid-pipeline must drain the in-flight batches
+    (nothing lost, nothing duplicated) and route new ingest through the
+    classic sync path while DEGRADED — without marking the pipeline
+    broken (it resumes after re-promotion)."""
+    from pathway_tpu.internals import device_probe, faults
+    from pathway_tpu.internals.device_probe import DeviceMonitor
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+        _FusedKnnIndexImpl,
+    )
+
+    impl = _FusedKnnIndexImpl(_encoder("flap-tiny"), "cos", 64)
+    texts = [f"alpha doc{i} bravo charlie" for i in range(24)]
+    monitor = DeviceMonitor(interval_s=1.0, probe=lambda _t: (0.5, None))
+    old = device_probe._monitor
+    device_probe._monitor = monitor
+    faults.install("device_flap@probes=1")
+    try:
+        with _env(PATHWAY_DEVICE_PIPELINE="1", PATHWAY_INGEST_CHUNK="8"):
+            impl.add_many(range(12), texts[:12], [None] * 12)
+            assert impl._pipeline is not None
+            pipe = impl._pipeline
+            # the flap fires between batches: monitor walks to DEGRADED
+            assert monitor.probe_once()["state"] == "degraded"
+            assert device_probe.device_degraded()
+            # new ingest bypasses the pipeline; in-flight work drains first
+            impl.add_many(range(12, 24), texts[12:], [None] * 12)
+            stats = pipe.stats()
+            assert stats["dispatched"] == stats["submitted"]
+            assert stats["in_flight"] == 0
+            assert not impl._pipeline_broken
+            assert len(impl.knn) == 24
+            rows = impl.search_many(
+                [texts[0], texts[23]], [1, 1], [None, None]
+            )
+            assert rows[0][0][0] == 0
+            assert rows[1][0][0] == 23
+            # budget exhausted: next probe re-promotes, pipeline resumes
+            assert monitor.probe_once()["state"] == "healthy"
+            assert impl._use_pipeline()
+    finally:
+        device_probe._monitor = old
+        faults.clear()
+
+
+# -- failure model ----------------------------------------------------------
+
+
+def test_pipeline_error_parks_and_replays():
+    """A dispatch failure parks the failing item AND everything still
+    queued (in order), surfaces as DevicePipelineError, and take_failed
+    resets the pipeline for further use."""
+    from pathway_tpu.internals.device_pipeline import (
+        DevicePipeline,
+        DevicePipelineError,
+    )
+
+    gate = threading.Event()
+    dispatched = []
+
+    def prepare(item):
+        return item, {"rows": 1}
+
+    def dispatch(payload):
+        gate.wait(10)
+        if payload == "boom":
+            raise RuntimeError("injected dispatch failure")
+        dispatched.append(payload)
+        return None
+
+    pipe = DevicePipeline(
+        prepare, dispatch, wait=lambda _h: None, name="test-pipe"
+    )
+    try:
+        pipe.submit("a")
+        pipe.submit("boom")
+        pipe.submit("b")
+        gate.set()
+        with pytest.raises(DevicePipelineError):
+            pipe.drain()
+        assert pipe.take_failed() == ["boom", "b"]
+        assert dispatched == ["a"]
+        # error state cleared: the pipeline accepts work again
+        pipe.submit("c")
+        pipe.drain()
+        assert dispatched == ["a", "c"]
+    finally:
+        pipe.close()
+
+
+def test_impl_pipeline_failure_replays_synchronously():
+    """An impl-level dispatch failure downgrades to the classic path and
+    replays the parked batches exactly once — every doc lands."""
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+        _FusedKnnIndexImpl,
+    )
+
+    impl = _FusedKnnIndexImpl(_encoder("fallback-tiny"), "cos", 32)
+    texts = [f"delta doc{i} echo foxtrot" for i in range(12)]
+    orig = impl.fused.dispatch_batch
+    state = {"failures": 1}
+
+    def flaky(payload):
+        if state["failures"]:
+            state["failures"] -= 1
+            raise RuntimeError("injected dispatch failure")
+        return orig(payload)
+
+    impl.fused.dispatch_batch = flaky
+    with _env(PATHWAY_DEVICE_PIPELINE="1", PATHWAY_INGEST_CHUNK="4"):
+        impl.add_many(range(12), texts, [None] * 12)
+        impl.drain()
+        assert impl._pipeline_broken
+        assert len(impl.knn) == 12
+        rows = impl.search_many([texts[5]], [1], [None])
+        assert rows[0][0][0] == 5
+        # broken pipeline stays off: further ingest is classic and works
+        impl.add_many([12], ["golf doc12 hotel"], [None])
+        assert len(impl.knn) == 13
+
+
+# -- observability ----------------------------------------------------------
+
+
+def test_pipeline_status_and_gauges():
+    from pathway_tpu.internals.device_pipeline import (
+        pipeline_metrics,
+        pipeline_status,
+    )
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+        _FusedKnnIndexImpl,
+    )
+
+    impl = _FusedKnnIndexImpl(_encoder("status-tiny"), "cos", 32)
+    texts = [f"india doc{i} juliet kilo" for i in range(16)]
+    with _env(
+        PATHWAY_DEVICE_PIPELINE="1",
+        PATHWAY_PACK_TOKEN_BUDGET="64",
+        PATHWAY_INGEST_CHUNK="8",
+    ):
+        impl.add_many(range(16), texts, [None] * 16)
+        impl.drain()
+        status = pipeline_status()
+        assert status["enabled"]
+        assert status["active"] >= 1
+        assert status["rows"] >= 16
+        assert status["pad_waste_ratio"] is not None
+        assert 0.0 <= status["pad_waste_ratio"] < 1.0
+        rendered = pipeline_metrics().render()
+        assert "pathway_device_pad_waste_ratio" in rendered
+        assert "pathway_device_pipeline_queue_depth" in rendered
+        assert "pathway_device_pipeline_occupancy" in rendered
+        # aux spans attribute host prep vs device dispatch
+        spans = impl.take_aux_spans()
+        kinds = {name for name, _t0, _dur, _rows in spans}
+        assert "pipeline:prep" in kinds
+        assert "pipeline:dispatch" in kinds

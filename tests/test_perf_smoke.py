@@ -312,6 +312,38 @@ def test_ineligible_graphs_stay_classic():
     assert _columnar_stats(eng) == {}
 
 
+@pytest.mark.perf_smoke
+def test_async_device_pipeline_selected_when_enabled(monkeypatch):
+    """The async ingest pipeline is selection-gated like the columnar
+    nodes: with the default env (PATHWAY_DEVICE_PIPELINE unset = on) an
+    eligible ingest MUST route through the DevicePipeline — proven by
+    the pipeline's own dispatch counters, not timing (the docs/s claim
+    lives in benchmarks/engine_bench.py --pipeline)."""
+    from pathway_tpu.models.minilm import SentenceEncoder
+    from pathway_tpu.models.transformer import TransformerConfig
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+        _FusedKnnIndexImpl,
+    )
+
+    monkeypatch.delenv("PATHWAY_DEVICE_PIPELINE", raising=False)
+    tiny = TransformerConfig(
+        vocab_size=512, hidden=32, layers=1, heads=2, mlp_dim=64, max_len=32
+    )
+    impl = _FusedKnnIndexImpl(
+        SentenceEncoder("smoke-pipeline", config=tiny, max_len=16),
+        "cos",
+        32,
+    )
+    texts = [f"alpha doc{i} bravo" for i in range(16)]
+    impl.add_many(range(16), texts, [None] * 16)
+    impl.drain()
+    assert impl._pipeline is not None, "async ingest path not selected"
+    stats = impl._pipeline.stats()
+    assert stats["dispatched"] >= 1
+    assert stats["rows"] == 16
+    assert not impl._pipeline_broken
+
+
 # ---------------------------------------------------------------------------
 # static analyzer over the benchmark topologies: the graphs we publish
 # numbers for must lint clean, and the analyzer's columnar predictions
