@@ -91,6 +91,33 @@ def build_wordcount_graph(n_rows, vocab=10_000, batch=200_000):
     return tab.groupby(tab.word).reduce(tab.word, cnt=pw.reducers.count())
 
 
+def build_wordcount_chain_graph(n_rows, vocab=1_000, batch=50_000):
+    """Wordcount with a fusable row-wise prefix: source -> select
+    (normalize) -> filter (drop negatives) -> select (reorder
+    projection) -> groupby(word) -> count/sum.  The three middle ops
+    form one maximal PWT501 chain; the build collapses them into a
+    single FusedChainNode (analysis/fusion.py plan contract), which
+    bench_fused_chain A/Bs against the classic three-node build."""
+    rng = random.Random(13)
+    words = [f"w{i}" for i in range(vocab)]
+    schema = schema_from_types(word=str, n=int)
+    events = []
+    t = 2
+    for i in range(n_rows):
+        events.append((t, (ref_scalar(i), (rng.choice(words), i % 97), 1)))
+        if (i + 1) % batch == 0:
+            t += 2
+    tab = table_from_events(schema, events)
+    normalized = tab.select(tab.word, n=tab.n * 2)
+    kept = normalized.filter(normalized.n >= 0)
+    slim = kept.select(kept.n, kept.word)
+    return slim.groupby(slim.word).reduce(
+        slim.word,
+        cnt=pw.reducers.count(),
+        total=pw.reducers.sum(slim.n),
+    )
+
+
 def build_join_graph(n_left, n_right):
     """Small build side at t=2, one big probe-side batch at t=4 ->
     inner join -> select."""
@@ -126,6 +153,9 @@ def build_flatten_graph(n_rows, width=4):
 GRAPH_BUILDERS = {
     "reduce": lambda: build_reduce_graph(64, 4),
     "wordcount": lambda: build_wordcount_graph(256, vocab=32, batch=64),
+    "wordcount_chain": lambda: build_wordcount_chain_graph(
+        256, vocab=32, batch=64
+    ),
     "join": lambda: build_join_graph(128, 16),
     "flatten": lambda: build_flatten_graph(64),
 }
@@ -277,6 +307,72 @@ def bench_flatten_columnar(n_rows=100_000, width=4):
         "classic_s": round(secs["classic"], 4),
         "columnar_s": round(secs["columnar"], 4),
         "columnar_vs_classic": round(ratio, 2),
+    }))
+    return ratio
+
+
+def bench_fused_chain(n_rows=200_000, vocab=1_000, batch=20_000):
+    """Chain-fusion A/B on the wordcount_chain topology.
+
+    Classic arm (PATHWAY_DISABLE_FUSION=1) builds the row-wise prefix as
+    three nodes (RowwiseNode + FilterNode + RowwiseNode), each paying its
+    own take/emit and intermediate triple materialization per batch; the
+    fused arm builds the plan's single FusedChainNode.  Seconds are
+    node-isolated via PATHWAY_NODE_TIMING_LOG (the groupby/capture tail
+    is identical in both arms), best-of-2 interleaved runs per arm."""
+    import tempfile
+
+    from pathway_tpu.internals.parse_graph import G
+
+    node_types = {
+        "classic": ("RowwiseNode", "FilterNode"),
+        "fused": ("FusedChainNode",),
+    }
+    secs = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        run_no = 0
+        for label, disable in (
+            ("classic", "1"), ("fused", "0"),
+            ("classic", "1"), ("fused", "0"),  # best-of-2 per arm
+        ):
+            run_no += 1
+            G.clear()
+            log = _os.path.join(tmp, f"timing_{run_no}.jsonl")
+            saved = {
+                k: _os.environ.get(k)
+                for k in (
+                    "PATHWAY_NODE_TIMING_LOG", "PATHWAY_DISABLE_FUSION"
+                )
+            }
+            _os.environ["PATHWAY_NODE_TIMING_LOG"] = log
+            _os.environ["PATHWAY_DISABLE_FUSION"] = disable
+            try:
+                res = build_wordcount_chain_graph(
+                    n_rows, vocab=vocab, batch=batch
+                )
+                (capture,) = run_tables(res, record_stream=True)
+                total = sum(r[1] for r in capture.state.rows.values())
+                assert total == n_rows, (label, total, n_rows)
+                node_s = _node_seconds(log, node_types[label])
+                assert node_s > 0.0, (label, "no timed chain nodes")
+                secs[label] = min(secs.get(label, node_s), node_s)
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        _os.environ.pop(k, None)
+                    else:
+                        _os.environ[k] = v
+                G.clear()
+    ratio = secs["classic"] / secs["fused"]
+    print(json.dumps({
+        "metric": "fused_chain_rows_per_sec",
+        "value": round(n_rows / secs["fused"]),
+        "unit": "rows/s through the fused select|filter|select chain",
+        "classic_rows_per_sec": round(n_rows / secs["classic"]),
+        "classic_s": round(secs["classic"], 4),
+        "fused_s": round(secs["fused"], 4),
+        "fused_vs_classic": round(ratio, 2),
+        "n_rows": n_rows,
     }))
     return ratio
 
@@ -820,8 +916,11 @@ if __name__ == "__main__":
         bench_exchange()
     elif "--pipeline" in _sys.argv:
         bench_pipeline()
+    elif "--fusion" in _sys.argv:
+        bench_fused_chain()
     else:
         bench_group_update_flatness()
         bench_wordcount()
         bench_join_columnar()
         bench_flatten_columnar()
+        bench_fused_chain()

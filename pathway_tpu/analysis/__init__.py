@@ -17,20 +17,26 @@ from typing import Any, Iterable, Optional
 
 from pathway_tpu.analysis.diagnostics import (
     CODES,
+    SCHEMA_VERSION,
     AnalysisResult,
     Diagnostic,
     Severity,
     make_diag,
 )
+from pathway_tpu.analysis.fusion import FusionChain, FusionPlan, plan_fusion
 from pathway_tpu.analysis.graph import GraphView
+from pathway_tpu.analysis.mesh import MeshSpec
 from pathway_tpu.analysis.passes import (
     columnar_pass,
     dead_pass,
     dtype_pass,
     embedder_pass,
+    fusion_pass,
+    mesh_pass,
     state_pass,
     udf_pass,
     verify_against_plan,
+    verify_fusion,
 )
 
 
@@ -58,16 +64,21 @@ def analyze(
     *,
     extra_tables: Iterable[Any] = (),
     workers: Optional[int] = None,
+    mesh: Any = None,
 ) -> AnalysisResult:
     """Run every pass over `graph` (default: the global parse graph).
 
     `extra_tables` anchors tables that are not registered as sinks (e.g.
     run_tables captures); `workers` overrides the configured worker
-    count for the exchange-related lints."""
+    count for the exchange-related lints; `mesh` (a MeshSpec,
+    "dp=4,tp=2" string or mapping) additionally runs the PWT4xx
+    mesh-compatibility pass against that device topology."""
     if graph is None:
         from pathway_tpu.internals.parse_graph import G as graph
     if workers is None:
         workers = _worker_count()
+    if mesh is not None:
+        mesh = MeshSpec.parse(mesh)
     view = GraphView(graph, extra_tables=extra_tables)
     result = AnalysisResult()
     dtype_pass(view, result)
@@ -76,6 +87,8 @@ def analyze(
     dead_pass(view, result)
     udf_pass(view, result, workers=workers)
     embedder_pass(view, result, workers=workers)
+    fusion_pass(view, result)
+    mesh_pass(view, result, mesh=mesh, workers=workers)
     return result
 
 
@@ -84,9 +97,15 @@ __all__ = [
     "AnalysisResult",
     "CODES",
     "Diagnostic",
+    "FusionChain",
+    "FusionPlan",
     "GraphView",
+    "MeshSpec",
+    "SCHEMA_VERSION",
     "Severity",
     "analyze",
     "make_diag",
+    "plan_fusion",
     "verify_against_plan",
+    "verify_fusion",
 ]

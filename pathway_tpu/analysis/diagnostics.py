@@ -52,14 +52,34 @@ CODES: Dict[str, tuple] = {
     "PWT305": (Severity.WARNING, "non-deterministic UDF feeds stateful operator"),
     "PWT306": (Severity.WARNING, "async/blocking UDF on exchange-crossing path"),
     "PWT399": (Severity.ERROR, "analyzer prediction disagrees with built plan"),
-    # PWT4xx — accelerator utilization
+    # PWT4xx — accelerator utilization / mesh compatibility
     "PWT401": (Severity.WARNING, "embedder batch shape wastes MXU on padding"),
+    "PWT402": (Severity.ERROR, "embedding shape incompatible with mesh axes"),
+    "PWT403": (Severity.WARNING, "reducer is not shardable across the mesh"),
+    "PWT404": (Severity.WARNING, "exchange sharding disagrees with mesh axes"),
+    "PWT405": (Severity.WARNING, "single-worker-pinned source on a mesh"),
+    # PWT5xx — fusion planning
+    "PWT501": (Severity.INFO, "fusable select/filter chain found"),
+    "PWT502": (Severity.INFO, "fusion chain broken by non-fusable operator"),
+    "PWT503": (Severity.INFO, "fusion chain broken by fan-out"),
+    "PWT504": (Severity.INFO, "UDF barrier blocks chain fusion"),
+    "PWT599": (Severity.ERROR, "fusion plan disagrees with built nodes"),
 }
+
+# JSON schema version for analyze --json payloads and the golden matrix.
+# Bump when the payload shape changes (v2: schema_version stamp itself,
+# deterministic finding order, the "fusion" plan section).
+SCHEMA_VERSION = 2
 
 
 def _trace_to_dict(trace: Any) -> Optional[Dict[str, Any]]:
     if trace is None:
         return None
+    if isinstance(trace, dict):
+        # already converted — passes that emit several findings for one
+        # operator convert once and share the dict (read-only by
+        # convention; Diagnostic.to_dict copies on serialization)
+        return trace
     return {
         "file": trace.file,
         "line": trace.line,
@@ -133,6 +153,20 @@ def make_diag(
     )
 
 
+def _finding_sort_key(f: Diagnostic) -> tuple:
+    """Deterministic order regardless of pass/thread scheduling: (code,
+    trace location, operator, message).  Applied before every render and
+    serialization so golden-matrix comparisons cannot flake."""
+    trace = f.trace or {}
+    return (
+        f.code,
+        trace.get("file") or "",
+        trace.get("line") or 0,
+        f.operator or "",
+        f.message,
+    )
+
+
 @dataclass
 class AnalysisResult:
     findings: List[Diagnostic] = field(default_factory=list)
@@ -140,9 +174,27 @@ class AnalysisResult:
     # {"op", "op_id", "predicted": "columnar"|"classic", "reasons": [...],
     #  "trace": {...}|None}
     predictions: List[Dict[str, Any]] = field(default_factory=list)
+    # FusionPlan section, attached by fusion_pass.  Holds either the
+    # serialized dict or the live FusionPlan object (serialized lazily
+    # on first read — the common pw.run path never reads it)
+    _fusion: Any = field(default=None, repr=False)
+
+    @property
+    def fusion(self) -> Optional[Dict[str, Any]]:
+        src = self._fusion
+        if src is not None and not isinstance(src, dict):
+            src = self._fusion = src.to_dict()
+        return src
+
+    @fusion.setter
+    def fusion(self, value: Any) -> None:
+        self._fusion = value
 
     def add(self, diag: Diagnostic) -> None:
         self.findings.append(diag)
+
+    def sorted_findings(self) -> List[Diagnostic]:
+        return sorted(self.findings, key=_finding_sort_key)
 
     def max_severity(self) -> Optional[Severity]:
         if not self.findings:
@@ -157,23 +209,26 @@ class AnalysisResult:
 
     def to_dict(self) -> Dict[str, Any]:
         return {
-            "version": 1,
-            "findings": [f.to_dict() for f in self.findings],
+            "schema_version": SCHEMA_VERSION,
+            "findings": [f.to_dict() for f in self.sorted_findings()],
             "predictions": [dict(p) for p in self.predictions],
+            "fusion": dict(self.fusion) if self.fusion is not None else None,
             "summary": self.counts(),
         }
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "AnalysisResult":
+        fusion = d.get("fusion")
         return cls(
             findings=[Diagnostic.from_dict(f) for f in d.get("findings", [])],
             predictions=[dict(p) for p in d.get("predictions", [])],
+            _fusion=dict(fusion) if fusion is not None else None,
         )
 
     def render_text(self) -> str:
         lines: List[str] = []
         order = sorted(
-            self.findings, key=lambda f: (-int(f.severity), f.code)
+            self.sorted_findings(), key=lambda f: (-int(f.severity), f.code)
         )
         for f in order:
             _sev, title = CODES.get(f.code, (Severity.INFO, ""))

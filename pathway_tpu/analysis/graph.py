@@ -10,10 +10,11 @@ best-effort dtype resolver.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, Iterator, List, Optional, Set
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals.expression import (
+    ApplyExpression,
     ColumnExpression,
     ColumnReference,
     IdReference,
@@ -118,24 +119,94 @@ class GraphView:
                 known.add(id(t))
                 self.tables.append(t)
 
-        # consumer index over the visible tables
+        # one sweep over the visible tables builds every index the passes
+        # share: the consumer map, the (table, op) pair lists behind
+        # ops(), the anchored per-kind buckets, and the connector tables
+        # carrying a live-source descriptor.  vars() sidesteps
+        # Table.__getattr__'s column-lookup fallback.
+        self.sink_ids: Set[int] = seen_sink
         self.consumers: Dict[int, List[Any]] = {}
+        self.anchored_by_kind: Dict[str, List[Any]] = {}
+        self.live_source_tables: List[Any] = []
+        self._all_pairs: List[Any] = []
+        self._anchored_pairs: List[Any] = []
+        self._anchored_consumers: Dict[int, List[Any]] = {}
+        anchored_ids = self._anchored_ids
         for t in self.tables:
-            op = getattr(t, "_op", None)
+            d = vars(t)
+            live = d.get("_live_source")
+            if live is not None:
+                self.live_source_tables.append((live, t))
+            op = d.get("_op")
             if op is None:
                 continue
+            self._all_pairs.append((t, op))
+            anchored = id(t) in anchored_ids
+            if anchored:
+                self._anchored_pairs.append((t, op))
+                self.anchored_by_kind.setdefault(op.kind, []).append((t, op))
             for inp in op.inputs:
                 self.consumers.setdefault(id(inp), []).append(t)
+                if anchored:
+                    self._anchored_consumers.setdefault(
+                        id(inp), []
+                    ).append(t)
+
+        self._apply_index: Optional[Dict[int, Tuple[Any, ...]]] = None
+        self._apply_sites: Optional[List[Any]] = None
+        self._label_cache: Dict[int, str] = {}
+
+    def apply_index(self) -> Dict[int, Tuple[Any, ...]]:
+        """table id -> deduped ApplyExpression nodes in that table's op
+        payload, in expression-walk order.  Four passes scan for UDF call
+        sites (udf_pass, embedder_pass, the fusion planner's barrier
+        check and the mesh pass's embedder-marker scan); the graph is
+        immutable under this view, so the expression walk happens once
+        and everyone shares the result."""
+        if self._apply_index is None:
+            idx: Dict[int, Tuple[Any, ...]] = {}
+            rows: List[Any] = []
+            for t, op in self.ops():
+                seen: Set[int] = set()
+                sites: List[Any] = []
+                for expr in op_exprs(op):
+                    for node in walk_expr(expr):
+                        if (
+                            isinstance(node, ApplyExpression)
+                            and id(node) not in seen
+                        ):
+                            seen.add(id(node))
+                            sites.append(node)
+                if sites:
+                    idx[id(t)] = tuple(sites)
+                    rows.append((t, op, tuple(sites)))
+            self._apply_index = idx
+            self._apply_sites = rows
+        return self._apply_index
+
+    def apply_sites(self) -> List[Any]:
+        """(table, op, ApplyExpression sites) rows for every op that
+        calls at least one UDF, in ops() order.  The UDF-centric passes
+        iterate this short list instead of scanning every op."""
+        if self._apply_sites is None:
+            self.apply_index()
+        return self._apply_sites
 
     def is_anchored(self, table: Any) -> bool:
         return id(table) in self._anchored_ids
 
+    def anchored_consumers(self) -> Dict[int, List[Any]]:
+        """Consumer index restricted to the anchored region (built in
+        __init__).  Only anchored consumers are ever built, so only they
+        pin a table's materialization — this is the index the fusion
+        planner walks (a dead reader must not break an otherwise fusable
+        chain)."""
+        return self._anchored_consumers
+
     def ops(self, *, anchored_only: bool = False) -> Iterator[Any]:
-        """(table, op) pairs, de-duplicated, anchored tables first."""
-        for t in (self.anchored if anchored_only else self.tables):
-            op = getattr(t, "_op", None)
-            if op is not None:
-                yield t, op
+        """(table, op) pairs, de-duplicated, anchored tables first
+        (precomputed in __init__)."""
+        return iter(self._anchored_pairs if anchored_only else self._all_pairs)
 
     def graph_path(self, table: Any, depth: int = 5) -> str:
         """Short upstream chain for trace-less findings:
@@ -155,12 +226,19 @@ class GraphView:
         return " <- ".join(parts)
 
     def op_label(self, table: Any) -> str:
-        """The trace-fallback operator label: kind#op_id plus path."""
-        op = getattr(table, "_op", None)
-        if op is None:
-            return "source"
-        path = self.graph_path(table)
-        return f"{op.kind}#{op.op_id} ({path})"
+        """The trace-fallback operator label: kind#op_id plus path.
+        Memoized — every pass labels the tables it reports on, and the
+        upstream path never changes under this view."""
+        label = self._label_cache.get(id(table))
+        if label is None:
+            op = vars(table).get("_op")
+            if op is None:
+                label = "source"
+            else:
+                path = self.graph_path(table)
+                label = f"{op.kind}#{op.op_id} ({path})"
+            self._label_cache[id(table)] = label
+        return label
 
     def reaches_kind(self, table: Any, kinds: Set[str]) -> bool:
         """Does any transitive consumer of `table` run an op in `kinds`?"""

@@ -22,7 +22,6 @@ from pathway_tpu.analysis.diagnostics import AnalysisResult, make_diag
 from pathway_tpu.analysis.graph import GraphView, infer, op_exprs, walk_expr
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals.expression import (
-    ApplyExpression,
     BinaryOpExpression,
     CastExpression,
     ColumnReference,
@@ -411,7 +410,7 @@ def dead_pass(view: GraphView, result: AnalysisResult) -> None:
 def udf_pass(
     view: GraphView, result: AnalysisResult, *, workers: int = 1
 ) -> None:
-    for table, op in view.ops():
+    for table, op, sites in view.apply_sites():
         if op.synthetic:
             continue
         stateful_here = op.kind in STATEFUL_KINDS
@@ -422,37 +421,30 @@ def udf_pass(
             op.kind in _EXCHANGE_KINDS
             or view.reaches_kind(table, _EXCHANGE_KINDS)
         )
-        seen: Set[int] = set()
-        for expr in op_exprs(op):
-            for node in walk_expr(expr):
-                if not isinstance(node, ApplyExpression):
-                    continue
-                if id(node) in seen:
-                    continue
-                seen.add(id(node))
-                fname = getattr(node._fun, "__name__", "<udf>")
-                if not node._deterministic and reaches_stateful:
-                    result.add(make_diag(
-                        "PWT305",
-                        f"UDF {fname!r} is not marked deterministic but "
-                        "feeds a stateful operator: retractions recompute "
-                        "it and may not cancel the original insertion "
-                        "(mark it @pw.udf(deterministic=True) if it is)",
-                        trace=_trace_or_none(table),
-                        operator=view.op_label(table),
-                        udf=fname,
-                    ))
-                if node._is_async and crosses_exchange:
-                    result.add(make_diag(
-                        "PWT306",
-                        f"async UDF {fname!r} sits on an exchange-"
-                        "crossing path: its completion times differ per "
-                        "worker, so downstream keyed state sees "
-                        "interleavings that are hard to reproduce",
-                        trace=_trace_or_none(table),
-                        operator=view.op_label(table),
-                        udf=fname,
-                    ))
+        for node in sites:
+            fname = getattr(node._fun, "__name__", "<udf>")
+            if not node._deterministic and reaches_stateful:
+                result.add(make_diag(
+                    "PWT305",
+                    f"UDF {fname!r} is not marked deterministic but "
+                    "feeds a stateful operator: retractions recompute "
+                    "it and may not cancel the original insertion "
+                    "(mark it @pw.udf(deterministic=True) if it is)",
+                    trace=_trace_or_none(table),
+                    operator=view.op_label(table),
+                    udf=fname,
+                ))
+            if node._is_async and crosses_exchange:
+                result.add(make_diag(
+                    "PWT306",
+                    f"async UDF {fname!r} sits on an exchange-"
+                    "crossing path: its completion times differ per "
+                    "worker, so downstream keyed state sees "
+                    "interleavings that are hard to reproduce",
+                    trace=_trace_or_none(table),
+                    operator=view.op_label(table),
+                    udf=fname,
+                ))
 
 
 # ---------------------------------------------------------------------------
@@ -477,46 +469,39 @@ def embedder_pass(
     pass never builds a model."""
     from pathway_tpu.models.tokenizer import predict_pad_waste
 
-    for table, op in view.ops():
+    for table, op, sites in view.apply_sites():
         if op.synthetic:
             continue
-        seen: Set[int] = set()
-        for expr in op_exprs(op):
-            for node in walk_expr(expr):
-                if not isinstance(node, ApplyExpression):
-                    continue
-                if id(node) in seen:
-                    continue
-                seen.add(id(node))
-                marker = getattr(node._fun, "_pw_embedder", None)
-                if not isinstance(marker, dict):
-                    continue
-                batch = int(marker.get("max_batch_size") or 0)
-                max_len = int(marker.get("max_len") or 512)
-                if batch <= 0:
-                    continue
-                waste = predict_pad_waste(
-                    _SAMPLE_TOKEN_LENGTHS, batch, max_len=max_len
-                )
-                if waste <= _PAD_WASTE_THRESHOLD:
-                    continue
-                fname = getattr(node._fun, "__name__", "<udf>")
-                result.add(make_diag(
-                    "PWT401",
-                    f"embedder {fname!r} with max_batch_size={batch} "
-                    f"predicts {round(100 * waste)}% padding waste on "
-                    "sampled input lengths: the batch buckets to a power "
-                    "of two (minimum 8) and every doc pads to the bucket "
-                    "max, so most MXU cycles process pad tokens; raise "
-                    "max_batch_size or keep packed ragged batching on "
-                    "(PATHWAY_PACK_TOKEN_BUDGET > 0 with the default "
-                    "PATHWAY_DEVICE_PIPELINE=1)",
-                    trace=_trace_or_none(table),
-                    operator=view.op_label(table),
-                    udf=fname,
-                    predicted_waste=round(waste, 3),
-                    max_batch_size=batch,
-                ))
+        for node in sites:
+            marker = getattr(node._fun, "_pw_embedder", None)
+            if not isinstance(marker, dict):
+                continue
+            batch = int(marker.get("max_batch_size") or 0)
+            max_len = int(marker.get("max_len") or 512)
+            if batch <= 0:
+                continue
+            waste = predict_pad_waste(
+                _SAMPLE_TOKEN_LENGTHS, batch, max_len=max_len
+            )
+            if waste <= _PAD_WASTE_THRESHOLD:
+                continue
+            fname = getattr(node._fun, "__name__", "<udf>")
+            result.add(make_diag(
+                "PWT401",
+                f"embedder {fname!r} with max_batch_size={batch} "
+                f"predicts {round(100 * waste)}% padding waste on "
+                "sampled input lengths: the batch buckets to a power "
+                "of two (minimum 8) and every doc pads to the bucket "
+                "max, so most MXU cycles process pad tokens; raise "
+                "max_batch_size or keep packed ragged batching on "
+                "(PATHWAY_PACK_TOKEN_BUDGET > 0 with the default "
+                "PATHWAY_DEVICE_PIPELINE=1)",
+                trace=_trace_or_none(table),
+                operator=view.op_label(table),
+                udf=fname,
+                predicted_waste=round(waste, 3),
+                max_batch_size=batch,
+            ))
 
 
 # ---------------------------------------------------------------------------
@@ -563,4 +548,275 @@ def verify_against_plan(engine: Any, result: AnalysisResult) -> None:
                 operator=f"{op_kind}/{path}",
                 predicted=predicted.get(key, 0),
                 actual=actual.get(key, 0),
+            ))
+
+
+# ---------------------------------------------------------------------------
+# Pass 7 — chain-level fusion planning (PWT501..PWT504)
+# ---------------------------------------------------------------------------
+
+def fusion_pass(view: GraphView, result: AnalysisResult) -> None:
+    """Plan maximal fusable select/filter chains and attach the
+    serialized FusionPlan to the result (analysis/fusion.py holds the
+    walk; the build step runs the same planner, which is what makes the
+    PWT599 cross-check meaningful).  Chain findings are informational:
+    PWT501 says a chain will build as one fused node, PWT502/503 say why
+    it stops where it does, PWT504 marks the ops a UDF keeps out."""
+    from pathway_tpu.analysis.diagnostics import _trace_to_dict
+    from pathway_tpu.analysis.fusion import plan_fusion
+
+    plan = plan_fusion(view)
+    result.fusion = plan  # serialized lazily on first read
+    for chain in plan.chains:
+        tail = chain.tables[-1]
+        trace = _trace_to_dict(_trace_or_none(tail))
+        operator = view.op_label(tail)
+        shape = " -> ".join(chain.kinds)
+        result.add(make_diag(
+            "PWT501",
+            f"fusable chain of {len(chain)} row-wise ops ({shape}) "
+            "collapses into one fused interpreter node: no intermediate "
+            "materialization or per-stage consolidation",
+            trace=trace, operator=operator,
+            chain=chain.chain_id(), length=len(chain),
+            kinds=list(chain.kinds),
+        ))
+        if chain.break_reason == "kind":
+            result.add(make_diag(
+                "PWT502",
+                f"fusion chain ({shape}) stops at a non-fusable "
+                f"{chain.break_info} consumer: that operator keeps keyed "
+                "state and must see materialized rows",
+                trace=trace, operator=operator,
+                chain=chain.chain_id(), consumer=str(chain.break_info),
+            ))
+        elif chain.break_reason == "fanout":
+            result.add(make_diag(
+                "PWT503",
+                f"fusion chain ({shape}) stops at fan-out: "
+                f"{chain.break_info} consumers read the chain tail, so "
+                "its rows must materialize once instead of being "
+                "recomputed per consumer",
+                trace=trace, operator=operator,
+                chain=chain.chain_id(), consumers=chain.break_info,
+            ))
+    for table, name, why in plan.barrier_sites:
+        op = table._op
+        result.add(make_diag(
+            "PWT504",
+            f"{why} UDF {name!r} keeps this {op.kind} out of any fused "
+            "chain: its outputs must materialize per stage so "
+            "retractions can cancel the original insertions",
+            trace=_trace_or_none(table),
+            operator=view.op_label(table),
+            udf=name, why=why,
+        ))
+
+
+# ---------------------------------------------------------------------------
+# Pass 8 — mesh compatibility (PWT402..PWT405)
+# ---------------------------------------------------------------------------
+
+# reducers whose merge depends on arrival order across shards: sharding
+# the groupby over dp devices makes their output depend on the shard
+# interleaving (internals/reducers.py sorts entries per worker, but a
+# cross-shard merge has no shared (time, seq) order)
+_ORDER_SENSITIVE_REDUCERS = {"tuple", "earliest", "latest"}
+
+
+def mesh_pass(
+    view: GraphView, result: AnalysisResult, *, mesh, workers: int = 1
+) -> None:
+    """Lint graphs that cannot shard onto the proposed device mesh.
+
+    Runs only when a mesh spec is given (pw.run(mesh=...) or
+    `analyze --mesh dp=4,tp=2`).  Everything here is provable from the
+    recorded graph + the spec: no devices are touched."""
+    if mesh is None:
+        return
+    dp, tp = mesh.dp, mesh.tp
+
+    # PWT402 — embedder output shapes vs the proposed axes.  Embedder
+    # UDFs carry a `_pw_embedder` marker (xpacks/llm/embedders.py) with
+    # the model's dimension; minilm's encode path additionally buckets
+    # the batch axis to a power of two, so a non-pow2 dp count never
+    # divides the batch evenly (models/minilm.py raises at build time —
+    # this is the fail-fast twin of that check).
+    for table, op, sites in view.apply_sites():
+        if not view.is_anchored(table):
+            continue
+        for node in sites:
+            marker = getattr(node._fun, "_pw_embedder", None)
+            if not isinstance(marker, dict):
+                continue
+            fname = getattr(node._fun, "__name__", "<udf>")
+            trace = _trace_or_none(table)
+            operator = view.op_label(table)
+            dim = int(marker.get("dimension") or 0)
+            if tp > 1 and dim and dim % tp:
+                result.add(make_diag(
+                    "PWT402",
+                    f"embedder {fname!r} produces {dim}-dim vectors, "
+                    f"which a tp={tp} axis cannot shard evenly "
+                    f"({dim} % {tp} != 0): pick a tp that divides "
+                    "the hidden dimension",
+                    trace=trace, operator=operator,
+                    udf=fname, dimension=dim, tp=tp,
+                ))
+            if dp > 1 and dp & (dp - 1):
+                result.add(make_diag(
+                    "PWT402",
+                    f"embedder {fname!r} batches bucket to a power "
+                    f"of two, so a dp={dp} axis never divides the "
+                    "batch evenly: use a power-of-two dp device "
+                    "count (models/minilm.py enforces this at "
+                    "encoder build time)",
+                    trace=trace, operator=operator,
+                    udf=fname, dp=dp,
+                ))
+
+    # PWT403 — order-sensitive / opaque custom reducers under a sharded
+    # groupby: per-shard partials have no shared order to merge by
+    if dp > 1:
+        for table, op in view.anchored_by_kind.get("reduce", ()):
+            if op.synthetic:
+                continue
+            for rexpr in op.exprs.get("reducers", ()):
+                red = getattr(rexpr, "_reducer", None)
+                rname = getattr(red, "name", None)
+                if not rname:
+                    continue
+                if rname in _ORDER_SENSITIVE_REDUCERS:
+                    detail = (
+                        "its result depends on cross-shard arrival order"
+                    )
+                elif rname.startswith(("udf_", "stateful_")):
+                    detail = (
+                        "custom accumulators carry no mergeable partial "
+                        "state across shards"
+                    )
+                else:
+                    continue
+                result.add(make_diag(
+                    "PWT403",
+                    f"reducer {rname!r} cannot shard over dp={dp}: "
+                    + detail
+                    + "; keep the groupby on one shard or use an "
+                    "associative built-in",
+                    trace=_trace_or_none(table),
+                    operator=view.op_label(table),
+                    reducer=rname, dp=dp,
+                ))
+
+    # PWT404 — exchange shard codes vs device axes: the exchange layer
+    # routes by ref_scalar hash over `workers` (engine/value.py
+    # SHARD_BITS), so when the worker count does not tile the dp axis,
+    # rows land on devices that do not own the corresponding model shard
+    if dp > 1 and workers % dp != 0:
+        n_exchange = sum(
+            len(view.anchored_by_kind.get(k, ()))
+            for k in sorted(_EXCHANGE_KINDS)
+        )
+        if n_exchange:
+            result.add(make_diag(
+                "PWT404",
+                f"{n_exchange} exchange-crossing op(s) route rows over "
+                f"{workers} worker(s), which does not tile the dp={dp} "
+                "device axis: shard codes and device placement disagree, "
+                "so every mismatched row pays a cross-device hop; run "
+                "with workers as a multiple of dp",
+                operator="exchange/mesh",
+                exchange_ops=n_exchange, workers=workers, dp=dp,
+            ))
+
+    # PWT405 — single-worker-pinned sources starve a multi-device mesh:
+    # exclusive connectors (pw.io.python.read) ingest on one worker only.
+    # parse_graph.pending_sources sees descriptors before build-time
+    # registration; only sink-anchored ones matter (dead sources are
+    # PWT110's business).
+    if mesh.devices() > 1:
+        # same union as parse_graph.pending_sources, but over the view's
+        # already-collected descriptor tables (no weakref re-walk):
+        # registered sources first, then connector tables' descriptors
+        tables_by_source: Dict[int, Any] = {}
+        pending: List[Any] = list(view.graph.sources)
+        seen_src: Set[int] = {id(s) for s in pending}
+        for live, t in view.live_source_tables:
+            tables_by_source[id(live)] = t
+            if id(live) not in seen_src:
+                seen_src.add(id(live))
+                pending.append(live)
+        for live in pending:
+            if not getattr(live, "exclusive", False):
+                continue
+            table = tables_by_source.get(id(live))
+            if table is None or not view.is_anchored(table):
+                continue
+            sname = getattr(live, "name", None) or type(live).__name__
+            result.add(make_diag(
+                "PWT405",
+                f"source {sname!r} is pinned to a single worker but the "
+                f"mesh has {mesh.devices()} devices ({mesh.describe()}): "
+                "ingest serializes on one device while the rest idle; "
+                "use a partitioned connector or shard the input upstream",
+                trace=_trace_or_none(table),
+                operator=view.op_label(table),
+                source=sname, devices=mesh.devices(),
+            ))
+
+
+# ---------------------------------------------------------------------------
+# Fusion plan verification (PWT599)
+# ---------------------------------------------------------------------------
+
+def verify_fusion(engine: Any, result: AnalysisResult) -> None:
+    """Compare the FusionPlan the build consumed (engine.fusion_plan,
+    installed by internals/runner.py before any node was built) against
+    the fused nodes it actually instantiated (engine.fused_chains).
+    Chains are identified by their op_id tuples, so a dropped chain, a
+    phantom fused node, or a stage-count mismatch each become a hard
+    PWT599 — the fusion twin of PWT399."""
+    plan = getattr(engine, "fusion_plan", None)
+    if not plan or not plan.get("enabled"):
+        return  # fusion off at build time: nothing was promised
+    planned: Dict[tuple, Dict[str, Any]] = {
+        tuple(c["op_ids"]): c for c in plan.get("chains", ())
+    }
+    built: Dict[tuple, Any] = {
+        tuple(getattr(n, "op_ids", ())): n
+        for n in getattr(engine, "fused_chains", ())
+    }
+    for key in sorted(set(planned) | set(built)):
+        c = planned.get(key)
+        node = built.get(key)
+        if c is not None and node is None:
+            result.add(make_diag(
+                "PWT599",
+                f"planned fused chain of {c['length']} ops "
+                f"({' -> '.join(c['kinds'])}) was not built as a fused "
+                "node — the fusion planner and the build have drifted; "
+                "please report this",
+                operator=f"fused_chain#{c['id']}",
+                chain=c["id"], planned=c["length"], built=0,
+            ))
+        elif c is None and node is not None:
+            result.add(make_diag(
+                "PWT599",
+                f"a fused node over {len(node.op_ids)} ops was built "
+                "without a matching planned chain — the fusion planner "
+                "and the build have drifted; please report this",
+                operator="fused_chain#" + "-".join(
+                    str(i) for i in node.op_ids
+                ),
+                planned=0, built=len(node.op_ids),
+            ))
+        elif len(node.stages) != c["length"]:
+            result.add(make_diag(
+                "PWT599",
+                f"fused chain {c['id']} was planned with {c['length']} "
+                f"stages but built with {len(node.stages)} — the fusion "
+                "planner and the build have drifted; please report this",
+                operator=f"fused_chain#{c['id']}",
+                chain=c["id"], planned=c["length"],
+                built=len(node.stages),
             ))

@@ -18,7 +18,7 @@ from typing import List, Optional
 from pathway_tpu.analysis import AnalysisResult, Severity, analyze
 
 
-def analyze_script(path: str) -> AnalysisResult:
+def analyze_script(path: str, *, mesh=None) -> AnalysisResult:
     """Execute `path` with pw.run patched out, then analyze the graph it
     registered on the global parse graph."""
     from pathway_tpu.internals import runner
@@ -45,13 +45,22 @@ def analyze_script(path: str) -> AnalysisResult:
     finally:
         runner.run, runner.run_all = real_run, real_run_all
         pw.run, pw.run_all = pw_run, pw_run_all
-    return analyze(G)
+    return analyze(G, mesh=mesh)
 
 
 def main_analyze(args) -> int:
     """Entry point for the cli.py `analyze` subcommand."""
+    mesh = getattr(args, "mesh", None)
+    if mesh is not None:
+        from pathway_tpu.analysis.mesh import MeshSpec
+
+        try:
+            mesh = MeshSpec.parse(mesh)
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
     try:
-        result = analyze_script(args.script)
+        result = analyze_script(args.script, mesh=mesh)
     except SystemExit as exc:  # script called sys.exit()
         code = exc.code if isinstance(exc.code, int) else 1
         if code != 0:
@@ -63,14 +72,43 @@ def main_analyze(args) -> int:
             return 2
         from pathway_tpu.internals.parse_graph import G
 
-        result = analyze(G)
+        result = analyze(G, mesh=mesh)
     except Exception as exc:  # noqa: BLE001 — report, don't traceback
         print(f"error: failed to load {args.script}: {exc}", file=sys.stderr)
         return 2
 
+    baseline_info = None
+    if getattr(args, "baseline", None):
+        from pathway_tpu.analysis.baseline import apply_baseline
+
+        try:
+            baseline_info = apply_baseline(result, args.baseline)
+        except (OSError, ValueError, KeyError) as exc:
+            print(
+                f"error: unusable baseline {args.baseline}: {exc}",
+                file=sys.stderr,
+            )
+            return 2
+        if baseline_info["created"]:
+            print(
+                f"baseline written: {baseline_info['suppressed']} "
+                f"finding(s) -> {args.baseline}",
+                file=sys.stderr,
+            )
+
     if args.json:
-        print(json.dumps(result.to_dict(), indent=2, sort_keys=True))
+        payload = result.to_dict()
+        if baseline_info is not None:
+            payload["baseline"] = baseline_info
+        print(json.dumps(payload, indent=2, sort_keys=True))
     else:
+        if baseline_info is not None and baseline_info["suppressed"]:
+            print(
+                f"baseline {args.baseline}: "
+                f"{baseline_info['suppressed']} known finding(s) "
+                "suppressed",
+                file=sys.stderr,
+            )
         print(result.render_text())
 
     threshold: Optional[Severity] = None

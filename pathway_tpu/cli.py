@@ -108,6 +108,20 @@ def main(argv=None) -> int:
         default=None,
         help="exit 1 when a finding at or above this severity exists",
     )
+    analyze.add_argument(
+        "--mesh",
+        default=None,
+        metavar="AXES",
+        help="also run the PWT4xx mesh-compatibility pass against this "
+        "device mesh, e.g. dp=4,tp=2",
+    )
+    analyze.add_argument(
+        "--baseline",
+        default=None,
+        metavar="FILE",
+        help="suppress findings recorded in FILE (created from the "
+        "current findings when missing); --fail-on sees only new ones",
+    )
     analyze.set_defaults(func=_analyze)
 
     trace = sub.add_parser(

@@ -207,6 +207,13 @@ class Engine:
         # static-analysis result dict, attached by pw.run(analysis=...)
         # and served by the /status endpoint
         self.analysis: dict | None = None
+        # fusion contract (analysis/fusion.py): the serialized FusionPlan
+        # the build consumed, and the FusedChainNodes it actually built —
+        # verify_fusion (PWT599) and /status's `fusion` key audit the two
+        self.fusion_plan: dict | None = None
+        self.fused_chains: List[Node] = []
+        # declared device mesh from pw.run(mesh=...), for observability
+        self.mesh: dict | None = None
         self.error_log: List[ErrorLogEntry] = []
         self.error_log_nodes: List["ErrorLogNode"] = []
         self._scheduled_times: set[int] = set()

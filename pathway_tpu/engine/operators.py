@@ -1330,3 +1330,71 @@ class WindowFunctionNode(Node):
             finish(None if fname != "count" and cum_cnt[j] == 0 else run[j])
             for j in (frame_end[i] for i in range(n))
         ]
+
+
+class FusedChainNode(Node):
+    """A planned select/filter chain collapsed into one operator
+    (analysis/fusion.py FusionPlan; built by internals/table.py
+    build_fused_chain when RunContext.node hits a chain tail).
+
+    Classic builds materialize every stage: each RowwiseNode/FilterNode
+    pays its own take/consolidate/emit/receive round-trip per batch.
+    Here the batch flows through all stages inside one process() call and
+    consolidates exactly once at the end — legal because every stage is
+    an elementwise deterministic map or filter, and consolidation
+    commutes with per-row deterministic transforms (the net diff of a
+    mapped batch equals the map of the net diff).  No stage keeps state,
+    so there is nothing to snapshot and multi-worker sharding is
+    unaffected (select/filter are shard-stable).
+
+    `path`/`rows_processed` follow the columnar-node observability
+    convention (monitoring.node_path_stats), so tests and /status can
+    prove the fused implementation actually ran.
+    """
+
+    name = "fused_chain"
+    path = "fused"
+
+    def __init__(
+        self,
+        engine: Engine,
+        input_: Node,
+        stages: List[Tuple[str, Any]],
+        *,
+        op_ids: Tuple[int, ...] = (),
+        kinds: Tuple[str, ...] = (),
+    ):
+        super().__init__(engine, [input_])
+        # [("map", fn(keys, values) -> values) | ("filter", pred_fn)]
+        self.stages = stages
+        self.op_ids = tuple(op_ids)
+        self.kinds = tuple(kinds)
+
+    def process(self, time: int) -> None:
+        deltas = self.take(0)
+        if not deltas:
+            return
+        self.rows_processed += len(deltas)
+        self.batches_processed += 1
+        keys = [d[0] for d in deltas]
+        values = [d[1] for d in deltas]
+        diffs = [d[2] for d in deltas]
+        for kind, fn in self.stages:
+            if not keys:
+                break
+            if kind == "filter":
+                mask = fn(keys, (values,))
+                nk: List[Any] = []
+                nv: List[tuple] = []
+                nd: List[int] = []
+                for i, keep in enumerate(mask):
+                    if isinstance(keep, Error):
+                        self.log_error("Error value in filter condition")
+                    elif keep:
+                        nk.append(keys[i])
+                        nv.append(values[i])
+                        nd.append(diffs[i])
+                keys, values, diffs = nk, nv, nd
+            else:
+                values = fn(keys, values)
+        self.emit(time, list(zip(keys, values, diffs)))

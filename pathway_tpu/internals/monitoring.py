@@ -69,6 +69,46 @@ def node_path_stats(engine) -> list[Dict[str, Any]]:
     return out
 
 
+def fusion_status(engine) -> Dict[str, Any] | None:
+    """The fusion contract as /status reports it: per planned chain, how
+    many ops it covers and whether (and how hard) the fused node actually
+    ran.  None when no plan was installed (fusion disabled or a raw
+    engine); `nodes_saved` is the headline — engine nodes that never
+    existed because chains collapsed."""
+    plan = getattr(engine, "fusion_plan", None)
+    if plan is None:
+        return None
+    built = {
+        tuple(getattr(n, "op_ids", ())): n
+        for n in getattr(engine, "fused_chains", ())
+    }
+    chains = []
+    saved = 0
+    for c in plan.get("chains", ()):
+        node = built.get(tuple(c["op_ids"]))
+        if node is not None:
+            saved += c["length"] - 1
+        chains.append(
+            {
+                "id": c["id"],
+                "ops": c["length"],
+                "kinds": list(c["kinds"]),
+                "built": node is not None,
+                "rows_processed": (
+                    node.rows_processed if node is not None else 0
+                ),
+                "batches_processed": (
+                    node.batches_processed if node is not None else 0
+                ),
+            }
+        )
+    return {
+        "enabled": bool(plan.get("enabled")),
+        "chains": chains,
+        "nodes_saved": saved,
+    }
+
+
 class StatsMonitor:
     """Console dashboard over engine stats (reference: monitoring.py
     StatsMonitor:186 — rich Live table)."""
@@ -361,6 +401,9 @@ class PrometheusServer:
             # findings from pw.run(analysis=...): deployed graphs report
             # their own lint state (None when analysis was off)
             "analysis": getattr(e0, "analysis", None),
+            # fusion contract: planned chains vs built fused nodes with
+            # per-chain op counts (None when fusion was disabled)
+            "fusion": fusion_status(e0),
         }
 
     def _merged_freshness(self) -> list:

@@ -115,6 +115,27 @@ class ParseGraph:
     def live_tables(self) -> List[Any]:
         return [t for t in (r() for r in self.all_tables) if t is not None]
 
+    def pending_sources(self) -> List[Any]:
+        """Connector descriptors visible to the analyzer: build_streaming
+        registers LiveSources into `sources` only at build time, but
+        analysis runs before any build — connector tables carry their
+        descriptor as `_live_source` from DSL time, so the union (deduped
+        by identity, registration order first) is the pre-build view the
+        mesh pass (PWT405) lints."""
+        out: List[Any] = []
+        seen: set = set()
+        for src in self.sources:
+            if id(src) not in seen:
+                seen.add(id(src))
+                out.append(src)
+        for t in self.live_tables():
+            # vars() sidesteps Table.__getattr__'s column-lookup fallback
+            live = vars(t).get("_live_source")
+            if live is not None and id(live) not in seen:
+                seen.add(id(live))
+                out.append(live)
+        return out
+
     def clear(self) -> None:
         self.__init__()
 

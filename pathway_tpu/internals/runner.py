@@ -23,16 +23,45 @@ class RunContext:
         self._nodes: Dict[int, Any] = {}
         self._keepalive: List[Any] = []  # tables must outlive id() keys
         self.join_nodes: Dict[int, Any] = {}
+        # FusionPlan consumption (analysis/fusion.py): chain-tail table id
+        # -> FusionChain, installed by _install_fusion before any sink
+        # builds.  node() then builds the whole chain as ONE fused node.
+        self.fusion_by_tail: Optional[Dict[int, Any]] = None
 
     def node(self, table):
         n = self._nodes.get(id(table))
         if n is None:
-            n = table._build(self)
+            chain = None
+            if self.fusion_by_tail:
+                chain = self.fusion_by_tail.get(id(table))
+                if chain is not None and chain.skipped:
+                    chain = None
+            if chain is not None:
+                from pathway_tpu.internals.table import build_fused_chain
+
+                n = build_fused_chain(self, chain)
+            else:
+                n = table._build(self)
             if getattr(n, "trace", None) is None:
                 n.trace = getattr(table, "_trace", None)
             self._nodes[id(table)] = n
             self._keepalive.append(table)
         return n
+
+
+def _install_fusion(ctx: RunContext, extra_tables=()) -> None:
+    """Plan select/filter fusion over the current parse graph and hand
+    the plan to both sides of the contract: the RunContext (which builds
+    chain tails as fused nodes) and the engine (whose serialized copy is
+    what verify_fusion/PWT599 and the /status `fusion` key audit).  With
+    PATHWAY_DISABLE_FUSION set the plan is None and every op builds its
+    classic node."""
+    from pathway_tpu.analysis.fusion import plan_for_build
+
+    plan = plan_for_build(G, extra_tables=extra_tables)
+    ctx.fusion_by_tail = plan.by_tail() if plan is not None else None
+    ctx.engine.fusion_plan = plan.to_dict() if plan is not None else None
+    ctx.engine.fused_chains = []
 
 
 def _make_engine() -> Engine:
@@ -60,6 +89,7 @@ def run_tables(
     exactly once across the process group."""
     engine = engine or _make_engine()
     ctx = RunContext(engine)
+    _install_fusion(ctx, extra_tables=tables)
     captures = []
     for t in tables:
         node = ctx.node(t)
@@ -89,11 +119,16 @@ def last_engine():
     return _last_engine
 
 
-def _apply_analysis(engine: Engine, mode) -> None:
+def _apply_analysis(engine: Engine, mode, mesh=None, baseline=None) -> None:
     """Run the static analyzer over the registered sinks, verify its
-    columnar predictions against the freshly built plan, and attach the
-    result to the engine (the /status endpoint serves it).  "warn" logs
-    findings, "strict" refuses to run on warning-or-worse."""
+    columnar predictions and the fusion plan against the freshly built
+    nodes, and attach the result to the engine (the /status endpoint
+    serves it).  "warn" logs findings, "strict" refuses to run on
+    warning-or-worse.  A mesh spec turns analysis on (at least "warn")
+    and makes its PWT4xx ERROR findings fail fast regardless of mode —
+    that fail-fast is the whole point of pw.run(mesh=...)."""
+    if mesh is not None and (mode is None or mode == "off"):
+        mode = "warn"
     if mode is None or mode == "off":
         return
     if mode not in ("warn", "strict"):
@@ -107,13 +142,27 @@ def _apply_analysis(engine: Engine, mode) -> None:
         Severity,
         analyze,
         verify_against_plan,
+        verify_fusion,
     )
 
-    result = analyze(G, workers=engine.worker_count)
+    result = analyze(G, workers=engine.worker_count, mesh=mesh)
     verify_against_plan(engine, result)
+    verify_fusion(engine, result)
+    baseline_info = None
+    if baseline:
+        from pathway_tpu.analysis.baseline import apply_baseline
+
+        baseline_info = apply_baseline(result, baseline)
     engine.analysis = result.to_dict()
+    if baseline_info is not None:
+        engine.analysis["baseline"] = baseline_info
     if not result.findings:
         return
+    if mesh is not None and any(
+        f.code.startswith("PWT4") and f.severity >= Severity.ERROR
+        for f in result.findings
+    ):
+        raise AnalysisError(result)
     if mode == "strict" and result.max_severity() >= Severity.WARNING:
         raise AnalysisError(result)
     logging.getLogger("pathway_tpu").warning(
@@ -129,13 +178,26 @@ def run(
     persistence_config=None,
     autocommit_duration_ms: float | None = None,
     analysis=None,
+    analysis_baseline=None,
+    mesh=None,
     **kwargs,
 ) -> None:
     """pw.run — execute every registered sink (reference:
-    internals/run.py:11)."""
+    internals/run.py:11).
+
+    `mesh` ("dp=4,tp=2", mapping or MeshSpec) declares the device mesh
+    the run intends to shard over: the PWT4xx mesh-compatibility pass
+    runs before execution and its ERROR findings abort the run.
+    `analysis_baseline` names a findings snapshot (analysis/baseline.py)
+    so strict mode only trips on NEW findings."""
     global _last_engine
     from pathway_tpu.internals import faults, telemetry
     from pathway_tpu.internals.config import pathway_config as cfg
+
+    if mesh is not None:
+        from pathway_tpu.analysis.mesh import MeshSpec
+
+        mesh = MeshSpec.parse(mesh)
 
     # Arm the chaos harness once per run, before any worker starts
     # (per-worker arming would race and reset fire-once budgets).
@@ -149,6 +211,8 @@ def run(
             persistence_config=persistence_config,
             autocommit_duration_ms=autocommit_duration_ms,
             analysis=analysis,
+            analysis_baseline=analysis_baseline,
+            mesh=mesh,
             **kwargs,
         )
 
@@ -157,12 +221,14 @@ def run(
     telemetry.register_engine(engine)
     # static connector builds need it (object cache binding at build time)
     engine._persistence_config = persistence_config
+    engine.mesh = mesh.to_dict() if mesh is not None else None
     ctx = RunContext(engine)
     with telemetry.span("graph_runner.build"):
+        _install_fusion(ctx)
         for sink in G.sinks:
             nodes = [ctx.node(t) for t in sink.tables]
             sink.attach(ctx, nodes)
-    _apply_analysis(engine, analysis)
+    _apply_analysis(engine, analysis, mesh=mesh, baseline=analysis_baseline)
     _attach_monitoring(engine)
     monitor = _maybe_start_dashboard(engine, monitoring_level)
     http_server = None
@@ -202,6 +268,8 @@ def _run_threaded(
     persistence_config=None,
     autocommit_duration_ms: float | None = None,
     analysis=None,
+    analysis_baseline=None,
+    mesh=None,
     **kwargs,
 ) -> None:
     """workers = threads x processes (reference:
@@ -233,6 +301,7 @@ def _run_threaded(
         try:
             engine = Engine(coord=group.facade(thread_index))
             engine._persistence_config = persistence_config
+            engine.mesh = mesh.to_dict() if mesh is not None else None
             if thread_index == 0:
                 _last_engine = engine
                 from pathway_tpu.internals import telemetry as _tm
@@ -243,6 +312,9 @@ def _run_threaded(
             # the concurrent part
             with build_lock:
                 ctx = RunContext(engine)
+                # the planner is deterministic over the shared parse
+                # graph, so every worker derives the identical chain set
+                _install_fusion(ctx)
                 for sink in G.sinks:
                     nodes = [ctx.node(t) for t in sink.tables]
                     sink.attach(ctx, nodes)
@@ -251,7 +323,10 @@ def _run_threaded(
                 # still building from, and strict mode must raise before
                 # any worker starts executing
                 if thread_index == 0:
-                    _apply_analysis(engine, analysis)
+                    _apply_analysis(
+                        engine, analysis, mesh=mesh,
+                        baseline=analysis_baseline,
+                    )
             _attach_monitoring(engine)
             monitor = None
             http_server = None

@@ -1850,6 +1850,82 @@ def _expr_deterministic(expr: ColumnExpression) -> bool:
     return True
 
 
+def _fused_map_stage(progs, n_cols: int, projection):
+    """One select stage of a fused chain: values -> values, mirroring
+    RowwiseNode's batch/projection fast paths (same programs, same
+    itemgetter shortcut) so fused and classic outputs cannot differ."""
+    if projection is not None:
+        if len(projection) == 1:
+            idx = projection[0]
+            return lambda keys, values, _i=idx: [(v[_i],) for v in values]
+        import operator as _op
+
+        getter = _op.itemgetter(*projection)
+        return lambda keys, values, _g=getter: [_g(v) for v in values]
+
+    def run(keys, values):
+        if n_cols == 0:
+            return [()] * len(keys)
+        columns = [p(keys, (values,)) for p in progs]
+        return list(zip(*columns))
+
+    return run
+
+
+def build_fused_chain(ctx, chain):
+    """Compile a planned FusionChain (analysis/fusion.py) into ONE
+    FusedChainNode.  Each stage's expressions compile against that
+    stage's own input table — exactly the resolver the classic per-op
+    build would have used — so the only difference from the classic path
+    is the number of engine nodes, never the computed rows."""
+    from pathway_tpu.engine.expression_eval import EvalContext, compile_batch
+    from pathway_tpu.engine.operators import FusedChainNode
+
+    head = chain.tables[0]
+    prev = head._op.inputs[0]
+    input_node = ctx.node(prev)
+    stages = []
+    for t in chain.tables:
+        op = t._op
+        if op.kind == "filter":
+            stages.append(("filter", _compile_on(ctx, [prev], op.exprs["expr"])))
+        else:
+            cols = op.exprs["cols"]
+            ectx = EvalContext(make_resolver([prev]))
+            ectx.error_logger = ctx.engine.log_error
+            progs = [compile_batch(e, ectx) for e in cols.values()]
+            projection = None
+            if progs:
+                idxs = []
+                for e in cols.values():
+                    if type(e) is ColumnReference and not isinstance(
+                        e, IdReference
+                    ):
+                        loc = ectx.resolve(e)
+                        if loc is not None and loc != ("id",) and loc[0] == 0:
+                            idxs.append(loc[1])
+                            continue
+                    idxs = None
+                    break
+                if idxs is not None:
+                    projection = tuple(idxs)
+            stages.append(
+                ("map", _fused_map_stage(progs, len(progs), projection))
+            )
+        prev = t
+    node = FusedChainNode(
+        ctx.engine,
+        input_node,
+        stages,
+        op_ids=chain.op_ids,
+        kinds=chain.kinds,
+    )
+    fused = getattr(ctx.engine, "fused_chains", None)
+    if fused is not None:
+        fused.append(node)
+    return node
+
+
 def _semijoin(
     table: Table,
     other: Table,

@@ -86,12 +86,14 @@ class SentenceTransformerEmbedder(BaseEmbedder):
 
         embed_batch.submit_batch = submit_batch
         embed_batch.await_batch = await_batch
-        # static-analyzer marker (analysis PWT401): enough shape facts to
-        # predict the classic path's padding waste without building a model
+        # static-analyzer marker (analysis PWT401/PWT402): enough shape
+        # facts to predict the classic path's padding waste and check
+        # mesh-axis divisibility without building a model
         embed_batch._pw_embedder = {
             "model": model,
             "max_batch_size": max_batch_size,
             "max_len": self.encoder.max_len,
+            "dimension": self.encoder.dimension,
         }
         self.func = embed_batch
 

@@ -1,0 +1,36 @@
+"""Regenerate the golden analyzer diagnostic matrix.
+
+Run from the repo root:
+
+    python -m tests.regen_golden
+
+Rewrites tests/golden/analysis_matrix.json from the current lint-bait
+graph (test_analysis.build_lintful_graph) with the current
+SCHEMA_VERSION stamp.  Use after an intentional message or severity
+change, then review the diff — the golden file is the contract that
+diagnostic text is stable.
+
+`tests/` is deliberately NOT a package (several tests import siblings
+bare, relying on pytest's rootdir sys.path insertion), so this module
+mirrors that: it puts its own directory on sys.path and imports
+test_analysis the same way pytest does.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    import test_analysis
+
+    path = test_analysis.write_golden()
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

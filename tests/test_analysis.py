@@ -17,6 +17,7 @@ import pytest
 import pathway_tpu as pw
 from pathway_tpu.analysis import (
     CODES,
+    SCHEMA_VERSION,
     AnalysisError,
     AnalysisResult,
     Diagnostic,
@@ -97,12 +98,48 @@ def build_lintful_graph():
 
     tiny_embed._pw_embedder = {
         "model": "tiny", "max_batch_size": 3, "max_len": 256,
+        # PWT402 bait under --mesh dp=3,tp=5: 384 % 5 != 0 and dp=3 is
+        # not a power of two, so both mesh-shape lints fire here
+        "dimension": 384,
     }
     emb = t.select(name=t.name, e=pw.apply_with_type(tiny_embed, str, t.name))
 
+    # PWT403 (custom branch): stateful accumulators carry no mergeable
+    # partial state across dp shards
+    stateful = t.groupby(t.name).reduce(
+        t.name,
+        m=pw.reducers.stateful_single(lambda s, v: max(s or 0, v))(t.age),
+    )
+
+    # PWT405: exclusive connector (single-worker ingest) on a >1-device
+    # mesh.  Analysis never builds, so the subject never runs.
+    class _NullSubject(pw.io.python.ConnectorSubject):
+        def run(self):
+            pass
+
+    pinned = pw.io.python.read(
+        _NullSubject(),
+        schema=pw.schema_from_types(x=int),
+        name="pinned_src",
+    )
+    pinned_sel = pinned.select(x=pinned.x)
+
+    # PWT501+PWT503: a two-op chain whose tail fans out to two readers
+    s1 = t.select(name=t.name, v=t.age + 1)
+    s2 = s1.select(name=s1.name, v=s1.v * 2)
+    fan_a = s2.filter(s2.v > 0)
+    fan_b = s2.filter(s2.v < 100)
+    # PWT501+PWT502: a select->filter chain stopped by a keyed reduce
+    c1 = t.select(name=t.name, v=t.age * 3)
+    c2 = c1.filter(c1.v > 0)
+    chain_red = c2.groupby(c2.name).reduce(
+        c2.name, s=pw.reducers.sum(c2.v)
+    )
+
     _sink(
         lossy, bad_cmp, arith, by_float, tup, joined, nd_red, au_red,
-        win, it, narrow, emb,
+        win, it, narrow, emb, stateful, pinned_sel, fan_a, fan_b,
+        chain_red,
     )
     # PWT110: computed after the sinks, read by nobody.  Returned so the
     # caller keeps it alive — the parse graph tracks tables by weakref,
@@ -122,7 +159,9 @@ def _normalized(result):
 
 def _analyze_lintful():
     dead = build_lintful_graph()
-    result = analyze(G, workers=4)
+    # dp=3,tp=5 is deliberately hostile: 4 workers don't tile dp=3
+    # (PWT404), 384 % 5 != 0 and 3 is not a power of two (PWT402 x2)
+    result = analyze(G, workers=4, mesh="dp=3,tp=5")
     del dead
     return result
 
@@ -136,10 +175,10 @@ def test_golden_diagnostic_matrix():
     got = _normalized(_analyze_lintful())
     with open(GOLDEN) as fh:
         want = json.load(fh)
-    assert got == want, (
+    assert want["schema_version"] == SCHEMA_VERSION
+    assert got == want["findings"], (
         "diagnostics drifted from tests/golden/analysis_matrix.json; "
-        "if intentional, regenerate with `python tests/test_analysis.py "
-        "--regen`"
+        "if intentional, regenerate with `python -m tests.regen_golden`"
     )
 
 
@@ -147,6 +186,20 @@ def test_matrix_covers_enough_codes():
     codes = {f.code for f in _analyze_lintful().findings}
     assert len(codes) >= 8, codes
     assert codes <= set(CODES)
+    # the mesh and fusion passes each contribute their full code family
+    assert {
+        "PWT402", "PWT403", "PWT404", "PWT405",
+        "PWT501", "PWT502", "PWT503", "PWT504",
+    } <= codes, codes
+
+
+def test_findings_are_deterministically_ordered():
+    a = [f.to_dict() for f in _analyze_lintful().sorted_findings()]
+    G.clear()
+    b = [f.to_dict() for f in _analyze_lintful().sorted_findings()]
+    assert a == b
+    codes = [f["code"] for f in a]
+    assert codes == sorted(codes)
 
 
 def test_every_finding_has_a_location():
@@ -169,9 +222,12 @@ def test_json_round_trip():
     blob = json.dumps(d, sort_keys=True)
     back = AnalysisResult.from_dict(json.loads(blob))
     assert back.to_dict() == d
-    assert d["version"] == 1
+    assert d["schema_version"] == SCHEMA_VERSION
     assert d["summary"] == result.counts()
     assert len(d["predictions"]) == len(result.predictions)
+    # the fusion plan rides along and survives the round trip
+    assert d["fusion"]["enabled"] is True
+    assert any(c["length"] >= 2 for c in d["fusion"]["chains"])
 
 
 def test_severity_model():
@@ -230,7 +286,14 @@ def test_clean_graphs_have_zero_findings():
     tables = list(_clean_topologies())
     _sink(*tables)
     result = analyze(G, workers=4)
-    assert result.findings == [], result.render_text()
+    # informational fusion-chain notes (PWT501/502/503) are expected on
+    # well-formed pipelines — they describe the build plan, not defects
+    findings = [
+        f
+        for f in result.findings
+        if f.code not in ("PWT501", "PWT502", "PWT503")
+    ]
+    assert findings == [], result.render_text()
     # the eligible ops all predict columnar
     predicted = {(p["op"], p["predicted"]) for p in result.predictions}
     assert ("join", "columnar") in predicted
@@ -456,7 +519,7 @@ def test_cli_analyze_json(tmp_path, capsys):
     script = _write_script(tmp_path, _LINTY_SCRIPT)
     assert main(["analyze", script, "--json"]) == 0
     payload = json.loads(capsys.readouterr().out)
-    assert payload["version"] == 1
+    assert payload["schema_version"] == SCHEMA_VERSION
     assert any(f["code"] == "PWT202" for f in payload["findings"])
     # and the run() call was intercepted: nothing executed, graph intact
     assert payload["predictions"]
@@ -470,13 +533,25 @@ def test_cli_analyze_broken_script(tmp_path, capsys):
     assert "boom" in capsys.readouterr().err
 
 
+def write_golden():
+    """Regenerate tests/golden/analysis_matrix.json — shared by the
+    legacy `python tests/test_analysis.py --regen` entry point and
+    `python -m tests.regen_golden`."""
+    G.clear()
+    os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+    payload = {
+        "schema_version": SCHEMA_VERSION,
+        "findings": _normalized(_analyze_lintful()),
+    }
+    with open(GOLDEN, "w") as fh:
+        json.dump(payload, fh, indent=2)
+        fh.write("\n")
+    G.clear()
+    return GOLDEN
+
+
 if __name__ == "__main__":
     import sys
 
     if "--regen" in sys.argv:
-        G.clear()
-        os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
-        with open(GOLDEN, "w") as fh:
-            json.dump(_normalized(_analyze_lintful()), fh, indent=2)
-            fh.write("\n")
-        print(f"wrote {GOLDEN}")
+        print(f"wrote {write_golden()}")

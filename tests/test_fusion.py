@@ -1,0 +1,404 @@
+"""Chain fusion (analysis/fusion.py + engine FusedChainNode) and the
+mesh/baseline run surfaces.
+
+The contract under test: the planner's FusionPlan is consumed by the
+build (RunContext.node collapses each planned chain into ONE
+FusedChainNode), `PATHWAY_DISABLE_FUSION=1` restores the classic
+one-node-per-op build with identical results, and PWT599 fires whenever
+the installed plan and the built nodes disagree (forced here via
+PATHWAY_FUSION_FORCE_SKIP).
+"""
+
+import json
+import random
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.analysis import (
+    SCHEMA_VERSION,
+    AnalysisError,
+    MeshSpec,
+    analyze,
+)
+from pathway_tpu.analysis.fusion import plan_for_build, plan_fusion
+from pathway_tpu.analysis.graph import GraphView
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.runner import last_engine, run_tables
+
+
+def _sink(*tables):
+    for t in tables:
+        pw.io.subscribe(t, on_change=lambda *a, **k: None)
+
+
+def _chain_tail():
+    """select -> filter -> select over a tiny table: one maximal chain."""
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int),
+        [("a", 3), ("b", -1), ("c", 5)],
+    )
+    s1 = t.select(k=t.k, v=t.v * 2)
+    s2 = s1.filter(s1.v > 0)
+    return s2.select(v=s2.v, k=s2.k)
+
+
+# ---------------------------------------------------------------------------
+# fused build: one node per planned chain, classic behind the env lever
+# ---------------------------------------------------------------------------
+
+
+def test_fused_chain_builds_one_node(monkeypatch):
+    monkeypatch.delenv("PATHWAY_DISABLE_FUSION", raising=False)
+    (cap,) = run_tables(_chain_tail(), record_stream=True)
+    eng = cap.engine
+    fused = [n for n in eng.nodes if type(n).__name__ == "FusedChainNode"]
+    assert len(fused) == 1
+    assert len(fused[0].stages) == 3
+    assert fused[0].kinds == ("select", "filter", "select")
+    # the classic per-op nodes are gone
+    assert not [
+        n
+        for n in eng.nodes
+        if type(n).__name__ in ("RowwiseNode", "FilterNode")
+    ]
+    assert sorted(cap.state.rows.values()) == [(6, "a"), (10, "c")]
+    # the fused node is visible to monitoring under its own path
+    from pathway_tpu.internals.monitoring import (
+        fusion_status,
+        node_path_stats,
+    )
+
+    assert any(
+        s["path"] == "fused" and s["rows_processed"] >= 3
+        for s in node_path_stats(eng)
+    )
+    status = fusion_status(eng)
+    assert status["enabled"] and status["nodes_saved"] == 2
+    (chain,) = status["chains"]
+    assert chain["built"] and chain["rows_processed"] >= 3
+
+
+def test_disable_fusion_restores_classic_build(monkeypatch):
+    monkeypatch.setenv("PATHWAY_DISABLE_FUSION", "1")
+    (cap,) = run_tables(_chain_tail(), record_stream=True)
+    names = [type(n).__name__ for n in cap.engine.nodes]
+    assert "FusedChainNode" not in names
+    assert "RowwiseNode" in names and "FilterNode" in names
+    assert cap.engine.fusion_plan is None
+    assert sorted(cap.state.rows.values()) == [(6, "a"), (10, "c")]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fused_vs_classic_parity_randomized(seed, monkeypatch):
+    """Random select/filter chains over random data: the fused build and
+    the classic build must agree on keys AND values, exactly."""
+    rng = random.Random(seed)
+    rows = [
+        (f"k{rng.randrange(6)}", rng.randrange(-50, 50))
+        for _ in range(rng.randrange(10, 40))
+    ]
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int), rows
+    )
+    cur = t
+    for _ in range(rng.randrange(2, 6)):
+        if rng.random() < 0.5:
+            mul, add = rng.randrange(1, 4), rng.randrange(-3, 4)
+            cur = cur.select(k=cur.k, v=cur.v * mul + add)
+        else:
+            cur = cur.filter(cur.v > rng.randrange(-60, 60))
+
+    monkeypatch.setenv("PATHWAY_DISABLE_FUSION", "1")
+    (classic,) = run_tables(cur, record_stream=True)
+    monkeypatch.setenv("PATHWAY_DISABLE_FUSION", "0")
+    (fused,) = run_tables(cur, record_stream=True)
+    assert fused.engine.fused_chains, "chain was not fused"
+    assert classic.state.rows == fused.state.rows
+
+
+# ---------------------------------------------------------------------------
+# the plan contract: PWT599 parity and forced drift
+# ---------------------------------------------------------------------------
+
+
+def test_run_verifies_fusion_plan_clean(monkeypatch):
+    monkeypatch.delenv("PATHWAY_DISABLE_FUSION", raising=False)
+    monkeypatch.delenv("PATHWAY_FUSION_FORCE_SKIP", raising=False)
+    got = []
+    pw.io.subscribe(
+        _chain_tail(), on_change=lambda key, row, time, is_addition: got.append(row)
+    )
+    pw.run(analysis="warn")
+    eng = last_engine()
+    assert len(got) == 2
+    codes = [f["code"] for f in eng.analysis["findings"]]
+    assert "PWT501" in codes and "PWT599" not in codes
+    assert eng.analysis["fusion"]["enabled"] is True
+
+
+def test_forced_skip_trips_pwt599(monkeypatch):
+    """PATHWAY_FUSION_FORCE_SKIP drops the chain at build time while the
+    installed plan still claims it — the verifier must notice."""
+    monkeypatch.delenv("PATHWAY_DISABLE_FUSION", raising=False)
+    monkeypatch.setenv("PATHWAY_FUSION_FORCE_SKIP", "all")
+    _sink(_chain_tail())
+    pw.run(analysis="warn")
+    eng = last_engine()
+    drift = [f for f in eng.analysis["findings"] if f["code"] == "PWT599"]
+    assert drift and all(f["severity"] == "error" for f in drift)
+    assert not eng.fused_chains
+    from pathway_tpu.internals.monitoring import fusion_status
+
+    status = fusion_status(eng)
+    assert status["nodes_saved"] == 0
+    assert not status["chains"][0]["built"]
+
+
+def test_plan_for_build_levers(monkeypatch):
+    tail = _chain_tail()
+    monkeypatch.setenv("PATHWAY_FUSION_FORCE_SKIP", "all")
+    plan = plan_for_build(G, extra_tables=(tail,))
+    assert plan.chains and all(c.skipped for c in plan.chains)
+    # a skipped chain stays in the serialized claim
+    assert plan.to_dict()["chains"]
+    monkeypatch.setenv("PATHWAY_DISABLE_FUSION", "1")
+    assert plan_for_build(G, extra_tables=(tail,)) is None
+
+
+def test_fusion_plan_json_round_trip():
+    tail = _chain_tail()
+    plan = plan_fusion(GraphView(G, extra_tables=(tail,)))
+    d = json.loads(json.dumps(plan.to_dict()))
+    (chain,) = d["chains"]
+    assert chain["kinds"] == ["select", "filter", "select"]
+    assert chain["length"] == 3
+    assert chain["break"]["reason"] == "end"
+    assert chain["id"] == "-".join(str(i) for i in chain["op_ids"])
+
+
+# ---------------------------------------------------------------------------
+# mesh spec + pw.run(mesh=...)
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_spec_parse():
+    m = MeshSpec.parse("dp=4,tp=2")
+    assert m.dp == 4 and m.tp == 2 and m.devices() == 8
+    assert m.describe() == "dp=4,tp=2"
+    assert m.axis("ep") == 1
+    assert MeshSpec.parse(m) is m
+    assert MeshSpec.parse({"dp": 2}).dp == 2
+    assert MeshSpec.parse("dp=1").devices() == 1
+    for bad in ("dp", "dp=x", "dp=0", "", 7):
+        with pytest.raises(ValueError):
+            MeshSpec.parse(bad)
+
+
+def _marked_embedder(dimension=384):
+    def embed(s: str) -> str:
+        return s
+
+    embed._pw_embedder = {
+        "model": "m", "max_batch_size": 8, "max_len": 16,
+        "dimension": dimension,
+    }
+    return embed
+
+
+def test_mesh_pass_codes():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int), [("a", 1), ("b", 2)]
+    )
+    emb = t.select(e=pw.apply_with_type(_marked_embedder(), str, t.k))
+    red = t.groupby(t.k).reduce(t.k, xs=pw.reducers.tuple(t.v))
+    _sink(emb, red)
+    # hostile mesh: tp=5 does not divide 384, dp=3 is not a power of two,
+    # 2 workers do not tile dp=3
+    result = analyze(G, workers=2, mesh="dp=3,tp=5")
+    codes = sorted({f.code for f in result.findings if f.code.startswith("PWT4")})
+    assert codes == ["PWT402", "PWT403", "PWT404"]
+    # compatible mesh: all mesh lints go quiet (dp=2 divides 2 workers,
+    # tp=4 divides 384) except the order-sensitive reducer under dp>1
+    G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int), [("a", 1)]
+    )
+    emb = t.select(e=pw.apply_with_type(_marked_embedder(), str, t.k))
+    _sink(emb)
+    result = analyze(G, workers=2, mesh="dp=2,tp=4")
+    assert not [f for f in result.findings if f.code.startswith("PWT4")]
+
+
+def test_run_mesh_error_fails_fast():
+    t = pw.debug.table_from_rows(pw.schema_from_types(k=str), [("a",)])
+    emb = t.select(e=pw.apply_with_type(_marked_embedder(), str, t.k))
+    _sink(emb)
+    with pytest.raises(AnalysisError) as exc:
+        pw.run(mesh="dp=1,tp=5")
+    assert any(f.code == "PWT402" for f in exc.value.result.findings)
+
+
+def test_run_mesh_compatible_executes():
+    t = pw.debug.table_from_rows(pw.schema_from_types(k=str), [("a",)])
+    rows = []
+    pw.io.subscribe(
+        t.select(k=t.k),
+        on_change=lambda key, row, time, is_addition: rows.append(row),
+    )
+    pw.run(mesh="dp=1,tp=4")
+    assert rows == [{"k": "a"}]
+    assert last_engine().mesh == {"dp": 1, "tp": 4}
+
+
+def test_run_bad_mesh_rejected_before_build():
+    t = pw.debug.table_from_rows(pw.schema_from_types(k=str), [("a",)])
+    _sink(t.select(k=t.k))
+    with pytest.raises(ValueError):
+        pw.run(mesh="dp=zero")
+
+
+# ---------------------------------------------------------------------------
+# baselines: pw.run(analysis_baseline=...) and the CLI flag
+# ---------------------------------------------------------------------------
+
+
+def _graph_with_warning():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(g=float, v=int), [(0.5, 1), (0.5, 2)]
+    )
+    _sink(t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.v)))
+
+
+def test_run_analysis_baseline_snapshot_then_suppress(tmp_path):
+    bl = str(tmp_path / "baseline.json")
+    _graph_with_warning()
+    # first strict run writes the snapshot and passes (nothing is "new")
+    pw.run(analysis="strict", analysis_baseline=bl)
+    data = json.load(open(bl))
+    assert data["schema_version"] == SCHEMA_VERSION
+    assert any(f["code"] == "PWT202" for f in data["findings"])
+    # second run: the known finding is suppressed, strict still passes
+    G.clear()
+    _graph_with_warning()
+    pw.run(analysis="strict", analysis_baseline=bl)
+    eng = last_engine()
+    assert eng.analysis["baseline"]["created"] is False
+    assert eng.analysis["baseline"]["suppressed"] >= 1
+    assert not [
+        f for f in eng.analysis["findings"] if f["code"] == "PWT202"
+    ]
+    # without the baseline the same graph still fails strict
+    G.clear()
+    _graph_with_warning()
+    with pytest.raises(AnalysisError):
+        pw.run(analysis="strict")
+
+
+_LINTY_SCRIPT = """
+import pathway_tpu as pw
+
+t = pw.debug.table_from_rows(
+    pw.schema_from_types(g=float, v=int), [(0.5, 1)]
+)
+res = t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.v))
+pw.io.subscribe(res, on_change=lambda *a, **kw: None)
+pw.run()
+"""
+
+_CLEAN_SCRIPT = """
+import pathway_tpu as pw
+
+t = pw.debug.table_from_rows(
+    pw.schema_from_types(k=str, v=int), [("a", 1)]
+)
+res = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+pw.io.subscribe(res, on_change=lambda *a, **kw: None)
+pw.run()
+"""
+
+_MESH_SCRIPT = """
+import pathway_tpu as pw
+
+t = pw.debug.table_from_rows(pw.schema_from_types(k=str), [("a",)])
+
+def embed(s: str) -> str:
+    return s
+
+embed._pw_embedder = {
+    "model": "m", "max_batch_size": 8, "max_len": 16, "dimension": 384,
+}
+res = t.select(e=pw.apply_with_type(embed, str, t.k))
+pw.io.subscribe(res, on_change=lambda *a, **kw: None)
+pw.run()
+"""
+
+
+def _write_script(tmp_path, body, name="script.py"):
+    path = tmp_path / name
+    path.write_text(body)
+    return str(path)
+
+
+def test_cli_analyze_mesh(tmp_path, capsys):
+    from pathway_tpu.cli import main
+
+    script = _write_script(tmp_path, _MESH_SCRIPT)
+    # no mesh: shape lints cannot fire
+    assert main(["analyze", script, "--fail-on", "error"]) == 0
+    capsys.readouterr()
+    assert (
+        main([
+            "analyze", script, "--mesh", "dp=1,tp=5", "--fail-on", "error",
+        ])
+        == 1
+    )
+    assert "PWT402" in capsys.readouterr().out
+    assert main(["analyze", script, "--mesh", "bogus"]) == 2
+    assert "mesh" in capsys.readouterr().err
+
+
+def test_cli_analyze_baseline(tmp_path, capsys):
+    from pathway_tpu.cli import main
+
+    linty = _write_script(tmp_path, _LINTY_SCRIPT, name="linty.py")
+    bl = str(tmp_path / "baseline.json")
+    # first run snapshots and passes
+    assert (
+        main(["analyze", linty, "--fail-on", "warning", "--baseline", bl])
+        == 0
+    )
+    assert "baseline written" in capsys.readouterr().err
+    data = json.load(open(bl))
+    assert data["schema_version"] == SCHEMA_VERSION and data["findings"]
+    # second run: known findings suppressed, still passes
+    assert (
+        main(["analyze", linty, "--fail-on", "warning", "--baseline", bl])
+        == 0
+    )
+    capsys.readouterr()
+    # --json carries the suppression accounting
+    assert main(["analyze", linty, "--json", "--baseline", bl]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["baseline"]["suppressed"] >= 1
+    assert not [
+        f for f in payload["findings"] if f["code"] == "PWT202"
+    ]
+
+
+def test_cli_analyze_baseline_catches_new_findings(tmp_path, capsys):
+    from pathway_tpu.cli import main
+
+    clean = _write_script(tmp_path, _CLEAN_SCRIPT, name="clean.py")
+    linty = _write_script(tmp_path, _LINTY_SCRIPT, name="linty.py")
+    bl = str(tmp_path / "baseline.json")
+    assert (
+        main(["analyze", clean, "--fail-on", "warning", "--baseline", bl])
+        == 0
+    )
+    # a finding not in the snapshot still fails the gate
+    assert (
+        main(["analyze", linty, "--fail-on", "warning", "--baseline", bl])
+        == 1
+    )
+    assert "PWT202" in capsys.readouterr().out

@@ -364,20 +364,39 @@ def _bench_builders():
     return sorted(GRAPH_BUILDERS.items())
 
 
-@pytest.mark.perf_smoke
-def test_benchmark_graphs_lint_clean():
-    """`pathway-tpu analyze --fail-on=error` semantics over every
-    engine_bench topology: no error-severity findings, ever."""
-    from pathway_tpu.analysis import Severity, analyze
+# keep in sync with benchmarks.engine_bench.GRAPH_BUILDERS — pytest needs
+# the names at collection time, and test_builder_parametrization_is_complete
+# fails loudly when a new topology is added without extending this tuple
+_BUILDER_NAMES = ("flatten", "join", "reduce", "wordcount", "wordcount_chain")
 
-    for name, builder in _bench_builders():
-        pw.G.clear()
-        result_table = builder()
-        result = analyze(pw.G, extra_tables=(result_table,), workers=1)
-        errors = [
-            f for f in result.findings if f.severity >= Severity.ERROR
-        ]
-        assert not errors, (name, result.render_text())
+
+@pytest.mark.perf_smoke
+def test_builder_parametrization_is_complete():
+    from benchmarks.engine_bench import GRAPH_BUILDERS
+
+    assert tuple(sorted(GRAPH_BUILDERS)) == _BUILDER_NAMES
+
+
+@pytest.mark.perf_smoke
+@pytest.mark.parametrize("name", _BUILDER_NAMES)
+def test_benchmark_graph_lints_clean_and_fusion_parity(name):
+    """`pathway-tpu analyze --fail-on=error` semantics over every
+    engine_bench topology: no error-severity findings, ever.  Then the
+    PWT599 half of the contract: build the topology and cross-check the
+    fusion plan the runner installed against the fused nodes it actually
+    instantiated."""
+    from benchmarks.engine_bench import GRAPH_BUILDERS
+    from pathway_tpu.analysis import Severity, analyze, verify_fusion
+
+    pw.G.clear()
+    result_table = GRAPH_BUILDERS[name]()
+    result = analyze(pw.G, extra_tables=(result_table,), workers=1)
+    errors = [f for f in result.findings if f.severity >= Severity.ERROR]
+    assert not errors, (name, result.render_text())
+    (capture,) = run_tables(result_table)
+    verify_fusion(capture.engine, result)
+    drift = [f for f in result.findings if f.code == "PWT599"]
+    assert not drift, (name, result.render_text())
 
 
 @pytest.mark.perf_smoke
@@ -390,6 +409,7 @@ def test_benchmark_predictions_match_selection():
     expected_op = {
         "reduce": "reduce",
         "wordcount": "reduce",
+        "wordcount_chain": "reduce",
         "join": "join",
         "flatten": "flatten",
     }
@@ -428,6 +448,102 @@ def test_scaling_bench_graph_lints_clean(tmp_path):
     assert [
         (p["op"], p["predicted"]) for p in result.predictions
     ] == [("reduce", "columnar")]
+
+
+@pytest.mark.perf_smoke
+def test_cli_analyze_json_gate_over_example_graph(tmp_path, capsys):
+    """The CI gate exactly as documented: `pathway-tpu analyze
+    --fail-on=error --json` over a representative example pipeline (the
+    engine_bench wordcount_chain shape) exits 0 and emits schema-stamped
+    JSON with the fusion plan attached."""
+    import json as _json
+
+    from pathway_tpu.analysis import SCHEMA_VERSION
+    from pathway_tpu.cli import main
+
+    script = tmp_path / "wc_chain.py"
+    script.write_text(
+        "import pathway_tpu as pw\n"
+        "t = pw.debug.table_from_rows(\n"
+        "    pw.schema_from_types(word=str, n=int), [('a', 1), ('b', 2)]\n"
+        ")\n"
+        "s = t.select(word=t.word, n=t.n * 2)\n"
+        "f = s.filter(s.n >= 0)\n"
+        "res = f.groupby(f.word).reduce(f.word, c=pw.reducers.count())\n"
+        "pw.io.subscribe(res, on_change=lambda *a, **kw: None)\n"
+        "pw.run()\n"
+    )
+    rc = main([
+        "analyze", str(script),
+        "--fail-on", "error", "--json", "--mesh", "dp=1,tp=2",
+    ])
+    assert rc == 0
+    payload = _json.loads(capsys.readouterr().out)
+    assert payload["schema_version"] == SCHEMA_VERSION
+    assert any(c["length"] >= 2 for c in payload["fusion"]["chains"])
+
+
+@pytest.mark.perf_smoke
+def test_analyzer_new_passes_overhead_under_5pct():
+    """The fusion (PWT5xx) and mesh (PWT4xx) passes ride the CI gate
+    (`analyze --fail-on=error --json` over every benchmark topology), so
+    the gate with them enabled must cost under 5% more than without —
+    same min-of-N interleaved protocol as the other overhead guards.
+    Each sample is one full gate run (graph build + all passes + JSON
+    serialization): that is the unit CI pays for, and the build half is
+    what the new passes must stay marginal against.  gc runs between
+    samples, not inside them — graph building is allocation-heavy and
+    collector pauses would otherwise dominate the A/B difference."""
+    import gc
+    import json as _json
+    from time import perf_counter
+
+    import pathway_tpu.analysis as analysis_mod
+    from benchmarks.engine_bench import GRAPH_BUILDERS
+    from pathway_tpu.analysis.passes import fusion_pass, mesh_pass
+
+    REPS = 12
+
+    def _noop(*a, **k):
+        return None
+
+    def run_gate(with_new_passes: bool) -> float:
+        analysis_mod.fusion_pass = fusion_pass if with_new_passes else _noop
+        analysis_mod.mesh_pass = mesh_pass if with_new_passes else _noop
+        pw.G.clear()
+        gc.collect()
+        t0 = perf_counter()
+        tails = tuple(b() for b in GRAPH_BUILDERS.values())
+        result = analysis_mod.analyze(
+            pw.G, extra_tables=tails, workers=2, mesh="dp=2,tp=2"
+        )
+        _json.dumps(result.to_dict())
+        return perf_counter() - t0
+
+    on, off = [], []
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        run_gate(True)  # warmup both arms
+        run_gate(False)
+        for i in range(REPS):
+            # alternate arm order so slow drift cannot bias one arm
+            first = i % 2 == 0
+            a = run_gate(first)
+            b = run_gate(not first)
+            (on if first else off).append(a)
+            (off if first else on).append(b)
+    finally:
+        analysis_mod.fusion_pass = fusion_pass
+        analysis_mod.mesh_pass = mesh_pass
+        if gc_was_enabled:
+            gc.enable()
+        pw.G.clear()
+    ratio = min(on) / min(off)
+    assert ratio < 1.05, (
+        f"fusion+mesh pass overhead {ratio:.3f}x "
+        f"(with={min(on):.4f}s without={min(off):.4f}s)"
+    )
 
 
 def test_fault_harness_overhead_under_5pct():
