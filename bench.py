@@ -668,48 +668,75 @@ def _tracing_overhead() -> float | None:
         return None
 
 
+def _fallback_payload(err: str, device_status: dict) -> dict:
+    """The host-only artifact for any round where the device cannot carry
+    the main number — preflight failure OR a mid-run device death.  A
+    parseable artifact beats a driver-side timeout with nothing, and the
+    host-side engine numbers don't need the device at all.  `value` must
+    never be null (BENCH r05): promote the first usable host-path number
+    to the top level with its own unit, and name which metric it came
+    from in value_source."""
+    host = _host_only_numbers()
+    exchange = _exchange_numbers()
+    fallback = None
+    for ent in [*(host or {}).values(), exchange]:
+        if ent is not None and isinstance(ent.get("value"), (int, float)):
+            fallback = ent
+            break
+    return {
+        "metric": METRIC,
+        "value": fallback["value"] if fallback else 0.0,
+        "unit": (
+            fallback.get("unit", "rows/s") if fallback else "docs/s"
+        ),
+        "value_source": fallback.get("metric") if fallback else None,
+        "vs_baseline": None,
+        "error": err,
+        "device_status": device_status,
+        "host_only": host,
+        "exchange_throughput": exchange,
+        "observability_overhead": _observability_overhead(),
+        "tracing_overhead": _tracing_overhead(),
+        "failover_recovery_s": _failover_recovery_s(),
+        **_multichip_facts(),
+    }
+
+
+def _probe_status_now() -> dict:
+    """One fresh DeviceMonitor probe for stamping `device_status` on a
+    mid-run failure artifact — the state machine's verdict, not a raw
+    timeout string."""
+    from pathway_tpu.internals.device_probe import DeviceMonitor
+
+    try:
+        return dict(DeviceMonitor(timeout_s=60.0).probe_once())
+    except Exception as exc:  # noqa: BLE001 — the probe must not mask err
+        return {"status": "probe-failed", "error": str(exc)}
+
+
 def main() -> None:
     err, device_status = _device_healthy()
     if err is not None:
-        # a parseable artifact beats a driver-side timeout with nothing —
-        # and the host-side engine numbers don't need the device at all.
-        # `value` must never be null (BENCH r05): promote the first usable
-        # host-path number to the top level with its own unit, and name
-        # which metric it came from in value_source.
-        host = _host_only_numbers()
-        exchange = _exchange_numbers()
-        fallback = None
-        for ent in [*(host or {}).values(), exchange]:
-            if ent is not None and isinstance(
-                ent.get("value"), (int, float)
-            ):
-                fallback = ent
-                break
+        print(json.dumps(_fallback_payload(err, device_status)))
+        return
+    try:
+        _run_device_round(device_status)
+    except Exception as exc:  # noqa: BLE001 — always emit an artifact
+        # the device died AFTER a healthy preflight (mid-run hang killed
+        # by an inner timeout, OOM, tunnel drop): re-probe so the
+        # artifact records the monitor's verdict, then fall back to the
+        # host-only numbers instead of emitting nothing
         print(
             json.dumps(
-                {
-                    "metric": METRIC,
-                    "value": fallback["value"] if fallback else 0.0,
-                    "unit": (
-                        fallback.get("unit", "rows/s")
-                        if fallback
-                        else "docs/s"
-                    ),
-                    "value_source": (
-                        fallback.get("metric") if fallback else None
-                    ),
-                    "vs_baseline": None,
-                    "error": err,
-                    "device_status": device_status,
-                    "host_only": host,
-                    "exchange_throughput": exchange,
-                    "observability_overhead": _observability_overhead(),
-                    "tracing_overhead": _tracing_overhead(),
-                    "failover_recovery_s": _failover_recovery_s(),
-                }
+                _fallback_payload(
+                    f"device round failed: {type(exc).__name__}: {exc}",
+                    _probe_status_now(),
+                )
             )
         )
-        return
+
+
+def _run_device_round(device_status: dict) -> None:
     rng = random.Random(7)
     docs = make_docs(N_DOCS, rng)
     with tempfile.TemporaryDirectory() as tmp:
@@ -794,6 +821,7 @@ def main() -> None:
                     1000.0 / max(facts["serving_qps_64clients"], 1e-9), 3
                 ),
                 "n_docs": N_DOCS,
+                "device_status": device_status,
                 "exchange_throughput": _exchange_numbers(),
                 "observability_overhead": _observability_overhead(),
                 "tracing_overhead": _tracing_overhead(),
@@ -821,6 +849,7 @@ def main() -> None:
                     rates["classic"], docs
                 )["mfu_pct"],
                 **_generation_facts(),
+                **_multichip_facts(),
             }
         )
     )
@@ -849,6 +878,33 @@ def _generation_facts() -> dict:
         return {"generation": json.loads(line)}
     except Exception as exc:  # noqa: BLE001 — never sink the main bench
         return {"generation": {"error": f"{type(exc).__name__}: {exc}"}}
+
+
+def _multichip_facts() -> dict:
+    """MULTICHIP r06: A/B the dp=4,tp=2 mesh-backend ingest path against
+    single-device in a subprocess (it may force 8 virtual CPU devices,
+    which must not disturb this process's backend) and nest its JSON
+    line.  Works device-up or device-down — the emulated mesh needs only
+    host cores — so both artifact shapes carry it."""
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks",
+        "multichip_bench.py",
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, script],
+            capture_output=True,
+            timeout=900,
+            text=True,
+        )
+        line = proc.stdout.strip().splitlines()[-1]
+        return {"multichip": json.loads(line)}
+    except Exception as exc:  # noqa: BLE001 — never sink the main bench
+        return {"multichip": {"error": f"{type(exc).__name__}: {exc}"}}
 
 
 def _device_name() -> str:

@@ -664,13 +664,18 @@ def mesh_pass(
                     udf=fname, dimension=dim, tp=tp,
                 ))
             if dp > 1 and dp & (dp - 1):
+                # wording mirrors models/minilm.py SentenceEncoder's
+                # build-time ValueError (this lint is its fail-fast twin)
                 result.add(make_diag(
                     "PWT402",
-                    f"embedder {fname!r} batches bucket to a power "
-                    f"of two, so a dp={dp} axis never divides the "
-                    "batch evenly: use a power-of-two dp device "
-                    "count (models/minilm.py enforces this at "
-                    "encoder build time)",
+                    f"embedder {fname!r}: encode_batch buckets every "
+                    f"batch to a power of two (minimum 8), so a "
+                    f"dp={dp} axis would never divide the batch axis "
+                    "evenly. Use a power-of-two dp device count, or "
+                    "drop the mesh and run the single-device async "
+                    "pipeline (PATHWAY_DEVICE_PIPELINE=1, the "
+                    "default); models/minilm.py enforces the same "
+                    "rule at encoder build time",
                     trace=trace, operator=operator,
                     udf=fname, dp=dp,
                 ))

@@ -98,6 +98,7 @@ class DevicePipeline:
         wait: Optional[Callable[[Any], None]] = None,
         quiesce: Optional[Callable[[], None]] = None,
         name: str = "device-pipeline",
+        replicas: int = 1,
     ):
         self.name = name
         self._prepare = prepare
@@ -108,6 +109,11 @@ class DevicePipeline:
         self.max_in_flight = max_in_flight or _env_int(
             "PATHWAY_PIPELINE_IN_FLIGHT", 2
         )
+        # mesh backend: dispatches are SPMD across dp replicas, so every
+        # replica holds its own copy of the in-flight window; meta may
+        # carry "replica_rows" for the per-replica /status gauges
+        self.replicas = max(1, int(replicas))
+        self._replica_rows = [0] * self.replicas
         workers = prep_workers or _env_int("PATHWAY_PIPELINE_PREP_WORKERS", 2)
         self._pool = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix=f"{name}-prep"
@@ -223,7 +229,26 @@ class DevicePipeline:
                 "pad_waste_ratio": (
                     1.0 - self._real_tokens / slab if slab else None
                 ),
+                "replicas": self.replicas,
             }
+
+    def replica_stats(self) -> List[Dict[str, Any]]:
+        """Per-dp-replica view.  Dispatches span every replica (one SPMD
+        program), so in-flight depth and window capacity are identical
+        across replicas; rows come from the "replica_rows" meta the
+        dp-grouped prepare stage reports."""
+        with self._cond:
+            in_flight = len(self._inflight)
+            return [
+                {
+                    "replica": r,
+                    "rows": self._replica_rows[r],
+                    "in_flight": in_flight,
+                    "queue_depth": len(self._pending),
+                    "occupancy": in_flight / self.max_in_flight,
+                }
+                for r in range(self.replicas)
+            ]
 
     # -- internals ---------------------------------------------------------
 
@@ -278,6 +303,9 @@ class DevicePipeline:
                     self._rows += int(meta.get("rows", 0))
                     self._real_tokens += int(meta.get("real_tokens", 0))
                     self._slab_tokens += int(meta.get("slab_tokens", 0))
+                    for r, n in enumerate(meta.get("replica_rows") or ()):
+                        if r < self.replicas:
+                            self._replica_rows[r] += int(n)
                     self._cond.notify_all()
             except BaseException as exc:  # noqa: BLE001 — parked for replay
                 with self._cond:
@@ -383,4 +411,31 @@ def pipeline_status() -> Dict[str, Any]:
         out.update(agg)
         out["pad_waste_ratio"] = _pad_waste()
         out["occupancy"] = _occupancy()
+    return out
+
+
+def replica_status(replicas: int) -> List[Dict[str, Any]]:
+    """Per-dp-replica occupancy/queue gauges for the /status `mesh` key,
+    aggregated over the live mesh-armed pipelines (replica r sums the
+    r-th entry of every pipeline running with that replica count)."""
+    out = [
+        {
+            "replica": r,
+            "rows": 0,
+            "in_flight": 0,
+            "queue_depth": 0,
+            "occupancy": 0.0,
+        }
+        for r in range(max(1, int(replicas)))
+    ]
+    pipes = [p for p in _PIPELINES if p.replicas == len(out)]
+    for p in pipes:
+        for r, st in enumerate(p.replica_stats()):
+            out[r]["rows"] += st["rows"]
+            out[r]["in_flight"] += st["in_flight"]
+            out[r]["queue_depth"] += st["queue_depth"]
+    cap = sum(p.max_in_flight for p in pipes)
+    if cap:
+        for row in out:
+            row["occupancy"] = row["in_flight"] / cap
     return out

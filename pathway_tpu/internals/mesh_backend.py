@@ -1,0 +1,256 @@
+"""Mesh execution backend: `pw.run(mesh=...)` as a real device mesh.
+
+Until PR 8 the mesh argument only armed the PWT4xx compatibility lints.
+This module promotes it to a first-class backend: `activate()` builds a
+`jax.sharding.Mesh` over the process's devices (real chips, or
+CPU-emulated ones under `XLA_FLAGS=--xla_force_host_platform_device_count`
+for tests) and publishes it process-wide, so the framework ingest path
+picks it up at engine-build time:
+
+  * `stdlib/indexing` index impls adopt the mesh for their
+    `DeviceKnnIndex` row shard (search = per-shard top-k + all-gather
+    merge, exact parity with the single-chip path);
+  * `ops/knn.FusedEmbedSearch` packs ingest slabs PER dp SHARD
+    (`pack_batch_dp`) and dispatches them with a `NamedSharding` on the
+    batch axis through the existing async device pipeline — one
+    in-flight window per dp replica;
+  * `models/transformer.TransformerLM.mesh_params` tp-shards the
+    encoder weights with the partition rules from
+    `param_sharding_rules`, so the matmuls run tensor-parallel.
+
+Exchange <-> device alignment: documents are routed to dp shards by the
+SAME `key.shard % dp` rule the columnar exchange uses for workers
+(`Pointer.shard % worker_count`).  When `workers % dp == 0` every row a
+worker owns lands on one fixed dp replica — this is what turns PWT404
+from an advisory lint into a load-bearing contract.
+
+Degradation rules (documented in ARCHITECTURE.md "Mesh backend"):
+
+  * fewer devices than the spec asks for -> the backend stays inactive
+    (warning log) and the mesh remains lint-only, exactly the pre-PR
+    behavior;
+  * a non-power-of-two dp axis cannot shard the bucketed batch/index
+    axes -> ingest stays single-device (PWT402 already flags embedder
+    graphs in this state);
+  * a `device_flap` (DeviceMonitor DEGRADED) drains the in-flight
+    pipeline window and routes new ingest through the synchronous host
+    path without losing exactly-once sink semantics — same contract as
+    the single-chip pipeline.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class MeshBackend:
+    """An activated mesh: the spec, the built `jax.sharding.Mesh`, and
+    the dp routing/accounting the ingest path needs."""
+
+    def __init__(self, spec, mesh):
+        self.spec = spec
+        self.mesh = mesh
+        names = tuple(mesh.axis_names)
+        self.dp_axis = "dp" if "dp" in names else names[0]
+        self.tp_axis = "tp" if "tp" in names else None
+        self.dp = int(mesh.shape[self.dp_axis])
+        self.tp = int(mesh.shape[self.tp_axis]) if self.tp_axis else 1
+        self._lock = threading.Lock()
+        self._degraded_replicas: set[int] = set()
+
+    # -- sharding contract -------------------------------------------------
+
+    def can_shard_ingest(self) -> bool:
+        """dp shards the bucketed batch/index axes only at power-of-two
+        counts (`DeviceKnnIndex` capacities and `pack_batch_dp` row
+        buckets are power-of-two/multiple-of-8); anything else keeps the
+        single-device ingest path (PWT402 lints embedder graphs)."""
+        return self.dp >= 1 and not (self.dp & (self.dp - 1))
+
+    def batch_sharding(self):
+        """NamedSharding for [B, L] token slabs: rows over dp, replicated
+        over tp."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        return NamedSharding(self.mesh, P(self.dp_axis, None))
+
+    def dp_shard_of(self, key) -> int:
+        """dp replica owning `key` — `key.shard % dp`, the engine
+        exchange's own routing rule (Pointer.shard % worker_count), so
+        engine sharding and device sharding agree when workers % dp == 0
+        (PWT404)."""
+        shard = getattr(key, "shard", None)
+        if shard is None:
+            try:
+                shard = int(key)
+            except (TypeError, ValueError):
+                shard = hash(key)
+        return int(shard) % self.dp
+
+    # -- degradation bookkeeping -------------------------------------------
+
+    def note_replica_degraded(self, replica: int) -> None:
+        with self._lock:
+            self._degraded_replicas.add(int(replica) % self.dp)
+
+    def note_replicas_healthy(self) -> None:
+        with self._lock:
+            self._degraded_replicas.clear()
+
+    def degraded_replicas(self) -> List[int]:
+        with self._lock:
+            return sorted(self._degraded_replicas)
+
+    # -- /status -----------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        from pathway_tpu.internals.device_pipeline import replica_status
+
+        dev0 = self.mesh.devices.flat[0]
+        return {
+            "active": True,
+            "axes": dict(self.spec.to_dict()),
+            "dp_axis": self.dp_axis,
+            "tp_axis": self.tp_axis,
+            "device_count": int(self.mesh.devices.size),
+            "platform": getattr(dev0, "platform", None),
+            "sharded_ingest": self.can_shard_ingest(),
+            "degraded_replicas": self.degraded_replicas(),
+            "replicas": replica_status(self.dp),
+        }
+
+
+# -- process-wide activation -------------------------------------------------
+
+_BACKEND: Optional[MeshBackend] = None
+_lock = threading.Lock()
+
+
+def activate(spec) -> Optional[MeshBackend]:
+    """Build and publish the mesh for `spec` (a MeshSpec). Returns None —
+    leaving the mesh a pure lint target, the pre-PR behavior — when the
+    process doesn't have enough devices."""
+    global _BACKEND
+    import jax
+    from jax.sharding import Mesh
+
+    with _lock:
+        need = spec.devices()
+        devices = jax.devices()
+        if need > len(devices):
+            logger.warning(
+                "mesh %s needs %d devices but only %d are attached; "
+                "running single-device (the mesh still arms the PWT4xx "
+                "analysis lints)",
+                spec.describe(), need, len(devices),
+            )
+            _BACKEND = None
+            return None
+        shape = tuple(count for _, count in spec.axes)
+        names = tuple(name for name, _ in spec.axes)
+        grid = np.asarray(devices[:need], dtype=object).reshape(shape)
+        _BACKEND = MeshBackend(spec, Mesh(grid, names))
+        return _BACKEND
+
+
+def deactivate() -> None:
+    global _BACKEND
+    with _lock:
+        _BACKEND = None
+
+
+def active_backend() -> Optional[MeshBackend]:
+    return _BACKEND
+
+
+def mesh_status(engine=None) -> Optional[Dict[str, Any]]:
+    """The `"mesh"` key for /status: live backend status when active,
+    the (lint-only) spec dict when the engine was built with one, else
+    None."""
+    backend = _BACKEND
+    if backend is not None:
+        return backend.status()
+    spec = getattr(engine, "mesh", None) if engine is not None else None
+    if spec is not None:
+        return {"active": False, "axes": dict(spec)}
+    return None
+
+
+# -- dp-grouped slab packing -------------------------------------------------
+
+
+def pack_batch_dp(
+    tokenizer,
+    keys: Sequence[Any],
+    texts: Sequence[str],
+    backend: MeshBackend,
+    *,
+    max_len: int = 512,
+    token_budget: int = 256,
+    max_segments: int = 32,
+) -> Tuple[np.ndarray, np.ndarray, List[Tuple[int, int]], List[int]]:
+    """`tokenizer.pack_batch`, but grouped by dp shard: documents are
+    partitioned by `backend.dp_shard_of(key)`, each group packs its own
+    token-budget slabs, and the groups pad to a common [R, L] block so
+    the stacked [dp*R, L] batch lands each group's rows exactly on its
+    replica under `backend.batch_sharding()` (row r belongs to replica
+    r // R).
+
+    Returns (ids [dp*R, L], seg [dp*R, L], slots, replica_rows) with
+    slots[d] = (row, seg-1) exactly like pack_batch, and replica_rows
+    the per-replica DOCUMENT counts for the pipeline's per-replica
+    occupancy gauges."""
+    from pathway_tpu.models.tokenizer import (
+        PAD_ID,
+        pack_batch,
+        seq_bucket_length,
+    )
+
+    dp = backend.dp
+    groups: List[List[int]] = [[] for _ in range(dp)]
+    for i, key in enumerate(keys):
+        groups[backend.dp_shard_of(key)].append(i)
+    packed = []
+    for g in groups:
+        if not g:
+            packed.append((g, None, None, None))
+            continue
+        ids_g, seg_g, slots_g = pack_batch(
+            tokenizer,
+            [texts[i] for i in g],
+            max_len=max_len,
+            token_budget=token_budget,
+            max_segments=max_segments,
+            row_bucket=False,
+        )
+        packed.append((g, ids_g, seg_g, slots_g))
+    live = [p for p in packed if p[1] is not None]
+    slab = max(ids_g.shape[1] for _, ids_g, _, _ in live)
+    rows = seq_bucket_length(
+        max(ids_g.shape[0] for _, ids_g, _, _ in live),
+        minimum=8,
+        maximum=1 << 16,
+    )
+    dtype = live[0][1].dtype
+    pad_id = getattr(tokenizer, "pad_id", PAD_ID)
+    ids = np.full((dp * rows, slab), pad_id, dtype=dtype)
+    seg = np.zeros((dp * rows, slab), dtype=dtype)
+    slots: List[Optional[Tuple[int, int]]] = [None] * len(keys)
+    replica_rows: List[int] = []
+    for replica, (g, ids_g, seg_g, slots_g) in enumerate(packed):
+        replica_rows.append(len(g))
+        if ids_g is None:
+            continue
+        base = replica * rows
+        ids[base : base + ids_g.shape[0], : ids_g.shape[1]] = ids_g
+        seg[base : base + seg_g.shape[0], : seg_g.shape[1]] = seg_g
+        for i, (row, s) in zip(g, slots_g):
+            slots[i] = (base + row, s)
+    return ids, seg, slots, replica_rows

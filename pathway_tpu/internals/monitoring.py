@@ -382,6 +382,7 @@ class PrometheusServer:
         ]
         from pathway_tpu.internals.device_pipeline import pipeline_status
         from pathway_tpu.internals.device_probe import device_status
+        from pathway_tpu.internals.mesh_backend import mesh_status
         from pathway_tpu.internals.tracing import merged_critical_path
 
         return {
@@ -398,6 +399,10 @@ class PrometheusServer:
             # async ingest pipeline (internals/device_pipeline.py):
             # queue depth, in-flight window, cumulative pad-waste ratio
             "device_pipeline": pipeline_status(),
+            # mesh execution backend (internals/mesh_backend.py): axes,
+            # per-dp-replica occupancy/queue gauges; lint-only spec dict
+            # when armed without enough devices, None without a mesh
+            "mesh": mesh_status(e0),
             # findings from pw.run(analysis=...): deployed graphs report
             # their own lint state (None when analysis was off)
             "analysis": getattr(e0, "analysis", None),

@@ -203,41 +203,63 @@ def run(
     # (per-worker arming would race and reset fire-once budgets).
     faults.install_from_env()
 
+    # Build the mesh execution backend BEFORE the graph builds: index
+    # impls adopt it at build time (stdlib/indexing).  With too few
+    # devices the backend stays inactive and the mesh remains the pure
+    # lint target it was pre-backend.  Deactivation is in the finally
+    # below (and at the end of _run_threaded) so one run's mesh never
+    # leaks into the next.
+    if mesh is not None:
+        from pathway_tpu.internals import mesh_backend
+
+        mesh_backend.activate(mesh)
+
     if cfg.threads > 1:
-        return _run_threaded(
-            cfg.threads,
-            monitoring_level=monitoring_level,
-            with_http_server=with_http_server,
-            persistence_config=persistence_config,
-            autocommit_duration_ms=autocommit_duration_ms,
-            analysis=analysis,
-            analysis_baseline=analysis_baseline,
-            mesh=mesh,
-            **kwargs,
-        )
+        try:
+            return _run_threaded(
+                cfg.threads,
+                monitoring_level=monitoring_level,
+                with_http_server=with_http_server,
+                persistence_config=persistence_config,
+                autocommit_duration_ms=autocommit_duration_ms,
+                analysis=analysis,
+                analysis_baseline=analysis_baseline,
+                mesh=mesh,
+                **kwargs,
+            )
+        finally:
+            if mesh is not None:
+                mesh_backend.deactivate()
 
-    engine = _make_engine()
-    _last_engine = engine
-    telemetry.register_engine(engine)
-    # static connector builds need it (object cache binding at build time)
-    engine._persistence_config = persistence_config
-    engine.mesh = mesh.to_dict() if mesh is not None else None
-    ctx = RunContext(engine)
-    with telemetry.span("graph_runner.build"):
-        _install_fusion(ctx)
-        for sink in G.sinks:
-            nodes = [ctx.node(t) for t in sink.tables]
-            sink.attach(ctx, nodes)
-    _apply_analysis(engine, analysis, mesh=mesh, baseline=analysis_baseline)
-    _attach_monitoring(engine)
-    monitor = _maybe_start_dashboard(engine, monitoring_level)
+    monitor = None
     http_server = None
-    if with_http_server:
-        from pathway_tpu.internals.monitoring import PrometheusServer
-
-        http_server = PrometheusServer(engine, process_id=engine.worker_id)
-        http_server.start()
+    engine = None
     try:
+        engine = _make_engine()
+        _last_engine = engine
+        telemetry.register_engine(engine)
+        # static connector builds need it (object cache binding at build
+        # time)
+        engine._persistence_config = persistence_config
+        engine.mesh = mesh.to_dict() if mesh is not None else None
+        ctx = RunContext(engine)
+        with telemetry.span("graph_runner.build"):
+            _install_fusion(ctx)
+            for sink in G.sinks:
+                nodes = [ctx.node(t) for t in sink.tables]
+                sink.attach(ctx, nodes)
+        _apply_analysis(
+            engine, analysis, mesh=mesh, baseline=analysis_baseline
+        )
+        _attach_monitoring(engine)
+        monitor = _maybe_start_dashboard(engine, monitoring_level)
+        if with_http_server:
+            from pathway_tpu.internals.monitoring import PrometheusServer
+
+            http_server = PrometheusServer(
+                engine, process_id=engine.worker_id
+            )
+            http_server.start()
         from pathway_tpu.persistence import get_persistence_engine_config
 
         with telemetry.span(
@@ -257,7 +279,12 @@ def run(
         if http_server is not None:
             http_server.stop()
         # replay sampled spans to OTel (no-op without an endpoint)
-        telemetry.export_engine_trace(engine)
+        if engine is not None:
+            telemetry.export_engine_trace(engine)
+        if mesh is not None:
+            from pathway_tpu.internals import mesh_backend
+
+            mesh_backend.deactivate()
 
 
 def _run_threaded(

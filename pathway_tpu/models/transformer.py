@@ -394,21 +394,52 @@ class TransformerLM:
         # constant (tokenizer.PACK_MAX_SEGMENTS) so there is one compile
         # per (R, L) slab shape, same cache discipline as the classic path
         self._packed_jit = jax.jit(_fwd_packed, static_argnums=(3,))
+        self._mesh_params: tuple | None = None
 
-    def encode_packed(self, ids, seg, max_segments: int):
+    def mesh_params(self, mesh):
+        """Tensor-parallel copy of the weights for a mesh backend: each
+        array device_put once under the `param_sharding_rules` partition
+        specs (qkv/up column-, out/down row-sharded on 'tp'), cached per
+        mesh. `self.params` — and every caller that doesn't opt in via
+        the `params=` override — keeps its exact single-device layout."""
+        cached = self._mesh_params
+        if cached is not None and cached[0] is mesh:
+            return cached[1]
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        rules = param_sharding_rules(self.config, mesh)
+        shardings = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec),
+            rules,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        placed = jax.device_put(self.params, shardings)
+        self._mesh_params = (mesh, placed)
+        return placed
+
+    def encode_packed(self, ids, seg, max_segments: int, *, params=None):
         """Packed ragged encode: ids/seg from tokenizer.pack_batch (wire
         dtypes; upcast on device). Returns [R, max_segments, H] pooled
         L2-normalized vectors; empty slots are zero. Inputs are NOT
         donated — the device-side int upcast changes the buffer dtype, so
         XLA could never reuse them and would warn on every dispatch."""
-        return self._packed_jit(self.params, ids, seg, int(max_segments))
+        return self._packed_jit(
+            self.params if params is None else params,
+            ids,
+            seg,
+            int(max_segments),
+        )
 
-    def __call__(self, ids, mask):
+    def __call__(self, ids, mask, *, params=None):
         # ids/mask arrive already wire-narrowed by encode_batch (tokenizer
         # _wire_dtype is the single policy); no host casts here — a cast
         # would pull mesh-sharded inputs back to host and destroy their
         # NamedSharding placement
-        return self._encode_jit(self.params, ids=ids, mask=mask)
+        return self._encode_jit(
+            self.params if params is None else params, ids=ids, mask=mask
+        )
 
     # -- greedy generation (decoder) --------------------------------------
     def generate(self, ids: np.ndarray, mask: np.ndarray, max_new_tokens: int = 16):

@@ -192,11 +192,68 @@ class DeviceKnnIndex:
         self._slot_of_key: dict = {}
         self._key_of_slot: dict = {}
         self._free: list[int] = list(range(self.capacity - 1, -1, -1))
+        # mesh: per-shard free buckets so dp-routed rows get slots INSIDE
+        # their replica's row range (exchange<->device alignment).  The
+        # flat list stays authoritative-order for shardless callers;
+        # _free_set arbitrates lazily-stale entries in both structures.
+        self._free_set: set | None = None
+        self._free_by_shard: list | None = None
+        if mesh is not None:
+            self._free_set = set(self._free)
+            self._rebuild_shard_buckets()
         # queued updates: slot -> (vector | None for invalidation)
         self._dirty: dict[int, np.ndarray | None] = {}
 
     def __len__(self) -> int:
         return len(self._slot_of_key)
+
+    # -- free-slot bookkeeping (shard-aware under a mesh) -------------------
+
+    def _shard_count(self) -> int:
+        return int(self.mesh.shape[self.mesh.axis_names[0]])
+
+    def _rebuild_shard_buckets(self) -> None:
+        """Bucket the free slots by owning shard (slot // shard_rows).
+        Rebuilt after _grow because the per-shard row ranges shift when
+        capacity doubles.  Buckets are descending so pop() hands out the
+        lowest slot in the shard first, mirroring the flat list."""
+        n_dev = self._shard_count()
+        shard_rows = self.capacity // n_dev
+        buckets: list[list[int]] = [[] for _ in range(n_dev)]
+        for slot in sorted(self._free_set, reverse=True):
+            buckets[slot // shard_rows].append(slot)
+        self._free_by_shard = buckets
+
+    def _free_count(self) -> int:
+        return len(self._free_set) if self._free_set is not None else len(
+            self._free
+        )
+
+    def _pop_free(self, shard: int | None = None) -> int:
+        if self._free_set is None:
+            return self._free.pop()
+        if shard is not None:
+            bucket = self._free_by_shard[shard % len(self._free_by_shard)]
+            while bucket:
+                slot = bucket.pop()
+                if slot in self._free_set:
+                    self._free_set.discard(slot)
+                    return slot
+        # shardless callers — and a full shard bucket's overflow — take
+        # the global order the flat list preserves (placement is a
+        # locality optimization, never a correctness requirement)
+        while True:
+            slot = self._free.pop()
+            if slot in self._free_set:
+                self._free_set.discard(slot)
+                return slot
+
+    def _push_free(self, slot: int) -> None:
+        self._free.append(slot)
+        if self._free_set is not None:
+            self._free_set.add(slot)
+            shard_rows = self.capacity // self._shard_count()
+            self._free_by_shard[slot // shard_rows].append(slot)
 
     def _shard_buffers(self) -> None:
         if self.mesh is None:
@@ -238,19 +295,28 @@ class DeviceKnnIndex:
         slot = self._assign_slot(key)
         self._dirty[slot] = self._normalize(vector)
 
-    def add_batch(self, keys, vectors) -> None:
-        """vectors: [B, d] array (host or device)."""
+    def add_batch(self, keys, vectors, shards=None) -> None:
+        """vectors: [B, d] array (host or device). shards (optional,
+        mesh only): per-key dp-shard hints — slots are drawn from the
+        owning replica's row range so engine sharding and device
+        sharding agree."""
         keys = list(keys)
         if _is_device_array(vectors):
             # keep the batch on device: assign slots, one scatter, no host
             # round trip
             self._flush()
-            while len(self._free) < len(keys) - sum(
+            while self._free_count() < len(keys) - sum(
                 1 for k in keys if k in self._slot_of_key
             ):
                 self._grow()
             slots = np.array(
-                [self._assign_slot(k) for k in keys], dtype=np.int32
+                [
+                    self._assign_slot(
+                        k, None if shards is None else shards[i]
+                    )
+                    for i, k in enumerate(keys)
+                ],
+                dtype=np.int32,
             )
             slot_valid = np.ones((len(slots),), dtype=bool)
             self._buffer, self._valid_dev = _compiled_update()(
@@ -263,12 +329,12 @@ class DeviceKnnIndex:
             slot = self._assign_slot(key)
             self._dirty[slot] = vec
 
-    def _assign_slot(self, key) -> int:
+    def _assign_slot(self, key, shard: int | None = None) -> int:
         slot = self._slot_of_key.get(key)
         if slot is None:
-            if not self._free:
+            if not self._free_count():
                 self._grow()
-            slot = self._free.pop()
+            slot = self._pop_free(shard)
             self._slot_of_key[key] = slot
             self._key_of_slot[slot] = key
         return slot
@@ -278,7 +344,7 @@ class DeviceKnnIndex:
         if slot is None:
             return
         del self._key_of_slot[slot]
-        self._free.append(slot)
+        self._push_free(slot)
         self._dirty[slot] = None
 
     def _grow(self) -> None:
@@ -287,8 +353,12 @@ class DeviceKnnIndex:
             self._buffer, self._valid_dev
         )
         self._free.extend(range(new_capacity - 1, self.capacity - 1, -1))
+        if self._free_set is not None:
+            self._free_set.update(range(self.capacity, new_capacity))
         self.capacity = new_capacity
         self._shard_buffers()
+        if self._free_set is not None:
+            self._rebuild_shard_buckets()
 
     def _flush(self) -> None:
         if not self._dirty:
@@ -411,9 +481,18 @@ class FusedEmbedSearch:
     round trip; behind a tunneled TPU this is the difference between ~200ms
     and one RTT."""
 
-    def __init__(self, encoder, index: DeviceKnnIndex):
+    def __init__(self, encoder, index: DeviceKnnIndex, backend=None):
         self.encoder = encoder
         self.index = index
+        # mesh execution backend (internals/mesh_backend.MeshBackend):
+        # dp-grouped packed ingest + tp-sharded encoder params; None
+        # keeps the single-device path byte-identical
+        self.backend = backend
+
+    def _params(self):
+        if self.backend is not None:
+            return self.encoder.lm.mesh_params(self.backend.mesh)
+        return self.encoder.lm.params
 
     def _fn(self, k: int):
         # process-global cache keyed on (config, metric, k[, mesh]): a
@@ -450,8 +529,26 @@ class FusedEmbedSearch:
 
         texts = list(texts)
         keys = list(keys)
-        budget = pack_token_budget() if pack and self.index.mesh is None else 0
-        if budget > 0 and texts:
+        packable = self.index.mesh is None or self.backend is not None
+        budget = pack_token_budget() if pack and packable else 0
+        replica_rows = None
+        if budget > 0 and texts and self.backend is not None:
+            # mesh backend: pack PER dp SHARD so each replica's rows land
+            # on its devices under the batch NamedSharding
+            from pathway_tpu.internals.mesh_backend import pack_batch_dp
+
+            ids, seg, slots, replica_rows = pack_batch_dp(
+                self.encoder.tokenizer,
+                keys,
+                texts,
+                self.backend,
+                max_len=self.encoder.max_len,
+                token_budget=budget,
+                max_segments=PACK_MAX_SEGMENTS,
+            )
+            payload = ("packed_dp", keys, ids, seg, slots)
+            real, total = int(np.count_nonzero(seg)), int(seg.size)
+        elif budget > 0 and texts:
             ids, seg, slots = pack_batch(
                 self.encoder.tokenizer,
                 texts,
@@ -467,11 +564,14 @@ class FusedEmbedSearch:
             )
             payload = ("classic", keys, ids, mask, None)
             real, total = int(np.asarray(mask).sum()), int(mask.size)
-        return payload, {
+        meta = {
             "rows": len(keys),
             "real_tokens": real,
             "slab_tokens": total,
         }
+        if replica_rows is not None:
+            meta["replica_rows"] = replica_rows
+        return payload, meta
 
     def dispatch_batch(self, payload):
         """Device DISPATCH stage: enqueue encode (+ per-segment gather for
@@ -482,8 +582,20 @@ class FusedEmbedSearch:
         from pathway_tpu.models.tokenizer import PACK_MAX_SEGMENTS
 
         kind, keys, ids, second, slots = payload
-        if kind == "packed":
-            pooled = self.encoder.lm.encode_packed(ids, second, PACK_MAX_SEGMENTS)
+        shards = None
+        if kind == "packed_dp":
+            # dp-sharded dispatch: slab rows placed per replica, encoder
+            # matmuls tp-sharded via the partition-ruled param copy
+            import jax
+
+            sharding = self.backend.batch_sharding()
+            ids = jax.device_put(ids, sharding)
+            second = jax.device_put(second, sharding)
+            shards = [self.backend.dp_shard_of(k) for k in keys]
+        if kind in ("packed", "packed_dp"):
+            pooled = self.encoder.lm.encode_packed(
+                ids, second, PACK_MAX_SEGMENTS, params=self._params()
+            )
             rows = np.fromiter(
                 (r for r, _ in slots), dtype=np.int64, count=len(slots)
             )
@@ -494,7 +606,7 @@ class FusedEmbedSearch:
         else:
             emb = self.encoder.lm(ids, second)[: len(keys)]
         if keys:
-            self.index.add_batch(keys, emb)
+            self.index.add_batch(keys, emb, shards=shards)
         return emb
 
     def search_texts(self, texts, k: int) -> list:
@@ -510,7 +622,7 @@ class FusedEmbedSearch:
         # ids/mask are wire-narrowed by encode_batch (one shared dtype);
         # the fused jit upcasts on device
         packed = self._fn(k_eff)(
-            self.encoder.lm.params,
+            self._params(),
             np.stack([ids, mask]),
             self.index._buffer,
             self.index._valid_dev,

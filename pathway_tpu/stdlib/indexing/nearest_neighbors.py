@@ -35,6 +35,19 @@ class USearchMetricKind(enum.Enum):
     IP = "ip"
 
 
+def _ingest_backend():
+    """The process-wide mesh execution backend, when it can shard the
+    bucketed ingest/index axes (power-of-two dp). Impls are built at
+    engine-build time — inside pw.run(mesh=...) — so this is where an
+    explicit `mesh=None` factory picks up the run's mesh."""
+    from pathway_tpu.internals.mesh_backend import active_backend
+
+    backend = active_backend()
+    if backend is not None and backend.can_shard_ingest():
+        return backend
+    return None
+
+
 class _KnnIndexImpl(IndexImpl):
     """Device KNN with a degradation host path.
 
@@ -47,6 +60,10 @@ class _KnnIndexImpl(IndexImpl):
     interim.  The mirror costs one float32 copy per live vector."""
 
     def __init__(self, dimensions: int, metric: str, reserved_space: int, mesh=None):
+        if mesh is None:
+            backend = _ingest_backend()
+            if backend is not None:
+                mesh = backend.mesh
         self.knn = DeviceKnnIndex(
             dimensions, metric=metric, reserved_space=reserved_space, mesh=mesh
         )
@@ -137,11 +154,19 @@ class _FusedKnnIndexImpl(IndexImpl):
     def __init__(self, encoder, metric: str, reserved_space: int, mesh=None):
         from pathway_tpu.ops.knn import DeviceKnnIndex, FusedEmbedSearch
 
+        backend = None
+        if mesh is None:
+            # adopt the run's mesh backend: dp-sharded index + dp-grouped
+            # packed ingest + tp-sharded encoder (ops/knn.FusedEmbedSearch)
+            backend = _ingest_backend()
+            if backend is not None:
+                mesh = backend.mesh
+        self._backend = backend
         self.knn = DeviceKnnIndex(
             encoder.dimension, metric=metric, reserved_space=reserved_space,
             mesh=mesh,
         )
-        self.fused = FusedEmbedSearch(encoder, self.knn)
+        self.fused = FusedEmbedSearch(encoder, self.knn, backend=backend)
         self.metadata: dict = {}
         self._pipeline = None
         self._pipeline_broken = False
@@ -169,14 +194,16 @@ class _FusedKnnIndexImpl(IndexImpl):
         from pathway_tpu.internals.device_pipeline import pipeline_enabled
         from pathway_tpu.internals.device_probe import device_degraded
 
-        # mesh path keeps the classic dispatch (sharded inputs would need
-        # per-shard donation bookkeeping); DEGRADED devices bypass the
-        # pipeline so in-flight work drains and new batches take the
-        # synchronous path the monitor already guards
+        # a factory-attached mesh keeps the classic dispatch (sharded
+        # inputs would need per-shard donation bookkeeping); the mesh
+        # BACKEND path pipelines — its dp-grouped slabs dispatch as one
+        # SPMD program, one in-flight window per dp replica.  DEGRADED
+        # devices bypass the pipeline so in-flight work drains and new
+        # batches take the synchronous path the monitor already guards
         return (
             pipeline_enabled()
             and not self._pipeline_broken
-            and self.knn.mesh is None
+            and (self.knn.mesh is None or self._backend is not None)
             and not device_degraded()
         )
 
@@ -189,6 +216,7 @@ class _FusedKnnIndexImpl(IndexImpl):
                 dispatch=self.fused.dispatch_batch,
                 quiesce=self._quiesce_device,
                 name="knn-ingest",
+                replicas=self._backend.dp if self._backend else 1,
             )
         return self._pipeline
 
