@@ -306,7 +306,9 @@ def _device_ingest_rate(docs: list[str]) -> dict:
             best = max(best, N_DOCS / (time.perf_counter() - t0))
         return best
 
-    def pipelined() -> tuple[float, float | None]:
+    def pipelined() -> tuple[float, float | None, dict | None]:
+        from pathway_tpu.internals import utilization
+
         index, fused = fresh()
         pipe = DevicePipeline(
             prepare=lambda item: fused.prepare_batch(*item),
@@ -318,6 +320,11 @@ def _device_ingest_rate(docs: list[str]) -> dict:
             # warmup pass pays the packed-slab compiles
             pipe.submit((range(chunk), docs[:chunk]))
             pipe.drain()
+            # scope the live-MFU window to the measured runs only, so
+            # the runtime gauge and the offline rate judge the SAME
+            # dispatches (satellite: live-vs-offline cross-check)
+            if utilization.ENABLED:
+                utilization.reset_window()
             best = 0.0
             for _ in range(2):
                 t0 = time.perf_counter()
@@ -330,19 +337,25 @@ def _device_ingest_rate(docs: list[str]) -> dict:
                     )
                 pipe.drain()
                 best = max(best, N_DOCS / (time.perf_counter() - t0))
-            return best, pipe.stats()["pad_waste_ratio"]
+            live = (
+                utilization.tracker().snapshot()
+                if utilization.ENABLED
+                else None
+            )
+            return best, pipe.stats()["pad_waste_ratio"], live
         finally:
             pipe.close()
 
     classic = classic_rate()
     if pipeline_enabled():
-        pipe_rate, pad_waste = pipelined()
+        pipe_rate, pad_waste, live = pipelined()
     else:
-        pipe_rate, pad_waste = None, None
+        pipe_rate, pad_waste, live = None, None, None
     return {
         "classic": classic,
         "pipelined": pipe_rate,
         "pad_waste_ratio": pad_waste,
+        "live_utilization": live,
     }
 
 
@@ -848,6 +861,14 @@ def _run_device_round(device_status: dict) -> None:
                 "mfu_pct_device_phase_classic": _mfu_facts(
                     rates["classic"], docs
                 )["mfu_pct"],
+                # the runtime gauge's view of the SAME pipelined run
+                # (internals/utilization.py rolling window) — live and
+                # offline share one cost model, so >20% divergence means
+                # a measurement problem, and the flag makes it data
+                **_live_mfu_facts(
+                    rates.get("live_utilization"),
+                    _mfu_facts(device_rate, docs)["mfu_pct"],
+                ),
                 **_generation_facts(),
                 **_multichip_facts(),
             }
@@ -919,17 +940,15 @@ def _device_name() -> str:
 def _mfu_facts(docs_per_sec: float, docs: list[str]) -> dict:
     """tokens/s and achieved MFU of the ingest phase.  Tokens/doc is the
     REAL mask count from tokenizing the benchmark corpus (not max_len —
-    bucketing pads, but padding is not useful work); per-token forward
-    FLOPs ~= per-layer 2*(4*h^2 attention projections + 2*h*ffn MLP) +
-    attention scores at the actual sequence length."""
+    bucketing pads, but padding is not useful work); FLOPs/token comes
+    from the shared analytic model (internals/costmodel.py), the same
+    one the live `pathway_device_mfu_pct` gauge uses."""
+    from pathway_tpu.internals import costmodel
     from pathway_tpu.models.minilm import SentenceEncoder
     from pathway_tpu.models.tokenizer import encode_batch
 
     enc = SentenceEncoder.cached("all-MiniLM-L6-v2", max_len=64)
     cfg = enc.config
-    h = cfg.hidden
-    ffn = cfg.mlp_dim
-    layers = cfg.layers
     sample = docs[:512]
     _ids, mask = encode_batch(
         enc.tokenizer, sample, max_len=enc.max_len
@@ -937,10 +956,11 @@ def _mfu_facts(docs_per_sec: float, docs: list[str]) -> dict:
     tokens_per_doc = float(np.asarray(mask, dtype=np.float64).sum()) / len(
         sample
     )
-    seq = tokens_per_doc
-    per_token = layers * (
-        2 * (4 * h * h + 2 * h * ffn)  # qkvo projections + mlp
-        + 2 * 2 * seq * h  # attention scores + mix (per token, s*h each)
+    per_token = costmodel.encoder_flops_per_token(
+        tokens_per_doc,
+        hidden=cfg.hidden,
+        mlp_dim=cfg.mlp_dim,
+        layers=cfg.layers,
     )
     tokens_per_sec = docs_per_sec * tokens_per_doc
     flops = tokens_per_sec * per_token
@@ -955,19 +975,36 @@ def _mfu_facts(docs_per_sec: float, docs: list[str]) -> dict:
 
 
 def _device_peak_flops() -> float:
-    """Peak bf16 FLOP/s of the attached chip (known TPU generations)."""
-    name = _device_name().lower()
-    table = {
-        "v5 lite": 197e12,  # v5e
-        "v5e": 197e12,
-        "v5p": 459e12,
-        "v4": 275e12,
-        "v6": 918e12,  # trillium
+    """Peak bf16 FLOP/s of the attached chip (shared device table in
+    internals/costmodel.py; 0.0 for unknown devices)."""
+    from pathway_tpu.internals import costmodel
+
+    return costmodel.device_peak_flops(_device_name())
+
+
+def _live_mfu_facts(live: dict | None, offline_mfu: float | None) -> dict:
+    """Cross-check the live utilization tracker against this bench's
+    offline device-phase MFU.  Both sides share one cost model, so a
+    divergence beyond 20% means one of the measurements is lying (e.g.
+    the rolling window caught warmup, or the tracker missed spans)."""
+    live = live or {}
+    live_mfu = live.get("mfu_pct")
+    out: dict = {
+        "mfu_pct_device_phase_live": (
+            round(live_mfu, 2) if live_mfu is not None else None
+        ),
+        "tokens_per_sec_live": (
+            round(live["tokens_per_sec"])
+            if live.get("tokens_per_sec")
+            else None
+        ),
+        "bound_state_live": live.get("bound_state"),
     }
-    for key, peak in table.items():
-        if key in name:
-            return peak
-    return 0.0
+    if live_mfu is not None and offline_mfu:
+        ratio = abs(live_mfu - offline_mfu) / offline_mfu
+        out["mfu_live_divergence"] = round(ratio, 3)
+        out["mfu_live_divergence_flag"] = ratio > 0.20
+    return out
 
 
 if __name__ == "__main__":

@@ -100,13 +100,15 @@ def _measure(cfg, label: str) -> dict:
     t_prefill_1 = best(lambda: run(one_ids, one_mask, 1))
     t_full_1 = best(lambda: run(one_ids, one_mask, NEW_TOKENS + 1))
 
+    from pathway_tpu.internals import costmodel
+
     decode_s_b = t_full_b - t_prefill_b
     decode_s_1 = t_full_1 - t_prefill_1
     n_params = _n_params(cfg)
     decode_tok_s = BATCH * NEW_TOKENS / decode_s_b
-    # decode FLOPs/token ~= 2 * params (matmul MACs), the standard
-    # inference-roofline count; attention against the short cache adds
-    # <2% at these shapes
+    # decode FLOPs/token ~= 2 * params (shared analytic model —
+    # internals/costmodel.py documents the roofline count)
+    flops_per_token = costmodel.decoder_flops_per_token(n_params)
     peak = _peak_flops()
     return {
         "model": label,
@@ -120,8 +122,7 @@ def _measure(cfg, label: str) -> dict:
         "prefill_mfu_pct": round(
             100.0
             * (BATCH * PROMPT_LEN / t_prefill_b)
-            * 2
-            * n_params
+            * flops_per_token
             / peak,
             2,
         )
@@ -131,7 +132,7 @@ def _measure(cfg, label: str) -> dict:
         "decode_tokens_per_sec_b1": round(NEW_TOKENS / decode_s_1, 1),
         "ms_per_token_b1": round(1000.0 * decode_s_1 / NEW_TOKENS, 2),
         "decode_mfu_pct": round(
-            100.0 * decode_tok_s * 2 * n_params / peak, 2
+            100.0 * decode_tok_s * flops_per_token / peak, 2
         )
         if peak
         else None,
@@ -140,7 +141,7 @@ def _measure(cfg, label: str) -> dict:
             # once per batch; achieved bytes/s vs the chip's HBM BW
             100.0
             * (decode_tok_s / BATCH)
-            * (2 * n_params)
+            * flops_per_token
             / _hbm_bytes_per_sec(),
             1,
         )
@@ -150,35 +151,15 @@ def _measure(cfg, label: str) -> dict:
 
 
 def _peak_flops() -> float:
-    import jax
+    from pathway_tpu.internals import costmodel
 
-    name = str(jax.devices()[0]).lower()
-    for key, peak in {
-        "v5 lite": 197e12,
-        "v5e": 197e12,
-        "v5p": 459e12,
-        "v4": 275e12,
-        "v6": 918e12,
-    }.items():
-        if key in name:
-            return peak
-    return 0.0
+    return costmodel.device_peak_flops()
 
 
 def _hbm_bytes_per_sec() -> float:
-    import jax
+    from pathway_tpu.internals import costmodel
 
-    name = str(jax.devices()[0]).lower()
-    for key, bw in {
-        "v5 lite": 819e9,  # v5e: 819 GB/s
-        "v5e": 819e9,
-        "v5p": 2765e9,
-        "v4": 1228e9,
-        "v6": 1640e9,
-    }.items():
-        if key in name:
-            return bw
-    return 0.0
+    return costmodel.device_hbm_bytes_per_sec()
 
 
 def main() -> None:

@@ -54,14 +54,9 @@ def make_docs(n, rng):
 
 
 def _peak():
-    import jax
+    from pathway_tpu.internals import costmodel
 
-    name = str(jax.devices()[0]).lower()
-    for key, p in {"v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
-                   "v4": 275e12, "v6": 918e12}.items():
-        if key in name:
-            return p
-    return 0.0
+    return costmodel.device_peak_flops()
 
 
 def _readback(x) -> float:
@@ -215,9 +210,9 @@ def fused_ingest_rate(docs):
 
 
 def useful_flops_per_doc(tokens_per_doc):
-    h, ffn, layers, seq = 384, 1536, 6, tokens_per_doc
-    per_token = layers * (2 * (4 * h * h + 2 * h * ffn) + 2 * 2 * seq * h)
-    return per_token * tokens_per_doc
+    from pathway_tpu.internals import costmodel
+
+    return costmodel.encoder_flops_per_doc(tokens_per_doc)
 
 
 def main():

@@ -89,6 +89,12 @@ def _status(args) -> int:
     return main_status(args)
 
 
+def _profile(args) -> int:
+    from pathway_tpu.internals.trace_tool import main_profile
+
+    return main_profile(args)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="pathway")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -165,6 +171,41 @@ def main(argv=None) -> int:
         "--json", action="store_true", help="raw JSON output"
     )
     status.set_defaults(func=_status)
+
+    profile = sub.add_parser(
+        "profile",
+        help="capture an on-demand jax.profiler device trace — from a "
+        "running job's /profile endpoint, or locally with --device",
+    )
+    profile.add_argument(
+        "--url",
+        default=None,
+        help="base monitoring URL of the running job (overrides --port)",
+    )
+    profile.add_argument(
+        "--port",
+        type=int,
+        default=20000,
+        help="local monitoring port (default: worker 0's 20000)",
+    )
+    profile.add_argument(
+        "--seconds",
+        type=float,
+        default=2.0,
+        help="capture window length (bounded server-side)",
+    )
+    profile.add_argument(
+        "--out",
+        default=None,
+        help="trace output directory (default: a fresh tempdir)",
+    )
+    profile.add_argument(
+        "--device",
+        action="store_true",
+        help="capture in THIS process, driving a calibration matmul "
+        "(no running job needed)",
+    )
+    profile.set_defaults(func=_profile)
 
     spawn = sub.add_parser("spawn", help="run a program on multiple workers")
     spawn.add_argument("--threads", "-t", type=int, default=1)

@@ -1,0 +1,179 @@
+"""Analytic FLOPs/bytes cost model — the single source of truth.
+
+Before the utilization PR this model lived in three places (bench.py
+`_mfu_facts`/`_device_peak_flops`, benchmarks/roofline_check.py
+`useful_flops_per_doc`/`_peak`, benchmarks/generation_bench.py
+`_peak_flops`/`_hbm_bytes_per_sec`) and could silently drift.  Every
+MFU number the repo prints — offline bench artifacts, the roofline
+probes, and the live `pathway_device_mfu_pct` gauge — now derives from
+the formulas here, so "live vs offline divergence" can only mean a
+measurement problem, never two cost models disagreeing.
+
+Contract (documented in ARCHITECTURE.md "Device utilization"):
+
+  * USEFUL FLOPs count real mask tokens only.  Bucketing and slab
+    packing pad, but padding is not useful work; MFU judged on padded
+    tokens would reward waste.
+  * encoder per-token forward FLOPs at sequence length ``seq``::
+
+        layers * (2 * (4*h*h + 2*h*ffn)   # q,k,v,o projections + MLP
+                  + 2 * 2 * seq * h)      # attention scores + mix
+
+    (matmul FLOPs = 2 * MACs; norms/softmax/gathers are <2% at MiniLM
+    shapes and are deliberately excluded, matching the bench).
+  * decoder FLOPs/token ~= 2 * n_params — the standard inference
+    roofline count; attention against a short KV cache adds <2%.
+  * peak FLOP/s and HBM bytes/s come from a device-name keyed table of
+    published bf16 numbers; unknown devices (including the CPU CI
+    backend) return 0.0 and every consumer must treat 0.0 as "peak
+    unknown -> MFU undefined", never divide by it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+# MiniLM-L6 geometry — the repo's ingest encoder (models/minilm.py).
+MINILM_HIDDEN = 384
+MINILM_MLP_DIM = 1536
+MINILM_LAYERS = 6
+
+# Published peak bf16 FLOP/s per chip, keyed on jax device-name
+# substrings ("TPU v5 lite" spells v5e two ways across jax versions).
+DEVICE_PEAK_BF16_FLOPS: Dict[str, float] = {
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6": 918e12,  # trillium
+}
+
+# Published HBM bandwidth, same keying.
+DEVICE_HBM_BYTES_PER_SEC: Dict[str, float] = {
+    "v5 lite": 819e9,  # v5e: 819 GB/s
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v4": 1228e9,
+    "v6": 1640e9,
+}
+
+_lock = threading.Lock()
+_cached_name: Optional[str] = None
+
+
+def device_name() -> str:
+    """Name of device 0, cached (jax.devices() is not free behind a
+    tunnel); "unknown" when jax or the backend is unavailable."""
+    global _cached_name
+    with _lock:
+        if _cached_name is None:
+            try:
+                import jax
+
+                _cached_name = str(jax.devices()[0])
+            except Exception:  # noqa: BLE001 — no backend is a valid state
+                _cached_name = "unknown"
+        return _cached_name
+
+
+def _lookup(table: Dict[str, float], name: Optional[str]) -> float:
+    lowered = (name if name is not None else device_name()).lower()
+    for key, value in table.items():
+        if key in lowered:
+            return value
+    return 0.0
+
+
+def device_peak_flops(name: Optional[str] = None) -> float:
+    """Peak bf16 FLOP/s of `name` (default: the attached chip); 0.0 for
+    unknown devices — consumers must report MFU as None, not divide."""
+    return _lookup(DEVICE_PEAK_BF16_FLOPS, name)
+
+
+def device_hbm_bytes_per_sec(name: Optional[str] = None) -> float:
+    """HBM bytes/s of `name` (default: the attached chip); 0.0 unknown."""
+    return _lookup(DEVICE_HBM_BYTES_PER_SEC, name)
+
+
+def encoder_flops_per_token(
+    seq: float,
+    *,
+    hidden: int = MINILM_HIDDEN,
+    mlp_dim: int = MINILM_MLP_DIM,
+    layers: int = MINILM_LAYERS,
+) -> float:
+    """Forward FLOPs for ONE token of an encoder layer stack at sequence
+    length `seq`: per layer, 2*(4*h*h) for the q/k/v/o projections,
+    2*(2*h*ffn) for the MLP, and 2*2*seq*h for attention scores + mix."""
+    h = hidden
+    return layers * (2 * (4 * h * h + 2 * h * mlp_dim) + 2 * 2 * seq * h)
+
+
+def encoder_flops_per_doc(
+    tokens_per_doc: float,
+    *,
+    hidden: int = MINILM_HIDDEN,
+    mlp_dim: int = MINILM_MLP_DIM,
+    layers: int = MINILM_LAYERS,
+) -> float:
+    """Useful forward FLOPs for one document of `tokens_per_doc` REAL
+    tokens (seq = tokens_per_doc: a doc attends within itself)."""
+    return (
+        encoder_flops_per_token(
+            tokens_per_doc, hidden=hidden, mlp_dim=mlp_dim, layers=layers
+        )
+        * tokens_per_doc
+    )
+
+
+def encoder_useful_flops(
+    real_tokens: int,
+    rows: int,
+    *,
+    hidden: int = MINILM_HIDDEN,
+    mlp_dim: int = MINILM_MLP_DIM,
+    layers: int = MINILM_LAYERS,
+) -> float:
+    """Useful FLOPs of a dispatched batch: `real_tokens` mask tokens
+    over `rows` documents, attention charged at the batch's average
+    real sequence length (padding excluded — see module contract)."""
+    if real_tokens <= 0:
+        return 0.0
+    seq = real_tokens / max(rows, 1)
+    return real_tokens * encoder_flops_per_token(
+        seq, hidden=hidden, mlp_dim=mlp_dim, layers=layers
+    )
+
+
+def encoder_flops_for_config(config: Any, real_tokens: int, rows: int) -> float:
+    """`encoder_useful_flops` with the geometry read off a
+    TransformerConfig (hidden / mlp_dim / layers attributes)."""
+    return encoder_useful_flops(
+        real_tokens,
+        rows,
+        hidden=int(getattr(config, "hidden", MINILM_HIDDEN)),
+        mlp_dim=int(getattr(config, "mlp_dim", MINILM_MLP_DIM)),
+        layers=int(getattr(config, "layers", MINILM_LAYERS)),
+    )
+
+
+def decoder_flops_per_token(n_params: int) -> float:
+    """Decoder FLOPs per generated/prefilled token ~= 2 * n_params
+    (matmul MACs once through the weights)."""
+    return 2.0 * float(n_params)
+
+
+def mfu_pct(flops_per_sec: float, peak: Optional[float] = None) -> Optional[float]:
+    """Achieved model-FLOPs utilization in percent, or None when the
+    device peak is unknown (CPU CI, new chip generations)."""
+    p = device_peak_flops() if peak is None else peak
+    if not p:
+        return None
+    return 100.0 * flops_per_sec / p
+
+
+def _reset_cache_for_tests() -> None:
+    global _cached_name
+    with _lock:
+        _cached_name = None

@@ -44,6 +44,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pathway_tpu.internals import utilization
 from pathway_tpu.internals.metrics import MetricsRegistry
 
 
@@ -111,9 +112,15 @@ class DevicePipeline:
         )
         # mesh backend: dispatches are SPMD across dp replicas, so every
         # replica holds its own copy of the in-flight window; meta may
-        # carry "replica_rows" for the per-replica /status gauges
+        # carry "replica_rows" / "replica_real_tokens" /
+        # "replica_slab_tokens" for the per-replica /status gauges
         self.replicas = max(1, int(replicas))
         self._replica_rows = [0] * self.replicas
+        self._replica_real = [0] * self.replicas
+        self._replica_slab = [0] * self.replicas
+        # completion-to-completion device-time estimate (see
+        # internals/utilization.py module docstring)
+        self._last_completion = 0.0
         workers = prep_workers or _env_int("PATHWAY_PIPELINE_PREP_WORKERS", 2)
         self._pool = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix=f"{name}-prep"
@@ -175,9 +182,10 @@ class DevicePipeline:
             with self._cond:
                 if not self._inflight:
                     break
-                handle = self._inflight.popleft()
+                handle, disp_end, meta = self._inflight.popleft()
             waited = True
             self._wait(handle)
+            self._note_completion(disp_end, meta)
         if self._quiesce is not None:
             self._quiesce()
             waited = True
@@ -185,6 +193,10 @@ class DevicePipeline:
             self._drains += 1
             if waited:
                 self._note_span("pipeline:drain", t0, 0)
+        if waited and utilization.ENABLED:
+            utilization.tracker().note_span(
+                "drain", time.perf_counter() - t0
+            )
 
     def take_failed(self) -> List[Any]:
         """Return (and clear) the items that never made it to the device,
@@ -246,9 +258,22 @@ class DevicePipeline:
                     "in_flight": in_flight,
                     "queue_depth": len(self._pending),
                     "occupancy": in_flight / self.max_in_flight,
+                    "real_tokens": self._replica_real[r],
+                    "slab_tokens": self._replica_slab[r],
+                    "pad_waste_ratio": (
+                        1.0 - self._replica_real[r] / self._replica_slab[r]
+                        if self._replica_slab[r]
+                        else None
+                    ),
                 }
                 for r in range(self.replicas)
             ]
+
+    def replica_tokens(self) -> List[Tuple[int, int]]:
+        """Per-replica (real_tokens, slab_tokens) for the labeled
+        pad-waste gauge."""
+        with self._cond:
+            return list(zip(self._replica_real, self._replica_slab))
 
     # -- internals ---------------------------------------------------------
 
@@ -263,11 +288,71 @@ class DevicePipeline:
     def _note_span(self, kind: str, t0: float, rows: int) -> None:
         self._spans.append((kind, t0, time.perf_counter() - t0, rows))
 
+    def _note_completion(self, disp_end: float, meta: Dict[str, Any]) -> None:
+        """A waited handle finished executing: estimate its device busy
+        interval (completion-to-completion; dispatches execute in-order)
+        and feed the utilization window + the mesh straggler detector."""
+        t_end = time.perf_counter()
+        with self._cond:
+            device_s = max(0.0, t_end - max(self._last_completion, disp_end))
+            self._last_completion = t_end
+            self._spans.append(
+                (
+                    "pipeline:device",
+                    t_end - device_s,
+                    device_s,
+                    int(meta.get("rows", 0)),
+                )
+            )
+        if utilization.ENABLED:
+            utilization.tracker().note_span("device", device_s)
+            if self.replicas > 1:
+                from pathway_tpu.internals.mesh_backend import active_backend
+
+                backend = active_backend()
+                if backend is not None:
+                    backend.note_dispatch_device_time(
+                        device_s, meta.get("replica_rows")
+                    )
+
+    def _account_replicas(
+        self, meta: Dict[str, Any], rows: int, real: int, slab: int
+    ) -> None:
+        """Per-replica row/token accounting (caller holds _cond).  The
+        dp-grouped prepare stage reports exact per-replica counts; a
+        single-replica pipeline books everything on replica 0; a mesh
+        pipeline without per-replica detail spreads tokens evenly (slab
+        rows per replica ARE equal by construction — pack_batch_dp pads
+        groups to a common block)."""
+        for r, n in enumerate(meta.get("replica_rows") or ()):
+            if r < self.replicas:
+                self._replica_rows[r] += int(n)
+        if self.replicas == 1:
+            self._replica_rows[0] = self._rows
+            self._replica_real[0] += real
+            self._replica_slab[0] += slab
+            return
+        rr = meta.get("replica_real_tokens")
+        rs = meta.get("replica_slab_tokens")
+        if rr is not None and rs is not None:
+            for r in range(min(self.replicas, len(rr))):
+                self._replica_real[r] += int(rr[r])
+                self._replica_slab[r] += int(rs[r])
+        else:
+            for r in range(self.replicas):
+                self._replica_real[r] += real // self.replicas
+                self._replica_slab[r] += slab // self.replicas
+
     def _prep_timed(self, item: Any) -> Tuple[Any, Dict[str, Any]]:
         t0 = time.perf_counter()
         payload, meta = self._prepare(item)
+        dur = time.perf_counter() - t0
         with self._cond:
-            self._note_span("pipeline:prep", t0, int(meta.get("rows", 0)))
+            self._spans.append(
+                ("pipeline:prep", t0, dur, int(meta.get("rows", 0)))
+            )
+        if utilization.ENABLED:
+            utilization.tracker().note_span("prep", dur)
         return payload, meta
 
     def _run(self) -> None:
@@ -287,26 +372,39 @@ class DevicePipeline:
                     with self._cond:
                         if len(self._inflight) < self.max_in_flight:
                             break
-                        handle = self._inflight.popleft()
+                        handle, disp_end, old_meta = self._inflight.popleft()
                     t0 = time.perf_counter()
                     self._wait(handle)
+                    wait_dur = time.perf_counter() - t0
                     with self._cond:
-                        self._note_span("pipeline:wait", t0, 0)
+                        self._spans.append(("pipeline:wait", t0, wait_dur, 0))
+                    if utilization.ENABLED:
+                        utilization.tracker().note_span("wait", wait_dur)
+                    self._note_completion(disp_end, old_meta)
                 t0 = time.perf_counter()
                 handle = self._dispatch(payload)
+                disp_end = time.perf_counter()
+                rows = int(meta.get("rows", 0))
+                real = int(meta.get("real_tokens", 0))
+                slab = int(meta.get("slab_tokens", 0))
                 with self._cond:
-                    self._note_span(
-                        "pipeline:dispatch", t0, int(meta.get("rows", 0))
+                    self._spans.append(
+                        ("pipeline:dispatch", t0, disp_end - t0, rows)
                     )
-                    self._inflight.append(handle)
+                    self._inflight.append((handle, disp_end, meta))
                     self._dispatched = seq
-                    self._rows += int(meta.get("rows", 0))
-                    self._real_tokens += int(meta.get("real_tokens", 0))
-                    self._slab_tokens += int(meta.get("slab_tokens", 0))
-                    for r, n in enumerate(meta.get("replica_rows") or ()):
-                        if r < self.replicas:
-                            self._replica_rows[r] += int(n)
+                    self._rows += rows
+                    self._real_tokens += real
+                    self._slab_tokens += slab
+                    self._account_replicas(meta, rows, real, slab)
                     self._cond.notify_all()
+                if utilization.ENABLED:
+                    t = utilization.tracker()
+                    t.note_span("dispatch", disp_end - t0)
+                    t.note_batch(
+                        rows, real, slab,
+                        float(meta.get("useful_flops", 0.0)),
+                    )
             except BaseException as exc:  # noqa: BLE001 — parked for replay
                 with self._cond:
                     self._failed.append(item)
@@ -354,11 +452,53 @@ def _occupancy() -> Optional[float]:
     return sum(p.stats()["in_flight"] for p in pipes) / cap
 
 
+def _by_replica(values_of_pipe) -> List[Tuple[Tuple[str], float]]:
+    """Aggregate a per-pipeline list of per-replica numbers into labeled
+    gauge samples [(("<replica>",), value), ...].  A 4-replica mesh run
+    reports 4 series instead of collapsing into one number; the classic
+    single-device pipeline reports replica="0"."""
+    acc: Dict[int, float] = {}
+    for p in list(_PIPELINES):
+        for r, v in enumerate(values_of_pipe(p)):
+            if v is None:
+                continue
+            acc[r] = acc.get(r, 0.0) + v
+    return [((str(r),), acc[r]) for r in sorted(acc)]
+
+
+def _pad_waste_by_replica() -> List[Tuple[Tuple[str], float]]:
+    real: Dict[int, int] = {}
+    slab: Dict[int, int] = {}
+    for p in list(_PIPELINES):
+        for r, (re, sl) in enumerate(p.replica_tokens()):
+            real[r] = real.get(r, 0) + re
+            slab[r] = slab.get(r, 0) + sl
+    return [
+        ((str(r),), 1.0 - real[r] / slab[r])
+        for r in sorted(slab)
+        if slab[r]
+    ]
+
+
+def _occupancy_by_replica() -> List[Tuple[Tuple[str], float]]:
+    in_flight: Dict[int, int] = {}
+    cap: Dict[int, int] = {}
+    for p in list(_PIPELINES):
+        n = p.stats()["in_flight"]
+        for r in range(p.replicas):
+            in_flight[r] = in_flight.get(r, 0) + n
+            cap[r] = cap.get(r, 0) + p.max_in_flight
+    return [
+        ((str(r),), in_flight[r] / cap[r]) for r in sorted(cap) if cap[r]
+    ]
+
+
 _REGISTRY.gauge(
     "pathway_device_pad_waste_ratio",
     help="Fraction of dispatched slab tokens that were padding "
-    "(pipelined ingest batches, cumulative)",
-    callback=_pad_waste,
+    "(pipelined ingest batches, cumulative, per dp replica)",
+    labels=("replica",),
+    callback=_pad_waste_by_replica,
 )
 _REGISTRY.gauge(
     "pathway_device_pipeline_queue_depth",
@@ -367,13 +507,19 @@ _REGISTRY.gauge(
 )
 _REGISTRY.gauge(
     "pathway_device_pipeline_in_flight",
-    help="Batches dispatched to the device and not yet retired",
-    callback=lambda: _sum_stat("in_flight"),
+    help="Batches dispatched to the device and not yet retired "
+    "(per dp replica; SPMD dispatches occupy every replica's window)",
+    labels=("replica",),
+    callback=lambda: _by_replica(
+        lambda p: [p.stats()["in_flight"]] * p.replicas
+    ),
 )
 _REGISTRY.gauge(
     "pathway_device_pipeline_occupancy",
-    help="In-flight batches over the double-buffer window (0..1)",
-    callback=_occupancy,
+    help="In-flight batches over the double-buffer window (0..1, "
+    "per dp replica)",
+    labels=("replica",),
+    callback=_occupancy_by_replica,
 )
 _REGISTRY.gauge(
     "pathway_device_pipeline_fallbacks_total",

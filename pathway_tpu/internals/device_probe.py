@@ -226,7 +226,19 @@ def device_status() -> Dict[str, Any]:
         return {"status": "disabled"}
     if _monitor is None:
         return {"status": "not_started"}
-    return dict(_monitor.last)
+    out = dict(_monitor.last)
+    # roofline context for the utilization gauges — only when jax is
+    # already initialized in this process (this module otherwise probes
+    # via a SUBPROCESS exactly so a wedged backend can't hang /status)
+    import sys as _sys
+
+    if "jax" in _sys.modules:
+        from pathway_tpu.internals import costmodel
+
+        peak = costmodel.device_peak_flops()
+        if peak:
+            out["peak_tflops_bf16"] = round(peak / 1e12, 1)
+    return out
 
 
 def device_degraded() -> bool:

@@ -33,6 +33,11 @@ Kinds:
       containing SUBSTR) raise :class:`InjectedStoreFailure`.
   device_flap@probes=N
       the next N device-health probes report unhealthy.
+  slow_replica@replica=R,factor=F[,count=N]
+      the mesh backend's per-replica device-time accounting charges
+      replica R F-times its real share — a deterministic straggler for
+      the skew detector.  Persistent unless count=N bounds it to the
+      next N dispatches.
 """
 
 from __future__ import annotations
@@ -180,6 +185,32 @@ def store_put(key: str) -> None:
             raise InjectedStoreFailure(
                 f"injected store failure on {key!r} ({d!r})"
             )
+
+
+def replica_factor(replica: int) -> float:
+    """Mesh per-replica device-time hook: the multiplier a slow_replica
+    directive applies to `replica`'s charged device time (1.0 when none
+    matches).  Directives without count= are persistent; with count=N
+    the budget decrements once per dispatch."""
+    with _lock:
+        for d in _directives:
+            if d.kind != "slow_replica":
+                continue
+            if d.iparam("replica", -1) != int(replica):
+                continue
+            if "count" in d.params:
+                if d.remaining <= 0:
+                    continue
+                d.remaining -= 1
+            try:
+                factor = float(d.params.get("factor", "4"))
+            except ValueError:
+                factor = 4.0
+            if not d.fired:
+                d.fired = True
+                _record("slow_replica", replica=int(replica), factor=factor)
+            return factor
+    return 1.0
 
 
 def probe_flap() -> bool:

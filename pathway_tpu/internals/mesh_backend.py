@@ -40,13 +40,32 @@ Degradation rules (documented in ARCHITECTURE.md "Mesh backend"):
 
 from __future__ import annotations
 
+import collections
 import logging
+import os
 import threading
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+import time
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# Straggler detection knobs (documented in ARCHITECTURE.md "Device
+# utilization"): a replica whose windowed device-seconds exceed the
+# replica mean by SKEW_THRESHOLD for PATIENCE consecutive dispatches is
+# flagged — flight-recorder event + warn-once log.
+SKEW_THRESHOLD = _env_float("PATHWAY_MESH_SKEW_THRESHOLD", 1.5)
+SKEW_PATIENCE = int(_env_float("PATHWAY_MESH_SKEW_PATIENCE", 3))
+SKEW_WINDOW_S = _env_float("PATHWAY_MESH_SKEW_WINDOW_S", 30.0)
 
 
 class MeshBackend:
@@ -63,6 +82,34 @@ class MeshBackend:
         self.tp = int(mesh.shape[self.tp_axis]) if self.tp_axis else 1
         self._lock = threading.Lock()
         self._degraded_replicas: set[int] = set()
+        # -- per-dp-replica device-time accounting (utilization PR) ----
+        from pathway_tpu.internals.metrics import (
+            FlightRecorder,
+            MetricsRegistry,
+        )
+
+        self.metrics = MetricsRegistry(worker="0")
+        self._device_hist = self.metrics.histogram(
+            "pathway_mesh_replica_device_seconds",
+            help="Estimated per-dispatch device time attributed to each "
+            "dp replica (work-share weighted; see utilization.py)",
+            labels=("replica",),
+        )
+        self.metrics.gauge(
+            "pathway_mesh_replica_skew_ratio",
+            help="Max replica windowed device-seconds over the replica "
+            "mean (1.0 = balanced; straggler flagged above "
+            "PATHWAY_MESH_SKEW_THRESHOLD)",
+            callback=self._skew_ratio_or_none,
+        )
+        self.recorder = FlightRecorder(capacity=128)
+        # rolling (t, seconds) per replica for the skew window
+        self._device_window: List[Deque[Tuple[float, float]]] = [
+            collections.deque() for _ in range(self.dp)
+        ]
+        self._skew_streak = 0
+        self._straggler: Optional[Dict[str, Any]] = None
+        self._straggler_warned = False
 
     # -- sharding contract -------------------------------------------------
 
@@ -94,6 +141,96 @@ class MeshBackend:
                 shard = hash(key)
         return int(shard) % self.dp
 
+    # -- per-replica device time + straggler detection ---------------------
+
+    def note_dispatch_device_time(
+        self, device_s: float, replica_rows: Optional[Sequence[int]] = None
+    ) -> None:
+        """One pipelined dispatch completed after an estimated
+        `device_s` of device time.  The dispatch is one SPMD program —
+        wall time is shared — so each replica is charged its WORK share
+        (rows_r * dp / total_rows): a replica persistently carrying more
+        rows than its peers is the straggler that sets the slab height
+        every other replica pads to.  The `slow_replica` fault directive
+        (internals/faults.py) inflates a replica's charge for tests."""
+        from pathway_tpu.internals import faults
+
+        dp = self.dp
+        rows = list(replica_rows or [])
+        total = float(sum(rows)) if rows else 0.0
+        now = time.monotonic()
+        shares = []
+        for r in range(dp):
+            share = device_s
+            if total > 0 and r < len(rows):
+                share = device_s * rows[r] * dp / total
+            if faults.ACTIVE:
+                share *= faults.replica_factor(r)
+            shares.append(share)
+        with self._lock:
+            horizon = now - SKEW_WINDOW_S
+            for r, share in enumerate(shares):
+                self._device_hist.labels(str(r)).observe(share)
+                dq = self._device_window[r]
+                dq.append((now, share))
+                while dq and dq[0][0] < horizon:
+                    dq.popleft()
+            self._check_straggler_locked()
+
+    def _windowed_device_s_locked(self) -> List[float]:
+        return [sum(s for _, s in dq) for dq in self._device_window]
+
+    def _skew_ratio_or_none(self) -> Optional[float]:
+        with self._lock:
+            sums = self._windowed_device_s_locked()
+        total = sum(sums)
+        if not total or self.dp < 2:
+            return None
+        return max(sums) / (total / self.dp)
+
+    def _check_straggler_locked(self) -> None:
+        sums = self._windowed_device_s_locked()
+        total = sum(sums)
+        if not total or self.dp < 2:
+            return
+        mean = total / self.dp
+        worst = max(range(self.dp), key=lambda r: sums[r])
+        ratio = sums[worst] / mean
+        if ratio < SKEW_THRESHOLD:
+            self._skew_streak = 0
+            self._straggler = None
+            return
+        self._skew_streak += 1
+        if self._skew_streak < SKEW_PATIENCE:
+            return
+        self._straggler = {
+            "replica": worst,
+            "skew_ratio": round(ratio, 3),
+            "window_device_s": round(sums[worst], 6),
+            "streak": self._skew_streak,
+        }
+        if self._skew_streak == SKEW_PATIENCE:
+            self.recorder.record(
+                "replica_straggler",
+                name=f"replica {worst}",
+                node=worst,
+                duration_s=sums[worst],
+            )
+        if not self._straggler_warned:
+            self._straggler_warned = True
+            logger.warning(
+                "dp replica %d is a persistent straggler: windowed "
+                "device time %.3fs is %.2fx the replica mean over %d "
+                "consecutive dispatches (threshold %.2fx) — rebalance "
+                "ingest routing or check the chip",
+                worst, sums[worst], ratio, self._skew_streak,
+                SKEW_THRESHOLD,
+            )
+
+    def straggler(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return dict(self._straggler) if self._straggler else None
+
     # -- degradation bookkeeping -------------------------------------------
 
     def note_replica_degraded(self, replica: int) -> None:
@@ -114,6 +251,8 @@ class MeshBackend:
         from pathway_tpu.internals.device_pipeline import replica_status
 
         dev0 = self.mesh.devices.flat[0]
+        with self._lock:
+            window = [round(s, 6) for s in self._windowed_device_s_locked()]
         return {
             "active": True,
             "axes": dict(self.spec.to_dict()),
@@ -124,6 +263,11 @@ class MeshBackend:
             "sharded_ingest": self.can_shard_ingest(),
             "degraded_replicas": self.degraded_replicas(),
             "replicas": replica_status(self.dp),
+            # per-replica windowed device time + straggler verdict
+            "replica_device_s": window,
+            "skew_ratio": self._skew_ratio_or_none(),
+            "straggler": self.straggler(),
+            "events": self.recorder.tail(),
         }
 
 

@@ -202,6 +202,32 @@ class StatsMonitor:
                     table.add_row(
                         "device pad waste", f"{100.0 * waste:.1f}%"
                     )
+            # live utilization (internals/utilization.py): rolling MFU,
+            # tokens/s, and where the window's wall time went
+            from pathway_tpu.internals import utilization
+
+            if utilization.ENABLED:
+                snap_u = utilization.tracker().snapshot()
+                if snap_u["dispatches"]:
+                    row = (
+                        f"tokens/s={snap_u['tokens_per_sec']:.0f}"
+                        f" docs/s={snap_u['docs_per_sec']:.1f}"
+                        f" [{snap_u['bound_state']}]"
+                    )
+                    if snap_u["mfu_pct"] is not None:
+                        row = f"mfu={snap_u['mfu_pct']:.1f}% " + row
+                    table.add_row("device utilization", row)
+            from pathway_tpu.internals.mesh_backend import active_backend
+
+            backend = active_backend()
+            if backend is not None:
+                skew = backend._skew_ratio_or_none()
+                if skew is not None:
+                    row = f"skew={skew:.2f}x"
+                    straggler = backend.straggler()
+                    if straggler:
+                        row += f" STRAGGLER replica {straggler['replica']}"
+                    table.add_row("mesh replica balance", row)
             # critical-path attribution for the latest sampled epoch
             tr = getattr(m, "trace", None)
             cp = tr.critical_path() if tr is not None else None
@@ -312,6 +338,18 @@ class PrometheusServer:
         from pathway_tpu.internals.device_pipeline import pipeline_metrics
 
         add(pipeline_metrics())
+        # live utilization gauges (MFU / tokens-per-sec / bound state;
+        # internals/utilization.py)
+        from pathway_tpu.internals.utilization import utilization_metrics
+
+        add(utilization_metrics())
+        # per-dp-replica device-time histograms + skew gauge when a mesh
+        # backend is active (internals/mesh_backend.py)
+        from pathway_tpu.internals.mesh_backend import active_backend
+
+        backend = active_backend()
+        if backend is not None:
+            add(backend.metrics)
         return regs
 
     def metrics_text(self) -> str:
@@ -384,6 +422,7 @@ class PrometheusServer:
         from pathway_tpu.internals.device_probe import device_status
         from pathway_tpu.internals.mesh_backend import mesh_status
         from pathway_tpu.internals.tracing import merged_critical_path
+        from pathway_tpu.internals.utilization import utilization_status
 
         return {
             "worker_count": e0.worker_count,
@@ -399,6 +438,10 @@ class PrometheusServer:
             # async ingest pipeline (internals/device_pipeline.py):
             # queue depth, in-flight window, cumulative pad-waste ratio
             "device_pipeline": pipeline_status(),
+            # live device utilization (internals/utilization.py):
+            # rolling-window MFU, tokens/s, bound-state attribution,
+            # profiler-capture state
+            "utilization": utilization_status(),
             # mesh execution backend (internals/mesh_backend.py): axes,
             # per-dp-replica occupancy/queue gauges; lint-only spec dict
             # when armed without enough devices, None without a mesh
@@ -445,6 +488,30 @@ class PrometheusServer:
             )
         return out
 
+    def _profile_request(self, path: str) -> tuple:
+        """Handle ``/profile?seconds=N[&dir=PATH]``: run one guarded
+        jax.profiler capture and return (http_code, json_payload).  A
+        concurrent second request is rejected with 409 — captures are
+        one at a time, process-wide."""
+        import urllib.parse
+
+        from pathway_tpu.internals import profiler
+
+        query = urllib.parse.parse_qs(urllib.parse.urlparse(path).query)
+        try:
+            seconds = float(query.get("seconds", ["2"])[0])
+        except ValueError:
+            return 400, {"error": "seconds must be a number"}
+        if seconds <= 0:
+            return 400, {"error": "seconds must be positive"}
+        out_dir = query.get("dir", [None])[0]
+        try:
+            result = profiler.capture(seconds, out_dir)
+        except profiler.CaptureBusy as exc:
+            return 409, {"error": str(exc), "active": profiler.profiler_status()["active"]}
+        code = 200 if "error" not in result else 500
+        return code, result
+
     def start(self) -> None:
         # arm the periodic device-health probe alongside the endpoint
         # (no-op when PATHWAY_DEVICE_PROBE=0; one monitor per process)
@@ -455,6 +522,7 @@ class PrometheusServer:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
+                code = 200
                 if self.path in ("/metrics", "/"):
                     body = monitor.metrics_text().encode()
                     ctype = "text/plain; version=0.0.4"
@@ -463,11 +531,19 @@ class PrometheusServer:
                         monitor.status_json(), default=str
                     ).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/profile"):
+                    # on-demand jax.profiler capture (one at a time,
+                    # process-wide; internals/profiler.py) — blocks this
+                    # request thread for the capture window, the
+                    # ThreadingHTTPServer keeps /metrics answering
+                    code, payload = monitor._profile_request(self.path)
+                    body = json.dumps(payload, default=str).encode()
+                    ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.end_headers()
                     return
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

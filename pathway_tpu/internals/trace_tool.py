@@ -174,6 +174,28 @@ def render_status(status: dict) -> str:
             + (f" rtt={rtt}ms" if rtt is not None else "")
             + (f" error={device['error']}" if device.get("error") else "")
         )
+    util = status.get("utilization")
+    if util and util.get("enabled") and util.get("dispatches"):
+        mfu = util.get("mfu_pct")
+        lines.append(
+            "utilization: "
+            + (f"mfu={mfu:.1f}% " if mfu is not None else "")
+            + f"tokens/s={util.get('tokens_per_sec', 0):.0f} "
+            + f"docs/s={util.get('docs_per_sec', 0):.1f} "
+            + f"[{util.get('bound_state')}] "
+            + f"window={util.get('window_s')}s"
+        )
+    mesh = status.get("mesh")
+    if mesh and mesh.get("active") and mesh.get("skew_ratio") is not None:
+        line = f"mesh replica skew: {mesh['skew_ratio']:.2f}x"
+        straggler = mesh.get("straggler")
+        if straggler:
+            line += (
+                f" — STRAGGLER replica {straggler.get('replica')}"
+                f" ({straggler.get('skew_ratio')}x over"
+                f" {straggler.get('streak')} dispatches)"
+            )
+        lines.append(line)
     analysis = status.get("analysis")
     if analysis and analysis.get("findings"):
         lines.append(f"analysis findings: {len(analysis['findings'])}")
@@ -192,4 +214,59 @@ def main_status(args) -> int:
         print(json.dumps(status, indent=2, sort_keys=True))
     else:
         print(render_status(status))
+    return 0
+
+
+def main_profile(args) -> int:
+    """Entry point for the cli.py `profile` subcommand.
+
+    Default mode asks a RUNNING job's monitoring server for a capture
+    (``/profile?seconds=N`` — the job records whatever it is doing);
+    ``--device`` captures in THIS process instead, driving a small
+    calibration matmul so the trace shows the attached chip even
+    without a job."""
+    if args.device:
+        from pathway_tpu.internals import profiler
+
+        result = profiler.capture_local(args.seconds, args.out)
+    else:
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        base = args.url or f"http://127.0.0.1:{args.port}"
+        query = {"seconds": args.seconds}
+        if args.out:
+            query["dir"] = args.out
+        url = (
+            base.rstrip("/")
+            + "/profile?"
+            + urllib.parse.urlencode(query)
+        )
+        try:
+            with urllib.request.urlopen(
+                url, timeout=args.seconds + 30.0
+            ) as resp:
+                result = json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            try:
+                result = json.loads(exc.read().decode())
+            except Exception:  # noqa: BLE001
+                result = {"error": str(exc)}
+        except Exception as exc:  # noqa: BLE001 — connection refused etc.
+            print(
+                f"error: could not reach {url}: {exc} — is the job "
+                "running with pw.run(with_http_server=True)?",
+                file=sys.stderr,
+            )
+            return 1
+    if result.get("error"):
+        print(f"error: {result['error']}", file=sys.stderr)
+        return 1
+    print(
+        f"captured {result.get('seconds')}s of device trace "
+        f"({result.get('files', '?')} files) under "
+        f"{result.get('trace_dir')} — inspect with "
+        "`tensorboard --logdir <dir>` or xprof"
+    )
     return 0

@@ -192,9 +192,16 @@ def critical_path_from_events(
                     # pipeline:drain) ride the owning node's idx but are
                     # attributed as their own kind: they run on pipeline
                     # threads CONCURRENT with the tick, so "node" would
-                    # misread as serial engine-loop time
+                    # misread as serial engine-loop time.  The estimated
+                    # per-dispatch device busy interval (pipeline:device,
+                    # internals/utilization.py) gets its own kind — it is
+                    # CHIP time, not host pipeline time
                     "kind": (
-                        "pipeline" if name.startswith("pipeline:") else "node"
+                        "device"
+                        if name == "pipeline:device"
+                        else "pipeline"
+                        if name.startswith("pipeline:")
+                        else "node"
                     ),
                     "worker": w,
                     "node": idx,

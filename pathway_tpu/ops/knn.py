@@ -531,7 +531,7 @@ class FusedEmbedSearch:
         keys = list(keys)
         packable = self.index.mesh is None or self.backend is not None
         budget = pack_token_budget() if pack and packable else 0
-        replica_rows = None
+        replica_rows = replica_real = replica_slab = None
         if budget > 0 and texts and self.backend is not None:
             # mesh backend: pack PER dp SHARD so each replica's rows land
             # on its devices under the batch NamedSharding
@@ -548,6 +548,17 @@ class FusedEmbedSearch:
             )
             payload = ("packed_dp", keys, ids, seg, slots)
             real, total = int(np.count_nonzero(seg)), int(seg.size)
+            # per-replica token counts for the labeled pad-waste gauge
+            # and the straggler detector: slab rows land on replica
+            # r // block by construction (pack_batch_dp pads groups to
+            # a common block)
+            dp = self.backend.dp
+            block = seg.shape[0] // dp
+            replica_real = [
+                int(np.count_nonzero(seg[r * block : (r + 1) * block]))
+                for r in range(dp)
+            ]
+            replica_slab = [int(block * seg.shape[1])] * dp
         elif budget > 0 and texts:
             ids, seg, slots = pack_batch(
                 self.encoder.tokenizer,
@@ -564,13 +575,23 @@ class FusedEmbedSearch:
             )
             payload = ("classic", keys, ids, mask, None)
             real, total = int(np.asarray(mask).sum()), int(mask.size)
+        from pathway_tpu.internals import costmodel
+
         meta = {
             "rows": len(keys),
             "real_tokens": real,
             "slab_tokens": total,
+            # mask-aware useful FLOPs for the live MFU gauge
+            # (internals/utilization.py); padding is not useful work
+            "useful_flops": costmodel.encoder_flops_for_config(
+                self.encoder.config, real, len(keys)
+            ),
         }
         if replica_rows is not None:
             meta["replica_rows"] = replica_rows
+        if replica_real is not None:
+            meta["replica_real_tokens"] = replica_real
+            meta["replica_slab_tokens"] = replica_slab
         return payload, meta
 
     def dispatch_batch(self, payload):
