@@ -712,6 +712,7 @@ def _fallback_payload(err: str, device_status: dict) -> dict:
         "tracing_overhead": _tracing_overhead(),
         "failover_recovery_s": _failover_recovery_s(),
         **_multichip_facts(),
+        **_memory_facts(),
     }
 
 
@@ -871,6 +872,7 @@ def _run_device_round(device_status: dict) -> None:
                 ),
                 **_generation_facts(),
                 **_multichip_facts(),
+                **_memory_facts(),
             }
         )
     )
@@ -926,6 +928,67 @@ def _multichip_facts() -> dict:
         return {"multichip": json.loads(line)}
     except Exception as exc:  # noqa: BLE001 — never sink the main bench
         return {"multichip": {"error": f"{type(exc).__name__}: {exc}"}}
+
+
+def _memory_facts() -> dict:
+    """The `memory` section: peak HBM of the round just measured, the
+    per-component memtrack attribution, and the accounting-vs-backend
+    cross-check.  Same never-null rule as the headline value (BENCH r05):
+    every numeric field is a number with a `*_source` naming where it
+    came from — `0.0` + source "unavailable" when the backend reports no
+    memory stats (CPU), never null."""
+    try:
+        from pathway_tpu.internals import memtrack
+
+        out: dict = {"enabled": memtrack.ENABLED}
+        if not memtrack.ENABLED:
+            out.update(
+                peak_hbm_bytes=0.0,
+                peak_source="disabled",
+                components={},
+                predicted_vs_measured=0.0,
+                predicted_vs_measured_source="disabled",
+            )
+            return {"memory": out}
+        snap = memtrack.tracker().snapshot()
+        tracked = float(snap["device_hbm_bytes"])
+        stats = memtrack.jax_memory_stats()
+        peak = (stats or {}).get("peak_bytes_in_use")
+        if peak is not None:
+            out["peak_hbm_bytes"] = float(peak)
+            out["peak_source"] = "jax_memory_stats"
+        else:
+            # CPU backends report no memory stats; the tracked logical
+            # per-device bytes are the best available number
+            out["peak_hbm_bytes"] = round(tracked, 1)
+            out["peak_source"] = "memtrack"
+        out["tracked_device_hbm_bytes"] = round(tracked, 1)
+        out["components"] = {
+            name: round(c["bytes"], 1)
+            for name, c in sorted(snap["components"].items())
+        }
+        in_use = (stats or {}).get("bytes_in_use")
+        if in_use:
+            # tracked (predicted-by-accounting) over backend-measured:
+            # <1 because XLA holds scratch/compile buffers we don't claim
+            out["predicted_vs_measured"] = round(tracked / in_use, 4)
+            out["predicted_vs_measured_source"] = "jax_memory_stats"
+        else:
+            out["predicted_vs_measured"] = 0.0
+            out["predicted_vs_measured_source"] = "unavailable"
+        return {"memory": out}
+    except Exception as exc:  # noqa: BLE001 — never sink the main bench
+        return {
+            "memory": {
+                "enabled": False,
+                "peak_hbm_bytes": 0.0,
+                "peak_source": "error",
+                "components": {},
+                "predicted_vs_measured": 0.0,
+                "predicted_vs_measured_source": "error",
+                "error": f"{type(exc).__name__}: {exc}",
+            }
+        }
 
 
 def _device_name() -> str:

@@ -23,6 +23,7 @@ from pathway_tpu.analysis.diagnostics import (
     Severity,
     make_diag,
 )
+from pathway_tpu.analysis.capacity import capacity_pass, verify_capacity
 from pathway_tpu.analysis.fusion import FusionChain, FusionPlan, plan_fusion
 from pathway_tpu.analysis.graph import GraphView
 from pathway_tpu.analysis.mesh import MeshSpec
@@ -89,6 +90,7 @@ def analyze(
     embedder_pass(view, result, workers=workers)
     fusion_pass(view, result)
     mesh_pass(view, result, mesh=mesh, workers=workers)
+    capacity_pass(view, result, mesh=mesh, workers=workers)
     return result
 
 
@@ -104,8 +106,10 @@ __all__ = [
     "SCHEMA_VERSION",
     "Severity",
     "analyze",
+    "capacity_pass",
     "make_diag",
     "plan_fusion",
     "verify_against_plan",
+    "verify_capacity",
     "verify_fusion",
 ]

@@ -64,12 +64,20 @@ CODES: Dict[str, tuple] = {
     "PWT503": (Severity.INFO, "fusion chain broken by fan-out"),
     "PWT504": (Severity.INFO, "UDF barrier blocks chain fusion"),
     "PWT599": (Severity.ERROR, "fusion plan disagrees with built nodes"),
+    # PWT6xx — memory / capacity planning
+    "PWT601": (Severity.INFO, "predicted device-memory footprint"),
+    "PWT602": (Severity.WARNING, "external index without capacity info"),
+    "PWT603": (Severity.ERROR, "predicted footprint exceeds device memory"),
+    "PWT604": (Severity.WARNING, "predicted HBM headroom below threshold"),
+    "PWT605": (Severity.INFO, "encoder params replicated per dp replica"),
+    "PWT699": (Severity.ERROR, "capacity plan disagrees with live accounting"),
 }
 
 # JSON schema version for analyze --json payloads and the golden matrix.
 # Bump when the payload shape changes (v2: schema_version stamp itself,
-# deterministic finding order, the "fusion" plan section).
-SCHEMA_VERSION = 2
+# deterministic finding order, the "fusion" plan section; v3: the
+# "capacity" plan section).
+SCHEMA_VERSION = 3
 
 
 def _trace_to_dict(trace: Any) -> Optional[Dict[str, Any]]:
@@ -178,6 +186,9 @@ class AnalysisResult:
     # serialized dict or the live FusionPlan object (serialized lazily
     # on first read — the common pw.run path never reads it)
     _fusion: Any = field(default=None, repr=False)
+    # capacity-plan section (analysis/capacity.py): predicted per-index /
+    # per-device byte breakdown; None when the graph has no external index
+    capacity: Optional[Dict[str, Any]] = None
 
     @property
     def fusion(self) -> Optional[Dict[str, Any]]:
@@ -213,16 +224,21 @@ class AnalysisResult:
             "findings": [f.to_dict() for f in self.sorted_findings()],
             "predictions": [dict(p) for p in self.predictions],
             "fusion": dict(self.fusion) if self.fusion is not None else None,
+            "capacity": (
+                dict(self.capacity) if self.capacity is not None else None
+            ),
             "summary": self.counts(),
         }
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "AnalysisResult":
         fusion = d.get("fusion")
+        capacity = d.get("capacity")
         return cls(
             findings=[Diagnostic.from_dict(f) for f in d.get("findings", [])],
             predictions=[dict(p) for p in d.get("predictions", [])],
             _fusion=dict(fusion) if fusion is not None else None,
+            capacity=dict(capacity) if capacity is not None else None,
         )
 
     def render_text(self) -> str:

@@ -58,6 +58,18 @@ DEVICE_HBM_BYTES_PER_SEC: Dict[str, float] = {
     "v6": 1640e9,
 }
 
+# Published per-chip HBM capacity, same keying.  Consumed by the memory
+# tracker's headroom/forecast math and the PWT6xx capacity-planning pass
+# (both through memtrack.hbm_capacity_bytes, the single resolution
+# order); unknown devices return 0.0 and consumers report None.
+DEVICE_HBM_BYTES: Dict[str, float] = {
+    "v5 lite": 16e9,  # v5e: 16 GB
+    "v5e": 16e9,
+    "v5p": 95e9,
+    "v4": 32e9,
+    "v6": 32e9,  # trillium
+}
+
 _lock = threading.Lock()
 _cached_name: Optional[str] = None
 
@@ -94,6 +106,41 @@ def device_peak_flops(name: Optional[str] = None) -> float:
 def device_hbm_bytes_per_sec(name: Optional[str] = None) -> float:
     """HBM bytes/s of `name` (default: the attached chip); 0.0 unknown."""
     return _lookup(DEVICE_HBM_BYTES_PER_SEC, name)
+
+
+def device_hbm_bytes(name: Optional[str] = None) -> float:
+    """HBM capacity in bytes of `name` (default: the attached chip);
+    0.0 for unknown devices — consumers report headroom as None."""
+    return _lookup(DEVICE_HBM_BYTES, name)
+
+
+def encoder_param_count(
+    *,
+    vocab_size: int,
+    hidden: int,
+    layers: int,
+    mlp_dim: int,
+    max_len: int,
+) -> int:
+    """Exact parameter count of models/transformer.init_params for this
+    geometry: embed (v,h) + pos_embed (max_len,h) + final LN 2h, and per
+    layer two LNs (4h), qkv (3h^2)+3h, out (h^2)+h, up (h*m)+m, down
+    (m*h)+h.  Kept in lockstep with init_params — the PWT699 parity gate
+    compares this prediction against live leaf sizes."""
+    h, m = hidden, mlp_dim
+    per_layer = 4 * h * h + 2 * h * m + 9 * h + m
+    return vocab_size * h + max_len * h + 2 * h + layers * per_layer
+
+
+def encoder_param_bytes(config: Any) -> int:
+    """Parameter bytes (float32) for a TransformerConfig-shaped object."""
+    return 4 * encoder_param_count(
+        vocab_size=int(getattr(config, "vocab_size", 30522)),
+        hidden=int(getattr(config, "hidden", MINILM_HIDDEN)),
+        layers=int(getattr(config, "layers", MINILM_LAYERS)),
+        mlp_dim=int(getattr(config, "mlp_dim", MINILM_MLP_DIM)),
+        max_len=int(getattr(config, "max_len", 512)),
+    )
 
 
 def encoder_flops_per_token(

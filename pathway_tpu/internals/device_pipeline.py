@@ -44,7 +44,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from pathway_tpu.internals import utilization
+from pathway_tpu.internals import memtrack, utilization
 from pathway_tpu.internals.metrics import MetricsRegistry
 
 
@@ -293,6 +293,12 @@ class DevicePipeline:
         interval (completion-to-completion; dispatches execute in-order)
         and feed the utilization window + the mesh straggler detector."""
         t_end = time.perf_counter()
+        if memtrack.ENABLED:
+            # the slab's packed arrays retire with the dispatch
+            memtrack.tracker().adjust(
+                "pipeline_inflight", self,
+                -float(meta.get("slab_bytes", 0)),
+            )
         with self._cond:
             device_s = max(0.0, t_end - max(self._last_completion, disp_end))
             self._last_completion = t_end
@@ -384,6 +390,13 @@ class DevicePipeline:
                 t0 = time.perf_counter()
                 handle = self._dispatch(payload)
                 disp_end = time.perf_counter()
+                if memtrack.ENABLED:
+                    # packed slab bytes live on device until the handle
+                    # retires (_note_completion books the -delta)
+                    memtrack.tracker().adjust(
+                        "pipeline_inflight", self,
+                        float(meta.get("slab_bytes", 0)),
+                    )
                 rows = int(meta.get("rows", 0))
                 real = int(meta.get("real_tokens", 0))
                 slab = int(meta.get("slab_tokens", 0))

@@ -233,11 +233,23 @@ def device_status() -> Dict[str, Any]:
     import sys as _sys
 
     if "jax" in _sys.modules:
-        from pathway_tpu.internals import costmodel
+        from pathway_tpu.internals import costmodel, memtrack
 
         peak = costmodel.device_peak_flops()
         if peak:
             out["peak_tflops_bf16"] = round(peak / 1e12, 1)
+        # device memory: the backend's own numbers when it reports them
+        # (CPU devices report no memory stats -> None, the contract every
+        # consumer expects — never a guess)
+        stats = memtrack.jax_memory_stats()
+        out["memory_total_bytes"] = (
+            stats.get("bytes_limit") if stats else None
+        )
+        out["memory_available_bytes"] = (
+            stats["bytes_limit"] - stats["bytes_in_use"]
+            if stats and "bytes_limit" in stats and "bytes_in_use" in stats
+            else None
+        )
     return out
 
 

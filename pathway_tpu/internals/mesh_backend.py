@@ -301,6 +301,11 @@ def activate(spec) -> Optional[MeshBackend]:
         names = tuple(name for name, _ in spec.axes)
         grid = np.asarray(devices[:need], dtype=object).reshape(shape)
         _BACKEND = MeshBackend(spec, Mesh(grid, names))
+        from pathway_tpu.internals import memtrack
+
+        if memtrack.ENABLED:
+            # replica layout for per-replica watermarks / placement math
+            memtrack.tracker().set_topology(_BACKEND.dp, _BACKEND.tp)
         return _BACKEND
 
 
@@ -308,6 +313,10 @@ def deactivate() -> None:
     global _BACKEND
     with _lock:
         _BACKEND = None
+    from pathway_tpu.internals import memtrack
+
+    if memtrack.ENABLED:
+        memtrack.tracker().set_topology(1, 1)
 
 
 def active_backend() -> Optional[MeshBackend]:

@@ -217,6 +217,27 @@ class StatsMonitor:
                     if snap_u["mfu_pct"] is not None:
                         row = f"mfu={snap_u['mfu_pct']:.1f}% " + row
                     table.add_row("device utilization", row)
+            # memory attribution (internals/memtrack.py): who owns HBM
+            # and how long until the index fills it
+            from pathway_tpu.internals import memtrack
+
+            if memtrack.ENABLED:
+                snap_m = memtrack.tracker().snapshot()
+                if snap_m["components"]:
+                    row = f"hbm={snap_m['device_hbm_bytes'] / 2**20:.1f}MiB"
+                    pct = snap_m.get("headroom_pct")
+                    if pct is not None:
+                        row += f" headroom={pct:.1f}%"
+                    parts = ", ".join(
+                        f"{name}={c['bytes'] / 2**20:.1f}MiB"
+                        for name, c in sorted(snap_m["components"].items())
+                    )
+                    table.add_row("device memory", f"{row} ({parts})")
+                    ttf = snap_m["forecast"].get("time_to_full_s")
+                    if ttf is not None:
+                        table.add_row(
+                            "memory time-to-full", f"{ttf:.0f}s"
+                        )
             from pathway_tpu.internals.mesh_backend import active_backend
 
             backend = active_backend()
@@ -343,6 +364,11 @@ class PrometheusServer:
         from pathway_tpu.internals.utilization import utilization_metrics
 
         add(utilization_metrics())
+        # memory attribution gauges (per-component bytes, HBM headroom,
+        # time-to-full forecast; internals/memtrack.py)
+        from pathway_tpu.internals.memtrack import memory_metrics
+
+        add(memory_metrics())
         # per-dp-replica device-time histograms + skew gauge when a mesh
         # backend is active (internals/mesh_backend.py)
         from pathway_tpu.internals.mesh_backend import active_backend
@@ -420,6 +446,7 @@ class PrometheusServer:
         ]
         from pathway_tpu.internals.device_pipeline import pipeline_status
         from pathway_tpu.internals.device_probe import device_status
+        from pathway_tpu.internals.memtrack import memory_status
         from pathway_tpu.internals.mesh_backend import mesh_status
         from pathway_tpu.internals.tracing import merged_critical_path
         from pathway_tpu.internals.utilization import utilization_status
@@ -442,6 +469,10 @@ class PrometheusServer:
             # rolling-window MFU, tokens/s, bound-state attribution,
             # profiler-capture state
             "utilization": utilization_status(),
+            # memory attribution (internals/memtrack.py): per-component
+            # HBM/host bytes, capacity/headroom, ingest-rate time-to-full
+            # forecast, per-replica watermarks, jax cross-check
+            "memory": memory_status(),
             # mesh execution backend (internals/mesh_backend.py): axes,
             # per-dp-replica occupancy/queue gauges; lint-only spec dict
             # when armed without enough devices, None without a mesh

@@ -142,12 +142,14 @@ def _apply_analysis(engine: Engine, mode, mesh=None, baseline=None) -> None:
         Severity,
         analyze,
         verify_against_plan,
+        verify_capacity,
         verify_fusion,
     )
 
     result = analyze(G, workers=engine.worker_count, mesh=mesh)
     verify_against_plan(engine, result)
     verify_fusion(engine, result)
+    verify_capacity(engine, result)
     baseline_info = None
     if baseline:
         from pathway_tpu.analysis.baseline import apply_baseline

@@ -25,6 +25,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from pathway_tpu.internals import memtrack
+
 
 def _format_rows(scores, idx, key_of_slot) -> list:
     """[(key, score)] rows from top-k output, dropping invalid slots."""
@@ -203,9 +205,34 @@ class DeviceKnnIndex:
             self._rebuild_shard_buckets()
         # queued updates: slot -> (vector | None for invalidation)
         self._dirty: dict[int, np.ndarray | None] = {}
+        if memtrack.ENABLED:
+            self._register_memory()
 
     def __len__(self) -> int:
         return len(self._slot_of_key)
+
+    # -- memory accounting (internals/memtrack.py) --------------------------
+
+    def _mem_span(self) -> int:
+        """Devices the slab spreads over: the buffer rows shard on the
+        mesh's first axis (dp), so both the per-device divisor and the
+        per-replica divisor are that axis size."""
+        return self._shard_count() if self.mesh is not None else 1
+
+    def _register_memory(self) -> None:
+        """(Re-)register the slab's LOGICAL bytes — float32 rows + bool
+        valid at the current bucketed capacity.  Upserts on the same
+        owner, so _grow just calls it again after doubling."""
+        span = self._mem_span()
+        memtrack.tracker().register(
+            "knn_index",
+            self,
+            self.capacity * (4 * self.d + 1),
+            device_span=span,
+            dp_shards=span,
+            capacity=self.capacity,
+            dimensions=self.d,
+        )
 
     # -- free-slot bookkeeping (shard-aware under a mesh) -------------------
 
@@ -292,6 +319,8 @@ class DeviceKnnIndex:
             raise ValueError(
                 f"vector dim {vector.shape[0]} != index dim {self.d}"
             )
+        if memtrack.ENABLED and key not in self._slot_of_key:
+            self._note_ingest(1)
         slot = self._assign_slot(key)
         self._dirty[slot] = self._normalize(vector)
 
@@ -305,9 +334,12 @@ class DeviceKnnIndex:
             # keep the batch on device: assign slots, one scatter, no host
             # round trip
             self._flush()
-            while self._free_count() < len(keys) - sum(
+            new = len(keys) - sum(
                 1 for k in keys if k in self._slot_of_key
-            ):
+            )
+            if memtrack.ENABLED and new:
+                self._note_ingest(new)
+            while self._free_count() < new:
                 self._grow()
             slots = np.array(
                 [
@@ -325,9 +357,20 @@ class DeviceKnnIndex:
             )
             return
         vectors = self._normalize(np.asarray(vectors, dtype=np.float32))
+        if memtrack.ENABLED:
+            new = sum(1 for k in keys if k not in self._slot_of_key)
+            if new:
+                self._note_ingest(new)
         for key, vec in zip(keys, vectors):
             slot = self._assign_slot(key)
             self._dirty[slot] = vec
+
+    def _note_ingest(self, new_rows: int) -> None:
+        """Feed the ingest-rate forecaster: each new row will occupy one
+        slab row of (4*d + 1) bytes, divided over the shard span."""
+        memtrack.tracker().note_ingest(
+            new_rows, new_rows * (4 * self.d + 1) / self._mem_span()
+        )
 
     def _assign_slot(self, key, shard: int | None = None) -> int:
         slot = self._slot_of_key.get(key)
@@ -359,6 +402,8 @@ class DeviceKnnIndex:
         self._shard_buffers()
         if self._free_set is not None:
             self._rebuild_shard_buckets()
+        if memtrack.ENABLED:
+            self._register_memory()
 
     def _flush(self) -> None:
         if not self._dirty:
@@ -488,6 +533,25 @@ class FusedEmbedSearch:
         # dp-grouped packed ingest + tp-sharded encoder params; None
         # keeps the single-device path byte-identical
         self.backend = backend
+        if memtrack.ENABLED:
+            # LOGICAL param bytes, keyed on the lm so encoders shared
+            # between FusedEmbedSearch instances count once.  Matmul
+            # params shard over tp within a replica but every dp replica
+            # holds a full copy (dp_shards=1 — the PWT605 story).
+            import jax
+
+            nbytes = sum(
+                int(getattr(leaf, "nbytes", 0))
+                for leaf in jax.tree_util.tree_leaves(encoder.lm.params)
+            )
+            memtrack.tracker().register(
+                "encoder_params",
+                encoder.lm,
+                nbytes,
+                device_span=backend.tp if backend is not None else 1,
+                dp_shards=1,
+                model=type(encoder).__name__,
+            )
 
     def _params(self):
         if self.backend is not None:
@@ -581,6 +645,12 @@ class FusedEmbedSearch:
             "rows": len(keys),
             "real_tokens": real,
             "slab_tokens": total,
+            # exact bytes of the two packed wire arrays (ids + seg/mask)
+            # for the pipeline's in-flight memory accounting
+            "slab_bytes": (
+                int(getattr(payload[2], "nbytes", 0))
+                + int(getattr(payload[3], "nbytes", 0))
+            ),
             # mask-aware useful FLOPs for the live MFU gauge
             # (internals/utilization.py); padding is not useful work
             "useful_flops": costmodel.encoder_flops_for_config(

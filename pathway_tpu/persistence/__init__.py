@@ -18,7 +18,7 @@ import re
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
-from pathway_tpu.internals import faults
+from pathway_tpu.internals import faults, memtrack
 
 
 def _store_fault(key: str) -> None:
@@ -450,6 +450,17 @@ class OperatorSnapshotManager:
                         msg, idx, node.name, exc
                     )
 
+        if memtrack.ENABLED:
+            # host-RAM staging footprint of this save (pickled state
+            # blobs held until the manifest commits); the entry persists
+            # as "what the last snapshot staged" and dies with the manager
+            memtrack.tracker().register(
+                "snapshot_staging",
+                self,
+                sum(len(blob) for _, blob in states),
+                tier="host",
+                nodes=len(states),
+            )
         try:
             return self._save_committed(engine, time, writers, states, skipped)
         except Exception as exc:  # noqa: BLE001 — backend write failed

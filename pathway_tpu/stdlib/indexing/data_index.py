@@ -13,6 +13,7 @@ from pathway_tpu.internals.expression import (
     collect_tables,
     smart_wrap,
 )
+from pathway_tpu.internals.parse_graph import record_op
 from pathway_tpu.internals.schema import (
     ColumnSchema,
     Schema,
@@ -163,6 +164,19 @@ class DataIndex:
             universe=query_table._universe,
             build=build,
         )
+        # capacity annotation for the PWT6xx pass (analysis/capacity.py):
+        # the analyzer predicts the device footprint of this index from
+        # the same numbers the runtime will allocate with
+        record_op(
+            reply,
+            "external_index",
+            (query_table, data_table),
+            index=type(inner).__name__,
+            dimensions=getattr(inner, "dimensions", None),
+            reserved_space=getattr(inner, "reserved_space", None),
+            metric=_metric_name(inner),
+            encoder=_encoder_info(getattr(inner, "embedder", None)),
+        )
         if collapse_rows:
             # zip query columns alongside (same universe)
             out_cols = {}
@@ -189,6 +203,31 @@ class DataIndex:
         for i, name in enumerate(data_names):
             out_cols[name] = flat._pw_pairs.get(2 + i)
         return flat._select_impl(out_cols)
+
+
+def _metric_name(inner: InnerIndex) -> Optional[str]:
+    m = getattr(inner, "metric", None)
+    return getattr(m, "value", m) if m is not None else None
+
+
+def _encoder_info(embedder: Any) -> Optional[dict]:
+    """Geometry of a local JAX encoder (the fused-path criterion in
+    stdlib/indexing/nearest_neighbors._local_jax_encoder), as a plain
+    dict the analyzer can price with costmodel.encoder_param_count.
+    API-backed embedders (no device-resident params) return None."""
+    encoder = getattr(embedder, "encoder", None)
+    if encoder is None or not hasattr(encoder, "lm"):
+        return None
+    cfg = getattr(encoder, "config", None)
+    if cfg is None:
+        return None
+    return {
+        "vocab_size": int(getattr(cfg, "vocab_size", 30522)),
+        "hidden": int(getattr(cfg, "hidden", 0)),
+        "layers": int(getattr(cfg, "layers", 0)),
+        "mlp_dim": int(getattr(cfg, "mlp_dim", 0)),
+        "max_len": int(getattr(cfg, "max_len", 512)),
+    }
 
 
 def _zip_pairs_expr(reply: Table):

@@ -136,10 +136,35 @@ def build_lintful_graph():
         c2.name, s=pw.reducers.sum(c2.v)
     )
 
+    # PWT602: an external index that exposes no embedding dimension —
+    # the capacity pass cannot price it.  record_op is called directly
+    # (the same annotation DataIndex._query records) so the trace stays
+    # in this file and no index is actually built.
+    from pathway_tpu.internals.parse_graph import record_op
+
+    idx_unknown = t.select(name=t.name)
+    record_op(
+        idx_unknown, "external_index", (t,),
+        index="CustomInner", dimensions=None, reserved_space=None,
+        metric=None, encoder=None,
+    )
+    # PWT601+PWT603+PWT605 under dp=3,tp=5: 1M reserved rows at d=384
+    # bucket to 2^20 rows -> ~1.6 GB of slab, overflowing the 256 MiB
+    # PATHWAY_ASSUME_HBM_BYTES ceiling _analyze_lintful pins (PWT603);
+    # the encoder dict replicates per dp replica (PWT605)
+    idx_sized = t.select(name=t.name)
+    record_op(
+        idx_sized, "external_index", (t,),
+        index="BruteForceKnn", dimensions=384, reserved_space=1_000_000,
+        metric="cosine_similarity",
+        encoder={"vocab_size": 30522, "hidden": 384, "layers": 6,
+                 "mlp_dim": 1536, "max_len": 512},
+    )
+
     _sink(
         lossy, bad_cmp, arith, by_float, tup, joined, nd_red, au_red,
         win, it, narrow, emb, stateful, pinned_sel, fan_a, fan_b,
-        chain_red,
+        chain_red, idx_unknown, idx_sized,
     )
     # PWT110: computed after the sinks, read by nobody.  Returned so the
     # caller keeps it alive — the parse graph tracks tables by weakref,
@@ -160,8 +185,18 @@ def _normalized(result):
 def _analyze_lintful():
     dead = build_lintful_graph()
     # dp=3,tp=5 is deliberately hostile: 4 workers don't tile dp=3
-    # (PWT404), 384 % 5 != 0 and 3 is not a power of two (PWT402 x2)
-    result = analyze(G, workers=4, mesh="dp=3,tp=5")
+    # (PWT404), 384 % 5 != 0 and 3 is not a power of two (PWT402 x2).
+    # Pin the HBM ceiling so the PWT6xx capacity findings are identical
+    # on every machine (the resolver would otherwise consult jax).
+    prev = os.environ.get("PATHWAY_ASSUME_HBM_BYTES")
+    os.environ["PATHWAY_ASSUME_HBM_BYTES"] = str(256 * 2**20)
+    try:
+        result = analyze(G, workers=4, mesh="dp=3,tp=5")
+    finally:
+        if prev is None:
+            os.environ.pop("PATHWAY_ASSUME_HBM_BYTES", None)
+        else:
+            os.environ["PATHWAY_ASSUME_HBM_BYTES"] = prev
     del dead
     return result
 
@@ -190,6 +225,7 @@ def test_matrix_covers_enough_codes():
     assert {
         "PWT402", "PWT403", "PWT404", "PWT405",
         "PWT501", "PWT502", "PWT503", "PWT504",
+        "PWT601", "PWT602", "PWT603", "PWT605",
     } <= codes, codes
 
 

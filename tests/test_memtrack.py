@@ -1,0 +1,558 @@
+"""Memory observability (internals/memtrack.py) + the PWT6xx capacity
+pass (analysis/capacity.py).
+
+Covers the memory PR's acceptance contract: component registration /
+release / weakref-prune accounting, the placement divisors (device_span
+vs dp_shards), the time-to-full forecaster pinned against hand-computed
+rates, the warn-once headroom event, Prometheus exposition of the
+pathway_memory_* gauges, the live hook sites (DeviceKnnIndex /
+FusedEmbedSearch / DevicePipeline / snapshots), the PWT601..605
+diagnostics, and the PWT699 predicted-vs-live parity gate on the
+8-device virtual CPU mesh.  PATHWAY_MEMTRACK=0 must be inert — one
+attribute read per hook and no jax import."""
+
+from __future__ import annotations
+
+import gc
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from pathway_tpu.analysis.capacity import (
+    CAPACITY_PARITY_TOLERANCE,
+    _pipeline_inflight_bytes,
+    capacity_pass,
+    predict_index_bytes,
+    verify_capacity,
+)
+from pathway_tpu.analysis.diagnostics import AnalysisResult
+from pathway_tpu.analysis.mesh import MeshSpec
+from pathway_tpu.internals import costmodel, memtrack
+
+
+@pytest.fixture
+def fresh_tracker(monkeypatch):
+    """Fresh tracker scoped to the test; capacity resolution pinned off
+    the env so a developer's PATHWAY_ASSUME_HBM_BYTES cannot leak in."""
+    monkeypatch.delenv("PATHWAY_ASSUME_HBM_BYTES", raising=False)
+    tr = memtrack.reset_for_tests()
+    yield tr
+    memtrack.reset_for_tests()
+
+
+class _Owner:
+    """Weakref-able stand-in for an index / pipeline object."""
+
+
+# ---------------------------------------------------------------------------
+# registry accounting
+# ---------------------------------------------------------------------------
+
+
+def test_register_release_and_placement_divisors(fresh_tracker):
+    tr = fresh_tracker
+    idx, enc = _Owner(), _Owner()
+    # 8000 logical bytes sharded over 4 devices AND 4 dp replicas
+    tr.register("knn_index", idx, 8000, device_span=4, dp_shards=4)
+    # 1000 logical bytes sharded over 2 (tp) devices, replicated per dp
+    tr.register("encoder_params", enc, 1000, device_span=2, dp_shards=1)
+    assert tr.component_bytes() == {
+        ("knn_index", "hbm"): 8000.0,
+        ("encoder_params", "hbm"): 1000.0,
+    }
+    # per-device: 8000/4 + 1000/2; per-replica watermark: 8000/4 + 1000
+    assert tr.device_hbm_bytes() == pytest.approx(2500.0)
+    snap = tr.snapshot()
+    assert snap["hbm_bytes"] == 9000.0
+    assert snap["components"]["knn_index"]["device_bytes"] == 2000.0
+    # re-registering the same owner replaces, never double-counts
+    tr.register("knn_index", idx, 16000, device_span=4, dp_shards=4)
+    assert tr.component_bytes()[("knn_index", "hbm")] == 16000.0
+    tr.release("knn_index", idx)
+    tr.release("encoder_params", enc)
+    assert tr.component_bytes() == {}
+    assert tr.device_hbm_bytes() == 0.0
+
+
+def test_host_tier_is_excluded_from_hbm_math(fresh_tracker):
+    tr = fresh_tracker
+    mgr = _Owner()
+    tr.register("snapshot_staging", mgr, 4096, tier="host")
+    assert tr.device_hbm_bytes() == 0.0
+    snap = tr.snapshot()
+    assert snap["host_bytes"] == 4096.0 and snap["hbm_bytes"] == 0.0
+    assert snap["components"]["snapshot_staging"]["tier"] == "host"
+
+
+def test_dead_owner_prunes_from_accounting(fresh_tracker):
+    tr = fresh_tracker
+    idx = _Owner()
+    tr.register("knn_index", idx, 1024)
+    assert len(tr.entries("knn_index")) == 1
+    del idx
+    gc.collect()
+    assert tr.entries("knn_index") == []
+    assert tr.component_bytes() == {}
+
+
+def test_adjust_inflight_clamps_at_zero(fresh_tracker):
+    tr = fresh_tracker
+    pipe = _Owner()
+    tr.adjust("pipeline_inflight", pipe, 512.0)
+    tr.adjust("pipeline_inflight", pipe, 512.0)
+    assert tr.component_bytes()[("pipeline_inflight", "hbm")] == 1024.0
+    # over-release (completion raced a reset) floors at zero, never negative
+    tr.adjust("pipeline_inflight", pipe, -4096.0)
+    assert tr.component_bytes()[("pipeline_inflight", "hbm")] == 0.0
+
+
+def test_replica_watermark_tracks_per_replica_bytes(fresh_tracker):
+    tr = fresh_tracker
+    tr.set_topology(dp=2, tp=2)
+    idx, enc = _Owner(), _Owner()
+    # index shards over dp (per-replica 500); params replicate (1000 each)
+    tr.register("knn_index", idx, 1000, device_span=2, dp_shards=2)
+    tr.register("encoder_params", enc, 1000, device_span=2, dp_shards=1)
+    assert tr.replica_peaks() == {"0": 1500.0, "1": 1500.0}
+    # shrinking never lowers the high watermark
+    tr.register("knn_index", idx, 0, device_span=2, dp_shards=2)
+    assert tr.replica_peaks()["0"] == 1500.0
+
+
+# ---------------------------------------------------------------------------
+# forecaster — rates pinned by hand against a fake clock
+# ---------------------------------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def monotonic(self):
+        return self.now
+
+
+def _pin_clock(monkeypatch):
+    clock = _FakeClock()
+    monkeypatch.setattr(memtrack, "time", clock)
+    return clock
+
+
+def test_forecast_rates_pinned(fresh_tracker, monkeypatch):
+    clock = _pin_clock(monkeypatch)
+    monkeypatch.setenv("PATHWAY_ASSUME_HBM_BYTES", "1000000")
+    tr = fresh_tracker
+    idx = _Owner()
+    tr.register("knn_index", idx, 600_000)
+    # two batches 10s apart: 20 docs, 2000 per-device bytes over 10s
+    tr.note_ingest(10, 1000.0)
+    clock.now += 10.0
+    tr.note_ingest(10, 1000.0)
+    fc = tr.forecast()
+    assert fc["window_s"] == pytest.approx(10.0)
+    assert fc["docs"] == 20
+    assert fc["docs_per_sec"] == pytest.approx(2.0)
+    assert fc["bytes_per_doc"] == pytest.approx(100.0)
+    assert fc["device_bytes_per_sec"] == pytest.approx(200.0)
+    assert fc["hbm_capacity_bytes"] == 1_000_000.0
+    assert fc["hbm_used_bytes"] == 600_000.0
+    assert fc["hbm_headroom_bytes"] == 400_000.0
+    assert fc["headroom_pct"] == pytest.approx(40.0)
+    # 400_000 bytes of headroom at 200 B/s -> full in 2000s
+    assert fc["time_to_full_s"] == pytest.approx(2000.0)
+
+
+def test_forecast_is_none_safe_when_idle_or_capacityless(fresh_tracker):
+    fc = fresh_tracker.forecast()
+    # one delta (or none) covers no measurable window: rates stay None
+    assert fc["docs_per_sec"] is None
+    assert fc["device_bytes_per_sec"] is None
+    # CPU without PATHWAY_ASSUME_HBM_BYTES: capacity unknown, never a guess
+    assert fc["hbm_capacity_bytes"] is None
+    assert fc["time_to_full_s"] is None
+    json.dumps(fresh_tracker.snapshot())  # /status-safe
+
+
+def test_forecast_window_expires_old_deltas(fresh_tracker, monkeypatch):
+    clock = _pin_clock(monkeypatch)
+    tr = memtrack.reset_for_tests(forecast_window_s=30.0)
+    tr.note_ingest(100, 5000.0)
+    clock.now += 31.0
+    tr.note_ingest(10, 500.0)
+    fc = tr.forecast()
+    assert fc["docs"] == 10  # the 100-doc delta aged out
+
+
+def test_headroom_warns_once_with_flight_event(fresh_tracker, monkeypatch,
+                                               caplog):
+    import logging
+
+    monkeypatch.setenv("PATHWAY_ASSUME_HBM_BYTES", "1000")
+    tr = fresh_tracker
+    idx = _Owner()
+    tr.register("knn_index", idx, 950)  # 5% headroom < 10% threshold
+    events_before = len(memtrack.RECORDER.tail(128))
+    with caplog.at_level(logging.WARNING, logger="pathway_tpu"):
+        tr.note_ingest(1, 10.0)
+        tr.note_ingest(1, 10.0)  # second breach: no duplicate warning
+    warnings = [
+        r for r in caplog.records if "HBM headroom low" in r.getMessage()
+    ]
+    assert len(warnings) == 1
+    events = memtrack.RECORDER.tail(128)[events_before:]
+    headroom_events = [
+        e for e in events if e["kind"] == "memory_headroom_low"
+    ]
+    assert len(headroom_events) == 1
+    assert headroom_events[0]["name"].startswith("headroom_pct=5")
+    assert tr.snapshot()["headroom_warned"] is True
+
+
+# ---------------------------------------------------------------------------
+# gauges + /status
+# ---------------------------------------------------------------------------
+
+
+def test_memory_gauges_render_valid_exposition(fresh_tracker):
+    from pathway_tpu.internals.metrics import render_registries
+
+    tr = fresh_tracker
+    owner = _Owner()
+    tr.register("knn_index", owner, 2048, device_span=2)
+    text = render_registries([memtrack.memory_metrics()])
+    assert (
+        'pathway_memory_bytes{worker="0",component="knn_index",tier="hbm"}'
+        in text
+    )
+    assert "# TYPE pathway_memory_bytes gauge" in text
+    # capacity unknown on CPU -> headroom series ABSENT, not 0/NaN
+    assert "pathway_memory_hbm_headroom_bytes{" not in text
+    # every sample line parses as <name{labels}> <float>
+    for line in text.splitlines():
+        if line.startswith("pathway_memory_") and not line.startswith("#"):
+            float(line.rsplit(" ", 1)[1])
+
+
+def test_headroom_gauge_present_with_known_capacity(
+    fresh_tracker, monkeypatch
+):
+    from pathway_tpu.internals.metrics import render_registries
+
+    monkeypatch.setenv("PATHWAY_ASSUME_HBM_BYTES", "100000")
+    fresh_tracker.register("knn_index", fresh_tracker, 40000, device_span=2)
+    text = render_registries([memtrack.memory_metrics()])
+    line = next(
+        ln for ln in text.splitlines()
+        if ln.startswith("pathway_memory_hbm_headroom_bytes{")
+    )
+    assert float(line.rsplit(" ", 1)[1]) == pytest.approx(80000.0)
+
+
+def test_status_json_carries_memory_key(fresh_tracker):
+    from pathway_tpu.engine.engine import Engine
+    from pathway_tpu.internals.monitoring import PrometheusServer
+
+    fresh_tracker.register("knn_index", fresh_tracker, 4096)
+    status = PrometheusServer(
+        Engine(worker_id=0, worker_count=1, metrics=False)
+    ).status_json()
+    mem = status["memory"]
+    assert mem["enabled"] is True
+    assert mem["components"]["knn_index"]["bytes"] == 4096.0
+    assert "forecast" in mem and "recent_events" in mem
+    json.dumps(status)
+
+
+# ---------------------------------------------------------------------------
+# live hook sites
+# ---------------------------------------------------------------------------
+
+
+def test_device_knn_index_registers_and_regrows(fresh_tracker):
+    import numpy as np
+
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    knn = DeviceKnnIndex(16, metric="cos", reserved_space=8)
+    (entry,) = fresh_tracker.entries("knn_index")
+    assert entry["nbytes"] == knn.capacity * (4 * 16 + 1)
+    before = entry["nbytes"]
+    rng = np.random.default_rng(0)
+    for i in range(20):  # exceed reserved_space -> _grow re-registers
+        knn.add(i, rng.standard_normal(16).astype(np.float32))
+    (entry,) = fresh_tracker.entries("knn_index")
+    assert entry["nbytes"] == knn.capacity * (4 * 16 + 1) > before
+    # ingest fed the forecaster one doc per new key
+    assert sum(d for _, d, _ in fresh_tracker._deltas) == 20
+    del knn
+    gc.collect()
+    assert fresh_tracker.entries("knn_index") == []
+
+
+def test_pipeline_inflight_returns_to_zero(fresh_tracker):
+    from pathway_tpu.internals.device_pipeline import DevicePipeline
+
+    seen = []
+
+    def prepare(item):
+        return item, {"rows": 1, "slab_bytes": 256}
+
+    pipe = DevicePipeline(
+        prepare,
+        dispatch=lambda payload: seen.append(payload),
+        wait=lambda handle: None,
+        name="memtrack-test",
+        max_in_flight=2,
+    )
+    try:
+        for i in range(5):
+            pipe.submit(i)
+        pipe.drain()
+    finally:
+        pipe.close()
+    assert len(seen) == 5
+    inflight = fresh_tracker.component_bytes().get(
+        ("pipeline_inflight", "hbm"), 0.0
+    )
+    assert inflight == 0.0  # every +slab_bytes was retired by completion
+
+
+def test_snapshot_staging_registered_on_save(fresh_tracker):
+    import pickle
+
+    from pathway_tpu.persistence import Backend, OperatorSnapshotManager
+
+    class _Node:
+        name = "n"
+        inputs = ()
+
+        def __init__(self, state):
+            self._state = state
+
+        def snapshot_state(self):
+            return self._state
+
+    mgr = OperatorSnapshotManager(Backend.mock()._backend, worker_id=0)
+    engine = SimpleNamespace(
+        nodes=[_Node({"a": 1}), _Node(None), _Node([1, 2, 3])]
+    )
+    assert mgr.save(engine, 7, {}) is True
+    (entry,) = fresh_tracker.entries("snapshot_staging")
+    assert entry["tier"] == "host"
+    expected = len(pickle.dumps({"a": 1})) + len(pickle.dumps([1, 2, 3]))
+    assert entry["nbytes"] == float(expected)
+    assert entry["meta"]["nodes"] == 2  # the stateless node staged nothing
+
+
+# ---------------------------------------------------------------------------
+# PWT6xx capacity pass (unit level; the golden matrix pins the messages)
+# ---------------------------------------------------------------------------
+
+
+def _capacity_view(info):
+    op = SimpleNamespace(op_id=1, info=info)
+    return SimpleNamespace(
+        anchored_by_kind={"external_index": [(None, op)]},
+        op_label=lambda table: "external_index#1",
+    )
+
+
+def test_predict_index_bytes_matches_live_bucketing():
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    for reserved in (8, 100, 512, 5000):
+        knn = DeviceKnnIndex(32, reserved_space=reserved)
+        pred = predict_index_bytes(32, reserved, dp=1)
+        assert pred["rows"] == knn.capacity
+        assert pred["bytes"] == knn.capacity * (4 * 32 + 1)
+
+
+def test_capacity_pass_attaches_plan_and_sizes(fresh_tracker, monkeypatch):
+    monkeypatch.delenv("PATHWAY_ASSUME_HBM_BYTES", raising=False)
+    view = _capacity_view({
+        "index": "BruteForceKnn", "dimensions": 64,
+        "reserved_space": 1000, "metric": "cos", "encoder": None,
+    })
+    result = AnalysisResult()
+    capacity_pass(view, result, mesh=MeshSpec.parse("dp=2,tp=2"), workers=4)
+    codes = {f.code for f in result.findings}
+    assert codes == {"PWT601"}  # no cap known -> no PWT603/604
+    (row,) = result.capacity["indexes"]
+    assert row["predicted_rows"] == 1024
+    assert row["index_bytes"] == 1024 * (4 * 64 + 1)
+    assert row["per_device_bytes"] == pytest.approx(row["index_bytes"] / 2)
+    assert result.capacity["hbm_capacity_bytes"] is None
+
+
+def test_capacity_pass_low_headroom_emits_pwt604(fresh_tracker, monkeypatch):
+    pred = predict_index_bytes(384, 512, dp=1)
+    total = pred["bytes"] + _pipeline_inflight_bytes()
+    # capacity leaves exactly ~5% headroom: below the 10% warn line but
+    # not overflowing, so PWT604 fires and PWT603 does not
+    monkeypatch.setenv("PATHWAY_ASSUME_HBM_BYTES", str(int(total / 0.95) + 1))
+    view = _capacity_view({
+        "index": "BruteForceKnn", "dimensions": 384,
+        "reserved_space": 512, "metric": "cos", "encoder": None,
+    })
+    result = AnalysisResult()
+    capacity_pass(view, result, mesh=None, workers=1)
+    codes = [f.code for f in result.findings]
+    assert "PWT604" in codes and "PWT603" not in codes
+    assert result.capacity["headroom_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# PWT699 parity: predicted vs live accounting on the 8-device mesh
+# ---------------------------------------------------------------------------
+
+
+def test_pwt699_parity_within_tolerance_on_8_device_mesh(fresh_tracker):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from pathway_tpu.models.minilm import SentenceEncoder
+    from pathway_tpu.models.transformer import TransformerConfig
+    from pathway_tpu.ops.knn import DeviceKnnIndex, FusedEmbedSearch
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices (conftest emulates 8)")
+    tiny = TransformerConfig(
+        vocab_size=256, hidden=32, layers=1, heads=2, mlp_dim=64,
+        max_len=32, dtype="float32",
+    )
+    enc = SentenceEncoder("memtrack-parity", config=tiny, max_len=16, seed=3)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("knn",))
+    knn = DeviceKnnIndex(enc.dimension, reserved_space=512, mesh=mesh)
+    fused = FusedEmbedSearch(enc, knn)
+    fused.embed_and_add(range(8), [f"parity doc {i}" for i in range(8)])
+
+    # build the prediction from the same info DataIndex._query records
+    view = _capacity_view({
+        "index": "BruteForceKnn", "dimensions": enc.dimension,
+        "reserved_space": 512, "metric": "cos",
+        "encoder": {
+            "vocab_size": tiny.vocab_size, "hidden": tiny.hidden,
+            "layers": tiny.layers, "mlp_dim": tiny.mlp_dim,
+            "max_len": tiny.max_len,
+        },
+    })
+    result = AnalysisResult()
+    capacity_pass(view, result, mesh=MeshSpec.parse("dp=8,tp=1"), workers=8)
+    (row,) = result.capacity["indexes"]
+
+    live_index = sum(
+        e["nbytes"] for e in fresh_tracker.entries("knn_index")
+    )
+    live_params = sum(
+        e["nbytes"] for e in fresh_tracker.entries("encoder_params")
+    )
+    assert live_index > 0 and live_params > 0
+    # the ±10% acceptance bound, asserted directly...
+    assert abs(row["index_bytes"] - live_index) / live_index <= (
+        CAPACITY_PARITY_TOLERANCE
+    )
+    assert abs(row["param_bytes"] - live_params) / live_params <= (
+        CAPACITY_PARITY_TOLERANCE
+    )
+    # ...and through the PWT699 gate itself: no drift finding
+    verify_capacity(None, result)
+    assert not [f for f in result.findings if f.code == "PWT699"]
+    # today both formulas are exact twins of the allocators
+    assert row["index_bytes"] == live_index
+    assert row["param_bytes"] == live_params
+    assert live_params == 4 * costmodel.encoder_param_count(
+        vocab_size=tiny.vocab_size, hidden=tiny.hidden,
+        layers=tiny.layers, mlp_dim=tiny.mlp_dim, max_len=tiny.max_len,
+    )
+
+
+def test_pwt699_fires_on_sabotaged_prediction(fresh_tracker):
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    knn = DeviceKnnIndex(16, reserved_space=64)  # registers live bytes
+    live = sum(e["nbytes"] for e in fresh_tracker.entries("knn_index"))
+    assert live > 0
+    result = AnalysisResult()
+    result.capacity = {
+        "indexes": [{"index_bytes": live * 2, "param_bytes": 0}],
+    }
+    verify_capacity(None, result)
+    drift = [f for f in result.findings if f.code == "PWT699"]
+    assert drift and str(drift[0].severity) == "error"
+
+
+def test_pwt699_skips_on_entry_count_mismatch(fresh_tracker):
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    # two live indexes but only one predicted: another engine's state is
+    # in the process, a sum comparison would be meaningless -> silence
+    a = DeviceKnnIndex(16, reserved_space=64)
+    b = DeviceKnnIndex(16, reserved_space=64)
+    result = AnalysisResult()
+    result.capacity = {
+        "indexes": [{"index_bytes": 64 * 65, "param_bytes": 0}],
+    }
+    verify_capacity(None, result)
+    assert not [f for f in result.findings if f.code == "PWT699"]
+    del a, b
+
+
+# ---------------------------------------------------------------------------
+# PATHWAY_MEMTRACK=0 is inert
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_hooks_record_nothing(fresh_tracker, monkeypatch):
+    from pathway_tpu.internals.device_pipeline import DevicePipeline
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    monkeypatch.setattr(memtrack, "ENABLED", False)
+    DeviceKnnIndex(16, reserved_space=64)
+    pipe = DevicePipeline(
+        lambda item: (item, {"rows": 1, "slab_bytes": 256}),
+        dispatch=lambda payload: payload,
+        wait=lambda handle: None,
+        name="disabled-test",
+    )
+    try:
+        pipe.submit(0)
+        pipe.drain()
+    finally:
+        pipe.close()
+    assert fresh_tracker.entries() == []
+    assert memtrack.memory_status() == {"enabled": False}
+    from pathway_tpu.internals.metrics import render_registries
+
+    text = render_registries([memtrack.memory_metrics()])
+    assert "pathway_memory_bytes{" not in text
+
+
+def test_disabled_path_never_imports_jax():
+    """PATHWAY_MEMTRACK=0 in a fresh process: the full memtrack surface
+    (status, metrics render, manual registry traffic) must run without
+    pulling jax into the process — the disabled path reads one module
+    attribute and touches no memory APIs."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import sys;"
+        "from pathway_tpu.internals import memtrack;"
+        "from pathway_tpu.internals.metrics import render_registries;"
+        "assert memtrack.ENABLED is False;"
+        "assert memtrack.memory_status() == {'enabled': False};"
+        "text = render_registries([memtrack.memory_metrics()]);"
+        "assert 'pathway_memory_bytes{' not in text;"
+        "assert memtrack.jax_memory_stats() is None;"
+        "assert 'jax' not in sys.modules, 'disabled memtrack pulled in jax'"
+    )
+    env = dict(os.environ, PATHWAY_MEMTRACK="0")
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr

@@ -796,6 +796,77 @@ def test_utilization_accounting_overhead_under_5pct():
 
 
 @pytest.mark.perf_smoke
+def test_memtrack_accounting_overhead_under_5pct():
+    """The memory-accounting hooks sit on the same dispatch loop as the
+    utilization hooks (`if memtrack.ENABLED: tracker().adjust/note_*`).
+    Enabled — one in-flight adjust pair plus an ingest note per tick,
+    the full per-dispatch hook cost — must stay under 5% on the engine
+    microbench loop; disabled it is one module-attribute read.  Same
+    min-of-N interleaved protocol as the metrics/utilization guards."""
+    import gc
+    from time import perf_counter
+
+    from pathway_tpu.engine.engine import InputQueueSource, RowwiseNode
+    from pathway_tpu.internals import memtrack
+
+    # same REPS=7 margin rationale as the utilization guard above
+    ROWS, TICKS, REPS = 512, 40, 7
+    deltas = [(ref_scalar("k", i), (i,), 1) for i in range(ROWS)]
+
+    def ident(keys, cols):
+        return cols[0]
+
+    def run_once(enabled: bool) -> float:
+        saved = memtrack.ENABLED
+        memtrack.ENABLED = enabled
+        memtrack.reset_for_tests()
+        eng = Engine(metrics=False)
+        src = InputQueueSource(eng)
+        node = src
+        for _ in range(3):
+            node = RowwiseNode(eng, [node], ident)
+        owner = object()
+        try:
+            time = 2
+            for _ in range(8):  # warmup
+                src.push(time, deltas)
+                eng.process_time(time)
+                time += 2
+            t0 = perf_counter()
+            for _ in range(TICKS):
+                src.push(time, deltas)
+                if memtrack.ENABLED:
+                    tr = memtrack.tracker()
+                    tr.adjust("pipeline_inflight", owner, 4096.0)
+                    tr.note_ingest(ROWS, ROWS * 65.0)
+                    tr.adjust("pipeline_inflight", owner, -4096.0)
+                eng.process_time(time)
+                time += 2
+            return perf_counter() - t0
+        finally:
+            memtrack.ENABLED = saved
+            eng._gc_unfreeze()
+
+    on, off = [], []
+    gc.collect()
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        for _ in range(REPS):
+            on.append(run_once(True))
+            off.append(run_once(False))
+    finally:
+        memtrack.reset_for_tests()
+        if gc_was_enabled:
+            gc.enable()
+    ratio = min(on) / min(off)
+    assert ratio < 1.05, (
+        f"memory accounting overhead {ratio:.3f}x "
+        f"(on={min(on):.4f}s off={min(off):.4f}s)"
+    )
+
+
+@pytest.mark.perf_smoke
 def test_profiler_idle_is_noop():
     """With no capture requested the profiler must be pure state reads:
     importing internals/profiler.py and consulting its status must not
