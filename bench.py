@@ -712,6 +712,7 @@ def _fallback_payload(err: str, device_status: dict) -> dict:
         "tracing_overhead": _tracing_overhead(),
         "failover_recovery_s": _failover_recovery_s(),
         **_multichip_facts(),
+        **_degraded_facts(),
         **_memory_facts(),
     }
 
@@ -872,6 +873,7 @@ def _run_device_round(device_status: dict) -> None:
                 ),
                 **_generation_facts(),
                 **_multichip_facts(),
+                **_degraded_facts(),
                 **_memory_facts(),
             }
         )
@@ -928,6 +930,33 @@ def _multichip_facts() -> dict:
         return {"multichip": json.loads(line)}
     except Exception as exc:  # noqa: BLE001 — never sink the main bench
         return {"multichip": {"error": f"{type(exc).__name__}: {exc}"}}
+
+
+def _degraded_facts() -> dict:
+    """Self-healing runtime: ingest throughput with one dp replica
+    drained (target: >= (dp-1)/dp of the healthy rate), plus the
+    drain/re-admit latencies, in a subprocess for the same reason as
+    _multichip_facts.  Works device-up or device-down, and the entry is
+    never null — a failure nests as {"error": ...}."""
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks",
+        "degraded_bench.py",
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, script],
+            capture_output=True,
+            timeout=900,
+            text=True,
+        )
+        line = proc.stdout.strip().splitlines()[-1]
+        return {"degraded_mode": json.loads(line)}
+    except Exception as exc:  # noqa: BLE001 — never sink the main bench
+        return {"degraded_mode": {"error": f"{type(exc).__name__}: {exc}"}}
 
 
 def _memory_facts() -> dict:

@@ -95,6 +95,12 @@ def _profile(args) -> int:
     return main_profile(args)
 
 
+def _restart(args) -> int:
+    from pathway_tpu.internals.trace_tool import main_restart
+
+    return main_restart(args)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="pathway")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -206,6 +212,31 @@ def main(argv=None) -> int:
         "(no running job needed)",
     )
     profile.set_defaults(func=_profile)
+
+    restart = sub.add_parser(
+        "restart",
+        help="rolling restart of a running job's workers, one at a "
+        "time under load (health controller; exactly-once sinks "
+        "preserved)",
+    )
+    restart.add_argument(
+        "--url",
+        default=None,
+        help="base monitoring URL of the running job (overrides --port)",
+    )
+    restart.add_argument(
+        "--port",
+        type=int,
+        default=20000,
+        help="local monitoring port (default: worker 0's 20000)",
+    )
+    restart.add_argument(
+        "--workers",
+        default=None,
+        metavar="IDS",
+        help="comma-separated worker ids to roll (default: all)",
+    )
+    restart.set_defaults(func=_restart)
 
     spawn = sub.add_parser("spawn", help="run a program on multiple workers")
     spawn.add_argument("--threads", "-t", type=int, default=1)

@@ -110,6 +110,11 @@ class DevicePipeline:
         self.max_in_flight = max_in_flight or _env_int(
             "PATHWAY_PIPELINE_IN_FLIGHT", 2
         )
+        # health-controller backpressure: the configured sizes are the
+        # ceiling; set_pressure_scale() shrinks the live knobs toward 1
+        # and restores them when pressure clears (AIMD)
+        self._base_max_prepared = self.max_prepared
+        self._base_max_in_flight = self.max_in_flight
         # mesh backend: dispatches are SPMD across dp replicas, so every
         # replica holds its own copy of the in-flight window; meta may
         # carry "replica_rows" / "replica_real_tokens" /
@@ -144,6 +149,9 @@ class DevicePipeline:
             target=self._run, name=f"{name}-dispatch", daemon=True
         )
         self._thread.start()
+        if _PRESSURE_SCALE < 1.0:
+            # born under pressure: adopt the process-wide throttle
+            self.set_pressure_scale(_PRESSURE_SCALE)
         _PIPELINES.add(self)
 
     # -- producer side ----------------------------------------------------
@@ -197,6 +205,21 @@ class DevicePipeline:
             utilization.tracker().note_span(
                 "drain", time.perf_counter() - t0
             )
+
+    def set_pressure_scale(self, scale: float) -> None:
+        """Scale the live queue/window sizes to `scale` of their
+        configured ceilings (floor 1 each — the pipeline never stalls
+        outright).  Shrinking takes effect as in-flight work retires;
+        expanding wakes any submitter blocked on the old bound."""
+        scale = min(1.0, max(0.0, float(scale)))
+        with self._cond:
+            self.max_prepared = max(
+                1, int(self._base_max_prepared * scale)
+            )
+            self.max_in_flight = max(
+                1, int(self._base_max_in_flight * scale)
+            )
+            self._cond.notify_all()
 
     def take_failed(self) -> List[Any]:
         """Return (and clear) the items that never made it to the device,
@@ -435,6 +458,25 @@ class DevicePipeline:
 
 _PIPELINES: "weakref.WeakSet[DevicePipeline]" = weakref.WeakSet()
 _STATS: Dict[str, int] = {"fallbacks": 0}
+# process-wide backpressure scale (internals/health.py AIMD loop); new
+# pipelines adopt it at construction so pressure survives pipeline churn
+_PRESSURE_SCALE = 1.0
+
+
+def set_backpressure_scale(scale: float) -> float:
+    """Apply the health controller's AIMD scale to every live pipeline
+    (and remember it for pipelines created while pressure holds).
+    Returns the clamped scale actually applied."""
+    global _PRESSURE_SCALE
+    scale = min(1.0, max(0.0, float(scale)))
+    _PRESSURE_SCALE = scale
+    for p in list(_PIPELINES):
+        p.set_pressure_scale(scale)
+    return scale
+
+
+def backpressure_scale() -> float:
+    return _PRESSURE_SCALE
 # The pipeline is a process-wide resource (one set of gauges regardless of
 # how many engine workers share the process), so its series carry the
 # conventional worker="0" constant label the exposition contract requires.
@@ -554,6 +596,7 @@ def pipeline_status() -> Dict[str, Any]:
         "enabled": pipeline_enabled(),
         "active": len(pipes),
         "fallbacks": _STATS["fallbacks"],
+        "backpressure_scale": _PRESSURE_SCALE,
     }
     if pipes:
         agg = {

@@ -38,6 +38,16 @@ Kinds:
       replica R F-times its real share — a deterministic straggler for
       the skew detector.  Persistent unless count=N bounds it to the
       next N dispatches.
+  mem_pressure@bytes=B,epoch=E[,until=U]
+      from the first engine epoch >= E (until epoch U, or forever when
+      omitted) the memory forecaster sees B synthetic extra bytes in
+      use — deterministic pressure for the health controller's
+      backpressure loop without allocating anything.
+  restart_worker@worker=W,epoch=E
+      graceful injected restart: worker W raises WorkerRestart at the
+      first epoch >= E (fires once).  The supervisor layer respawns it
+      through the same failover path as kill_worker, but the restart is
+      billed as a rolling restart (health action), not a crash.
 """
 
 from __future__ import annotations
@@ -57,6 +67,15 @@ class WorkerKilled(Exception):
     Raised out of the worker's run loop; the supervisor layer treats it
     as a restartable crash (thread mode respawns the worker thread, TCP
     mode lets the process die for a ProcessSupervisor to respawn)."""
+
+
+class WorkerRestart(WorkerKilled):
+    """Injected graceful restart (``restart_worker`` directive, or the
+    health controller's rolling restart).
+
+    A WorkerKilled subclass so every absorb/respawn path built for
+    injected kills handles it unchanged; supervisors that care (restart
+    budgets, health accounting) can distinguish the two."""
 
 
 class InjectedStoreFailure(IOError):
@@ -122,10 +141,12 @@ def install(spec: Optional[str]) -> None:
     """Arm the harness from a spec string (replaces prior directives).
 
     ``install(None)`` / ``install("")`` disarms it (same as clear())."""
-    global ACTIVE
+    global ACTIVE, _mem_pressure_now, _generation
     with _lock:
         _directives.clear()
         events.clear()
+        _mem_pressure_now = 0
+        _generation += 1
         if spec:
             _directives.extend(parse(spec))
         ACTIVE = bool(_directives)
@@ -146,10 +167,31 @@ def clear() -> None:
 
 def on_epoch(worker: int, time: int, coord: Any = None) -> None:
     """Per-epoch hook, called by the streaming driver at the top of each
-    flush with the engine's logical coordinates.  Raises WorkerKilled
-    when a kill directive matches; performs peer severing in place."""
+    flush with the engine's logical coordinates.  Raises WorkerKilled /
+    WorkerRestart when a matching directive fires; performs peer
+    severing and mem_pressure (de)activation in place."""
+    global _mem_pressure_now
     with _lock:
+        pressure = 0
         for d in _directives:
+            if d.kind == "mem_pressure":
+                # pure function of logical time, so every worker's view
+                # agrees: active while epoch in [epoch, until)
+                if time >= d.iparam("epoch") and (
+                    "until" not in d.params or time < d.iparam("until")
+                ):
+                    pressure += d.iparam("bytes")
+                    if not d.fired:
+                        d.fired = True
+                        _record(
+                            "mem_pressure",
+                            bytes=d.iparam("bytes"),
+                            time=time,
+                        )
+                elif d.fired and d.remaining > 0 and "until" in d.params:
+                    d.remaining = 0  # record the clear exactly once
+                    _record("mem_pressure_clear", time=time)
+                continue
             if d.fired:
                 continue
             if d.kind == "kill_worker":
@@ -160,6 +202,14 @@ def on_epoch(worker: int, time: int, coord: Any = None) -> None:
                         f"injected kill: worker {worker} at epoch {time} "
                         f"({d!r})"
                     )
+            elif d.kind == "restart_worker":
+                if worker == d.iparam("worker") and time >= d.iparam("epoch"):
+                    d.fired = True
+                    _record("restart_worker", worker=worker, time=time)
+                    raise WorkerRestart(
+                        f"injected rolling restart: worker {worker} at "
+                        f"epoch {time} ({d!r})"
+                    )
             elif d.kind == "sever_peer":
                 if worker == d.iparam("worker") and time >= d.iparam("epoch"):
                     d.fired = True
@@ -168,6 +218,7 @@ def on_epoch(worker: int, time: int, coord: Any = None) -> None:
                     sever = getattr(coord, "sever_peer", None)
                     if sever is not None:
                         sever(peer)
+        _mem_pressure_now = pressure
 
 
 def store_put(key: str) -> None:
@@ -211,6 +262,51 @@ def replica_factor(replica: int) -> float:
                 _record("slow_replica", replica=int(replica), factor=factor)
             return factor
     return 1.0
+
+
+# synthetic bytes-in-use injected by active mem_pressure directives;
+# updated by on_epoch (logical time owns activation and clearing)
+_mem_pressure_now = 0
+
+# bumped by every install()/clear(): a directive set binds to runs that
+# START while it is armed.  Drivers capture generation() at startup and
+# skip the hook on mismatch — otherwise a long-lived run from before the
+# arming (e.g. a never-terminating webserver pipeline on a daemon
+# thread) keeps calling on_epoch with ITS frozen logical time,
+# overwriting _mem_pressure_now and racing the armed run's directives.
+_generation = 0
+
+
+def generation() -> int:
+    """Arming generation: incremented by install()/clear().  A streaming
+    driver samples this once at startup; on_epoch ticks from runs with a
+    stale generation must be skipped by the caller."""
+    with _lock:
+        return _generation
+
+
+def mem_pressure_bytes() -> int:
+    """Memory-forecaster hook: synthetic extra bytes-in-use injected by
+    the mem_pressure directives active at the last observed epoch."""
+    with _lock:
+        return _mem_pressure_now
+
+
+def replica_slowed(replica: int) -> bool:
+    """Read-only probe: is a slow_replica directive still armed for
+    `replica`?  Unlike :func:`replica_factor` this never consumes count
+    budget — the health controller polls it when deciding whether a
+    drained replica has recovered enough to re-admit."""
+    with _lock:
+        for d in _directives:
+            if d.kind != "slow_replica":
+                continue
+            if d.iparam("replica", -1) != int(replica):
+                continue
+            if "count" in d.params and d.remaining <= 0:
+                continue
+            return True
+    return False
 
 
 def probe_flap() -> bool:

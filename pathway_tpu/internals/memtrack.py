@@ -58,6 +58,7 @@ import time
 import weakref
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
+from pathway_tpu.internals import faults
 from pathway_tpu.internals.metrics import FlightRecorder, MetricsRegistry
 
 logger = logging.getLogger("pathway_tpu")
@@ -329,12 +330,17 @@ class MemoryTracker:
     def device_hbm_bytes(self) -> float:
         """What one device holds: sum of nbytes/device_span over hbm
         entries (uniform sharding; the per-device view headroom is
-        judged against)."""
-        return sum(
+        judged against).  Injected ``mem_pressure`` fault bytes are
+        added here so they flow through headroom, the forecast, and the
+        warn path exactly like real allocations."""
+        used = sum(
             e["nbytes"] / e["device_span"]
             for e in self.entries()
             if e["tier"] == "hbm"
         )
+        if faults.ACTIVE:
+            used += faults.mem_pressure_bytes()
+        return used
 
     def _per_replica_bytes_locked(self) -> float:
         return sum(
@@ -398,6 +404,19 @@ _TRACKER = MemoryTracker()
 
 def tracker() -> MemoryTracker:
     return _TRACKER
+
+
+def headroom_pct() -> Optional[float]:
+    """Current per-device headroom as a percentage of capacity — the
+    health controller's cheap backpressure input (skips the forecast's
+    rate math).  None when accounting is disabled or capacity is
+    unknown (the controller then never throttles on memory)."""
+    if not ENABLED:
+        return None
+    cap = hbm_capacity_bytes()
+    if not cap:
+        return None
+    return 100.0 * (cap - _TRACKER.device_hbm_bytes()) / cap
 
 
 def reset_for_tests(

@@ -82,6 +82,11 @@ class MeshBackend:
         self.tp = int(mesh.shape[self.tp_axis]) if self.tp_axis else 1
         self._lock = threading.Lock()
         self._degraded_replicas: set[int] = set()
+        # replicas drained by the health controller: they receive no NEW
+        # ingest (dp_shard_of routes around them) but stay in the mesh —
+        # their index shards remain searchable, so retrieval stays
+        # ranking-exact through a drain/re-admit cycle
+        self._drained: set[int] = set()
         # -- per-dp-replica device-time accounting (utilization PR) ----
         from pathway_tpu.internals.metrics import (
             FlightRecorder,
@@ -139,7 +144,16 @@ class MeshBackend:
                 shard = int(key)
             except (TypeError, ValueError):
                 shard = hash(key)
-        return int(shard) % self.dp
+        replica = int(shard) % self.dp
+        drained = self._drained
+        if drained and replica in drained:
+            # deterministic detour around drained replicas: the same key
+            # always lands on the same surviving replica, and search
+            # merges every shard regardless, so results stay exact
+            active = [r for r in range(self.dp) if r not in drained]
+            if active:
+                replica = active[int(shard) % len(active)]
+        return replica
 
     # -- per-replica device time + straggler detection ---------------------
 
@@ -183,18 +197,22 @@ class MeshBackend:
     def _skew_ratio_or_none(self) -> Optional[float]:
         with self._lock:
             sums = self._windowed_device_s_locked()
-        total = sum(sums)
-        if not total or self.dp < 2:
+            active = [r for r in range(self.dp) if r not in self._drained]
+        total = sum(sums[r] for r in active)
+        if not total or len(active) < 2:
             return None
-        return max(sums) / (total / self.dp)
+        return max(sums[r] for r in active) / (total / len(active))
 
     def _check_straggler_locked(self) -> None:
         sums = self._windowed_device_s_locked()
-        total = sum(sums)
-        if not total or self.dp < 2:
+        # drained replicas receive no new work; judging survivors against
+        # their stale window would fabricate stragglers
+        active = [r for r in range(self.dp) if r not in self._drained]
+        total = sum(sums[r] for r in active)
+        if not total or len(active) < 2:
             return
-        mean = total / self.dp
-        worst = max(range(self.dp), key=lambda r: sums[r])
+        mean = total / len(active)
+        worst = max(active, key=lambda r: sums[r])
         ratio = sums[worst] / mean
         if ratio < SKEW_THRESHOLD:
             self._skew_streak = 0
@@ -231,6 +249,52 @@ class MeshBackend:
         with self._lock:
             return dict(self._straggler) if self._straggler else None
 
+    # -- replica drain / re-admit (health controller actuator) -------------
+
+    def drain_replica(self, replica: int, reason: str = "") -> bool:
+        """Route NEW ingest around `replica` (its existing index shard
+        stays searchable — retrieval remains ranking-exact).  Returns
+        False when the replica is already drained or draining it would
+        leave no active replica."""
+        replica = int(replica) % self.dp
+        with self._lock:
+            if replica in self._drained:
+                return False
+            if len(self._drained) + 1 >= self.dp:
+                return False  # never drain the last replica
+            # replace, don't mutate: dp_shard_of reads lock-free
+            self._drained = self._drained | {replica}
+            # the straggler's stale window must not re-flag it (or its
+            # survivors) the moment it stops receiving work
+            self._device_window[replica].clear()
+            self._skew_streak = 0
+            self._straggler = None
+            self._straggler_warned = False
+        self.recorder.record(
+            "replica_drained", name=reason or f"replica {replica}",
+            node=replica,
+        )
+        return True
+
+    def readmit_replica(self, replica: int) -> bool:
+        """Re-admit a drained replica to the ingest routing."""
+        replica = int(replica) % self.dp
+        with self._lock:
+            if replica not in self._drained:
+                return False
+            self._drained = self._drained - {replica}
+            for dq in self._device_window:
+                dq.clear()  # restart skew detection from a clean window
+            self._skew_streak = 0
+            self._straggler = None
+        self.recorder.record(
+            "replica_readmitted", name=f"replica {replica}", node=replica
+        )
+        return True
+
+    def drained_replicas(self) -> List[int]:
+        return sorted(self._drained)
+
     # -- degradation bookkeeping -------------------------------------------
 
     def note_replica_degraded(self, replica: int) -> None:
@@ -262,6 +326,7 @@ class MeshBackend:
             "platform": getattr(dev0, "platform", None),
             "sharded_ingest": self.can_shard_ingest(),
             "degraded_replicas": self.degraded_replicas(),
+            "drained_replicas": self.drained_replicas(),
             "replicas": replica_status(self.dp),
             # per-replica windowed device time + straggler verdict
             "replica_device_s": window,

@@ -249,6 +249,35 @@ class StatsMonitor:
                     if straggler:
                         row += f" STRAGGLER replica {straggler['replica']}"
                     table.add_row("mesh replica balance", row)
+            # self-healing controller: show only when it has acted or is
+            # actively holding pressure / a drain / a roll
+            from pathway_tpu.internals import health
+
+            if health.ENABLED:
+                hs = health.health_status()
+                acted = any(hs.get("actions", {}).values())
+                if (
+                    acted
+                    or hs.get("pressure")
+                    or hs.get("drained_replicas")
+                    or hs.get("rolling_restart", {}).get("in_progress")
+                ):
+                    row = f"bp_scale={hs['backpressure_scale']:.3f}"
+                    if hs.get("pressure_reason"):
+                        row += f" [{hs['pressure_reason']}]"
+                    if hs.get("drained_replicas"):
+                        row += (
+                            " drained="
+                            f"{sorted(hs['drained_replicas'])}"
+                        )
+                    roll = hs.get("rolling_restart", {})
+                    if roll.get("in_progress"):
+                        cur = roll.get("current") or {}
+                        row += (
+                            f" rolling worker {cur.get('worker')}"
+                            f" ({cur.get('phase')})"
+                        )
+                    table.add_row("health", row)
             # critical-path attribution for the latest sampled epoch
             tr = getattr(m, "trace", None)
             cp = tr.critical_path() if tr is not None else None
@@ -376,6 +405,11 @@ class PrometheusServer:
         backend = active_backend()
         if backend is not None:
             add(backend.metrics)
+        # health-controller action counters (internals/health.py):
+        # pathway_health_actions_total{action}
+        from pathway_tpu.internals.health import health_metrics
+
+        add(health_metrics())
         return regs
 
     def metrics_text(self) -> str:
@@ -446,6 +480,7 @@ class PrometheusServer:
         ]
         from pathway_tpu.internals.device_pipeline import pipeline_status
         from pathway_tpu.internals.device_probe import device_status
+        from pathway_tpu.internals.health import health_status
         from pathway_tpu.internals.memtrack import memory_status
         from pathway_tpu.internals.mesh_backend import mesh_status
         from pathway_tpu.internals.tracing import merged_critical_path
@@ -477,6 +512,10 @@ class PrometheusServer:
             # per-dp-replica occupancy/queue gauges; lint-only spec dict
             # when armed without enough devices, None without a mesh
             "mesh": mesh_status(e0),
+            # self-healing controller (internals/health.py): drained
+            # replicas, backpressure scale, rolling-restart progress and
+            # per-worker recovery times, recent actions
+            "health": health_status(),
             # findings from pw.run(analysis=...): deployed graphs report
             # their own lint state (None when analysis was off)
             "analysis": getattr(e0, "analysis", None),
@@ -518,6 +557,35 @@ class PrometheusServer:
                 }
             )
         return out
+
+    def _restart_request(self, path: str) -> tuple:
+        """Handle ``/restart[?workers=0,1]``: queue a rolling restart of
+        the process's workers through the health controller.  Returns
+        (http_code, json_payload); 409 when a roll is already running,
+        400 when the controller is disabled."""
+        import urllib.parse
+
+        from pathway_tpu.internals import health
+
+        if not health.ENABLED:
+            return 400, {"error": "health controller disabled (PATHWAY_HEALTH=0)"}
+        query = urllib.parse.parse_qs(urllib.parse.urlparse(path).query)
+        raw = query.get("workers", [None])[0]
+        if raw:
+            try:
+                workers = [int(w) for w in raw.split(",") if w.strip()]
+            except ValueError:
+                return 400, {"error": "workers must be a comma list of ints"}
+        else:
+            workers = [e.worker_id for e in self._engines()]
+        try:
+            status = health.controller().request_rolling_restart(workers)
+        except RuntimeError as exc:
+            return 409, {
+                "error": str(exc),
+                "rolling_restart": health.controller().rolling_restart_status(),
+            }
+        return 200, {"requested": workers, "rolling_restart": status}
 
     def _profile_request(self, path: str) -> tuple:
         """Handle ``/profile?seconds=N[&dir=PATH]``: run one guarded
@@ -561,6 +629,13 @@ class PrometheusServer:
                     body = json.dumps(
                         monitor.status_json(), default=str
                     ).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/restart"):
+                    # drain-and-respawn the workers one at a time
+                    # (internals/health.py rolling restart); idempotency:
+                    # a second request while a roll runs returns 409
+                    code, payload = monitor._restart_request(self.path)
+                    body = json.dumps(payload, default=str).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/profile"):
                     # on-demand jax.profiler capture (one at a time,

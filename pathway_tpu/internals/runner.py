@@ -193,7 +193,7 @@ def run(
     `analysis_baseline` names a findings snapshot (analysis/baseline.py)
     so strict mode only trips on NEW findings."""
     global _last_engine
-    from pathway_tpu.internals import faults, telemetry
+    from pathway_tpu.internals import faults, health, telemetry
     from pathway_tpu.internals.config import pathway_config as cfg
 
     if mesh is not None:
@@ -204,6 +204,12 @@ def run(
     # Arm the chaos harness once per run, before any worker starts
     # (per-worker arming would race and reset fire-once budgets).
     faults.install_from_env()
+
+    # Reset the health controller's transient per-run state (drained
+    # replicas, held backpressure) so one run's degradations never leak
+    # into the next; action counters stay cumulative.
+    if health.ENABLED:
+        health.controller().on_run_start()
 
     # Build the mesh execution backend BEFORE the graph builds: index
     # impls adopt it at build time (stdlib/indexing).  With too few
@@ -230,6 +236,8 @@ def run(
                 **kwargs,
             )
         finally:
+            if health.ENABLED:
+                health.controller().on_run_end()
             if mesh is not None:
                 mesh_backend.deactivate()
 
@@ -283,6 +291,10 @@ def run(
         # replay sampled spans to OTel (no-op without an endpoint)
         if engine is not None:
             telemetry.export_engine_trace(engine)
+        # release any backpressure the controller still holds — a run's
+        # throttle must not leak into the next run in this process
+        if health.ENABLED:
+            health.controller().on_run_end()
         if mesh is not None:
             from pathway_tpu.internals import mesh_backend
 

@@ -32,6 +32,14 @@ from typing import Callable, List, Optional, Sequence
 # this; anything nonzero is restartable under PATHWAY_FAILOVER=1).
 WORKER_KILLED_EXIT = 43
 
+# Exit code for a GRACEFUL restart (faults.WorkerRestart — the health
+# controller's rolling restart, or the restart_worker directive).  The
+# chaos scripts catch WorkerRestart before WorkerKilled and exit with
+# this; graceful restarts are always respawned and never consume the
+# crash-restart budget — a planned roll must not eat the headroom kept
+# for real failures.
+WORKER_RESTART_EXIT = 44
+
 DEFAULT_MAX_RESTARTS = 3
 
 
@@ -41,18 +49,26 @@ class RestartPolicy:
     def __init__(self, max_restarts: int = DEFAULT_MAX_RESTARTS):
         self.max_restarts = max_restarts
         self.restarts = 0
+        self.graceful_restarts = 0
 
-    def may_restart(self, *, injected: bool) -> bool:
-        """Injected kills are always failover-eligible; organic crashes
-        only under PATHWAY_FAILOVER=1.  Both consume the budget."""
+    def may_restart(self, *, injected: bool, graceful: bool = False) -> bool:
+        """Graceful (rolling) restarts always respawn and never consume
+        the budget.  Injected kills are always failover-eligible;
+        organic crashes only under PATHWAY_FAILOVER=1 — both consume
+        the budget."""
+        if graceful:
+            return True
         if self.restarts >= self.max_restarts:
             return False
         if injected:
             return True
         return os.environ.get("PATHWAY_FAILOVER") == "1"
 
-    def note_restart(self) -> None:
-        self.restarts += 1
+    def note_restart(self, *, graceful: bool = False) -> None:
+        if graceful:
+            self.graceful_restarts += 1
+        else:
+            self.restarts += 1
 
 
 class ProcessSupervisor:
@@ -101,10 +117,13 @@ class ProcessSupervisor:
             self.exit_codes.append(rc)
             if rc == 0 or not self._restartable(rc):
                 return rc
-            injected = rc == WORKER_KILLED_EXIT
-            if not self.policy.may_restart(injected=injected):
+            graceful = rc == WORKER_RESTART_EXIT
+            injected = graceful or rc == WORKER_KILLED_EXIT
+            if not self.policy.may_restart(
+                injected=injected, graceful=graceful
+            ):
                 return rc
-            self.policy.note_restart()
+            self.policy.note_restart(graceful=graceful)
             self.proc = self._spawn()
 
 

@@ -196,6 +196,34 @@ def render_status(status: dict) -> str:
                 f" {straggler.get('streak')} dispatches)"
             )
         lines.append(line)
+    health = status.get("health")
+    if health and health.get("enabled"):
+        line = f"health: bp_scale={health.get('backpressure_scale')}"
+        if health.get("pressure"):
+            line += f" PRESSURE[{health.get('pressure_reason')}]"
+        drained = health.get("drained_replicas") or {}
+        if drained:
+            line += f" drained={sorted(drained)}"
+        roll = health.get("rolling_restart") or {}
+        if roll.get("in_progress"):
+            cur = roll.get("current") or {}
+            line += (
+                f" rolling worker {cur.get('worker')} ({cur.get('phase')})"
+            )
+        elif roll.get("last"):
+            last = roll["last"]
+            line += (
+                f" last roll: {len(last.get('workers', []))} workers in "
+                f"{last.get('total_s')}s (max recovery "
+                f"{last.get('max_recovery_s')}s)"
+            )
+        actions = health.get("actions") or {}
+        acted = {k: v for k, v in actions.items() if v}
+        if acted:
+            line += " actions=" + ",".join(
+                f"{k}:{v}" for k, v in sorted(acted.items())
+            )
+        lines.append(line)
     analysis = status.get("analysis")
     if analysis and analysis.get("findings"):
         lines.append(f"analysis findings: {len(analysis['findings'])}")
@@ -214,6 +242,56 @@ def main_status(args) -> int:
         print(json.dumps(status, indent=2, sort_keys=True))
     else:
         print(render_status(status))
+    return 0
+
+
+def main_restart(args) -> int:
+    """Entry point for the cli.py `restart` subcommand: ask a RUNNING
+    job's monitoring server to start a rolling restart (drain and
+    respawn one worker at a time, under load, exactly-once sinks
+    preserved).  ``--workers 0,2`` limits the roll; default rolls every
+    worker the server knows about."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    base = args.url or f"http://127.0.0.1:{args.port}"
+    url = base.rstrip("/") + "/restart"
+    if args.workers:
+        url += "?" + urllib.parse.urlencode({"workers": args.workers})
+    try:
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            result = json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        try:
+            result = json.loads(exc.read().decode())
+        except Exception:  # noqa: BLE001
+            result = {"error": str(exc)}
+    except Exception as exc:  # noqa: BLE001 — connection refused etc.
+        print(
+            f"error: could not reach {url}: {exc} — is the job running "
+            "with pw.run(with_http_server=True)?",
+            file=sys.stderr,
+        )
+        return 1
+    if result.get("error"):
+        print(f"error: {result['error']}", file=sys.stderr)
+        roll = result.get("rolling_restart") or {}
+        if roll.get("in_progress"):
+            cur = roll.get("current") or {}
+            print(
+                f"  a roll is already in progress: worker "
+                f"{cur.get('worker')} ({cur.get('phase')}), "
+                f"queued={roll.get('queued')}",
+                file=sys.stderr,
+            )
+        return 1
+    workers = result.get("requested", [])
+    print(
+        f"rolling restart requested for {len(workers)} worker(s): "
+        f"{workers} — one at a time, under load; watch progress with "
+        "`pathway-tpu status` (health line)"
+    )
     return 0
 
 

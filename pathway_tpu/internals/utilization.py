@@ -195,6 +195,30 @@ def tracker() -> UtilizationTracker:
     return _TRACKER
 
 
+def current_bound_state() -> str:
+    """Cheap control input for the health controller's backpressure
+    loop: just the window's span sums and the classification — none of
+    the costmodel/MFU work a full snapshot() pays.  "idle" when the
+    accounting is disabled (the controller then never throttles on it)."""
+    if not ENABLED:
+        return "idle"
+    t = _TRACKER
+    now = time.monotonic()
+    with t._lock:
+        t._prune(now)
+        batches = t._batches
+        dispatches = len(batches)
+        window = (
+            min(t.window_s, max(now - batches[0][0], 1e-9))
+            if dispatches
+            else t.window_s
+        )
+        prep = sum(d for _, d in t._spans["prep"])
+        dispatch = sum(d for _, d in t._spans["dispatch"])
+        wait = sum(d for _, d in t._spans["wait"])
+    return classify_bound_state(window, prep, dispatch, wait, dispatches)
+
+
 def reset_window(window_s: float = WINDOW_S) -> UtilizationTracker:
     """Replace the process tracker with a fresh (empty) window — used by
     tests and by bench.py to scope the live-MFU cross-check to exactly

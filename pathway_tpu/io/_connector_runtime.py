@@ -554,7 +554,7 @@ class StreamingDriver:
         import os
 
         from pathway_tpu.engine.engine import EngineError, FailoverRequired
-        from pathway_tpu.internals import faults
+        from pathway_tpu.internals import faults, health
 
         threads = []
         active = 0
@@ -720,6 +720,13 @@ class StreamingDriver:
         replayed = compute_replay()
         time = 2  # set per attempt in the run loop below
         started = False
+        # chaos directives bind to runs STARTED while they are armed: a
+        # driver from before the arming (e.g. a never-terminating
+        # webserver pipeline left on a daemon thread) must not tick the
+        # harness with its own frozen logical time — it would overwrite
+        # the mem-pressure gauge and could even consume one-shot
+        # directives meant for the armed run
+        chaos_gen = faults.generation()
 
         pending: Dict[LiveSource, List] = {}
         states: Dict[LiveSource, Any] = {}
@@ -757,12 +764,22 @@ class StreamingDriver:
             worker reaches the same tick — that is the frontier protocol."""
             nonlocal time, last_flush, last_snapshot, done
             nonlocal dirty_since_snapshot, batch_arrival
-            if faults.ACTIVE:
+            gen_ok = not faults.ACTIVE or faults.generation() == chaos_gen
+            if faults.ACTIVE and gen_ok:
                 # deterministic chaos: may raise WorkerKilled (this worker
                 # dies at its scheduled epoch, BEFORE voting — peers see a
                 # dead peer mid-agree, exactly like a real crash) or sever
                 # a peer socket
                 faults.on_epoch(my_worker, time, self.engine.coord)
+            if health.ENABLED and gen_ok:
+                # the closed-loop controller's tick: may drain/re-admit a
+                # replica, adjust backpressure, or raise WorkerRestart
+                # (rolling restart) — which the failover path absorbs
+                # exactly like an injected kill.  Stale-generation runs
+                # skip this too while a harness is armed, so an armed
+                # chaos run's health actions stay a pure function of its
+                # own directive schedule.
+                health.on_epoch(my_worker, time, self.engine)
             self.engine.flush_ticks = getattr(self.engine, "flush_ticks", 0) + 1
             has_data = any(
                 (committed_upto.get(live, 0) > 0 or not gated(live)
@@ -1002,6 +1019,13 @@ class StreamingDriver:
                         t.start()
                     started = True
                 while not done:
+                    if health.ENABLED:
+                        # adaptive backpressure: while the controller
+                        # holds pressure, pace ingest with its
+                        # Backoff-derived delay (0.0 otherwise)
+                        throttle = health.controller().throttle_delay()
+                        if throttle > 0.0:
+                            time_mod.sleep(throttle)
                     timeout = max(
                         0.0,
                         self.autocommit_s
@@ -1026,7 +1050,13 @@ class StreamingDriver:
                     # here load itself sets the batch size).  Bounded so a
                     # hot source cannot starve the autocommit deadline /
                     # multi-worker barrier.
-                    while len(events) < 4096:
+                    drain_budget = 4096
+                    if health.ENABLED:
+                        # backpressure shrinks the micro-batch coalescing
+                        # bound too: smaller engine batches while memory
+                        # or the host is the bottleneck
+                        drain_budget = health.controller().ingest_budget(4096)
+                    while len(events) < drain_budget:
                         try:
                             ev = self.queue.get_nowait()
                         except queue_mod.Empty:

@@ -174,13 +174,22 @@ class MessageQueueSubject(ConnectorSubjectBase):
         try:
             # transient broker hiccups: shared capped-exponential backoff
             # (surfaced as pathway_connector_retries / _backoff_seconds)
-            # before a persistent failure kills the reader
-            backoff = Backoff(base=0.05, cap=1.0, seed=0)
+            # before a persistent failure kills the reader.  Full jitter
+            # with a per-worker seed decorrelates workers that lost the
+            # same broker (no thundering-herd reconnect); max_elapsed
+            # bounds the total stall a flapping broker can cause.
+            backoff = Backoff(
+                base=0.05,
+                cap=1.0,
+                full_jitter=True,
+                max_elapsed=5.0,
+                seed=self._worker_id,
+            )
             while True:
                 try:
                     batch = self._client.poll(self.poll_timeout)
                 except Exception:
-                    if backoff.attempt >= 5:
+                    if backoff.exhausted():
                         self.report_retry(0.0)
                         raise
                     delay = backoff.next_delay()

@@ -57,12 +57,21 @@ class _HttpSubject(ConnectorSubjectBase):
     def run(self) -> None:
         from pathway_tpu.internals.backoff import Backoff
 
-        backoff = Backoff(base=0.5, cap=30.0, seed=0)
+        # full jitter + per-worker seed: workers polling the same origin
+        # decorrelate their retries; max_elapsed caps the total backoff a
+        # dead endpoint can accumulate before the reader fails loudly
+        backoff = Backoff(
+            base=0.5,
+            cap=30.0,
+            full_jitter=True,
+            max_elapsed=120.0,
+            seed=self._worker_id,
+        )
         while True:
             try:
                 self._fetch()
             except Exception:  # noqa: BLE001 — network/HTTP errors
-                if backoff.attempt >= 5:
+                if backoff.exhausted():
                     self.report_retry(0.0)
                     raise
                 delay = backoff.next_delay()

@@ -623,6 +623,14 @@ class FusedEmbedSearch:
                 for r in range(dp)
             ]
             replica_slab = [int(block * seg.shape[1])] * dp
+            drained = self.backend.drained_replicas()
+            for r in drained:
+                # a drained replica's block is INTENTIONALLY empty (the
+                # health controller routed ingest around it); count it
+                # as zero slab so the pad-waste gauge and the straggler
+                # detector don't read a planned drain as 100% waste/skew
+                if 0 <= r < dp:
+                    replica_slab[r] = replica_real[r]
         elif budget > 0 and texts:
             ids, seg, slots = pack_batch(
                 self.encoder.tokenizer,

@@ -1,0 +1,537 @@
+"""Closed-loop health controller (internals/health.py): replica drain &
+re-admit over the mesh straggler detector, rolling restarts through the
+epoch-fenced failover path, and AIMD adaptive backpressure driven by the
+mem_pressure fault directive / memory headroom / bound-state gauges.
+
+Chaos end-to-end coverage (drain preserves ranking-exact retrieval,
+rolling restarts keep sinks exactly-once across 2 thread + 2 TCP
+workers) lives in tests/test_recovery.py; the <5% armed-but-idle guard
+lives in tests/test_perf_smoke.py."""
+
+import contextlib
+import json
+import os
+import socket
+import subprocess
+import sys
+import time as time_mod
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pathway_tpu.internals import device_pipeline, faults, health
+
+
+@pytest.fixture(autouse=True)
+def _fresh_controller():
+    from pathway_tpu.internals import utilization
+
+    # earlier tests feed the process-global rolling utilization window;
+    # a stale host-bound verdict would read as real pressure here
+    utilization.reset_window()
+    health.reset_for_tests()
+    try:
+        yield
+    finally:
+        faults.clear()
+        utilization.reset_window()
+        device_pipeline.set_backpressure_scale(1.0)
+        health.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# actuator 3: adaptive backpressure (AIMD)
+# ---------------------------------------------------------------------------
+
+
+def test_aimd_shrinks_under_injected_pressure_and_recovers():
+    """mem_pressure@bytes,epoch,until: the controller halves the pipeline
+    budget each pressured tick (floor BP_MIN_SCALE), throttles ingest,
+    then re-expands additively to 1.0 when the directive clears."""
+    c = health.controller()
+    faults.install("mem_pressure@bytes=999999999999,epoch=2,until=6")
+
+    scales = []
+    for epoch in range(12):
+        faults.on_epoch(0, epoch)
+        c.on_epoch(0, epoch)
+        scales.append(c._bp_scale)
+
+    # epochs 0-1: no pressure
+    assert scales[0] == 1.0 and scales[1] == 1.0
+    # epochs 2-5: multiplicative decrease 0.5 -> 0.25 -> 0.125 (floor)
+    assert scales[2] == pytest.approx(0.5)
+    assert scales[3] == pytest.approx(0.25)
+    assert scales[4] == pytest.approx(health.BP_MIN_SCALE)
+    assert scales[5] == pytest.approx(health.BP_MIN_SCALE)
+    # epochs 6+: additive increase +0.25 per tick back to exactly 1.0
+    assert scales[6] == pytest.approx(0.375)
+    assert scales[9] == pytest.approx(1.0)
+    assert scales[-1] == 1.0
+    # the module-level pipeline scale is restored for future pipelines
+    assert device_pipeline.backpressure_scale() == 1.0
+
+    actions = c.action_counts()
+    assert actions["throttle"] >= 3
+    assert actions["relax"] == 1
+    # mem_pressure armed/cleared events recorded by the fault harness
+    kinds = [k for k, _d, _t in faults.events]
+    assert "mem_pressure" in kinds and "mem_pressure_clear" in kinds
+    # flight recorder carries the throttle/relax trail for /status
+    ev = [e["kind"] for e in c.recorder.tail(32)]
+    assert "health_throttle" in ev and "health_relax" in ev
+
+
+def test_throttle_delay_and_ingest_budget_scale_with_pressure():
+    c = health.controller()
+    assert c.throttle_delay() == 0.0
+    assert c.ingest_budget(4096) == 4096
+    faults.install("mem_pressure@bytes=1000000,epoch=0")
+    faults.on_epoch(0, 0)
+    c.on_epoch(0, 0)
+    first = c.throttle_delay()
+    assert first > 0.0
+    assert c.ingest_budget(4096) == 2048
+    c.on_epoch(0, 1)
+    assert c.throttle_delay() >= first  # escalating while pressure holds
+    assert c.ingest_budget(4096) == 1024
+    # floor: the drain budget never throttles below 256 events/tick
+    c._bp_scale = health.BP_MIN_SCALE
+    assert c.ingest_budget(1024) == 256
+    faults.clear()
+    # disarmed harness: the pressure sensors are wall-clock paced again,
+    # so step past the pacing window before the clear tick
+    time_mod.sleep(health.PRESSURE_CHECK_S + 0.05)
+    faults.on_epoch(0, 2)
+    c.on_epoch(0, 2)
+    assert c.throttle_delay() == 0.0
+
+
+def test_pressure_reason_from_memtrack_headroom(monkeypatch):
+    """Real-headroom path (no faults): crossing HEADROOM_WARN_PCT is a
+    pressure reason; comfortable headroom is not."""
+    from pathway_tpu.internals import memtrack
+
+    c = health.controller()
+    monkeypatch.setattr(memtrack, "headroom_pct", lambda: 4.0)
+    reason = c._pressure_reason_now(faults)
+    assert reason is not None and "headroom" in reason
+    monkeypatch.setattr(memtrack, "headroom_pct", lambda: 55.0)
+    assert c._pressure_reason_now(faults) is None
+
+
+def test_pressure_reason_from_bound_state(monkeypatch):
+    from pathway_tpu.internals import utilization
+
+    c = health.controller()
+    monkeypatch.setattr(
+        utilization, "current_bound_state", lambda: "host-bound"
+    )
+    reason = c._pressure_reason_now(faults)
+    assert reason == "bound_state=host-bound"
+    monkeypatch.setattr(
+        utilization, "current_bound_state", lambda: "compute-bound"
+    )
+    assert c._pressure_reason_now(faults) is None
+
+
+def test_new_pipelines_adopt_held_backpressure():
+    """A pipeline born while pressure holds starts with the scaled
+    budget (the module scale applies at construction)."""
+    from pathway_tpu.internals.device_pipeline import DevicePipeline
+
+    def _pipe():
+        return DevicePipeline(
+            lambda item: (item, {}),
+            lambda payload: payload,
+            max_in_flight=8,
+            max_prepared=16,
+        )
+
+    base = _pipe()
+    born = None
+    try:
+        assert base.max_in_flight == 8
+        device_pipeline.set_backpressure_scale(0.25)
+        assert base.max_in_flight == 2  # live pipelines shrink in place
+        born = _pipe()
+        assert born.max_in_flight == 2  # born under pressure adopts it
+        device_pipeline.set_backpressure_scale(1.0)
+        assert base.max_in_flight == 8 and born.max_in_flight == 8
+    finally:
+        device_pipeline.set_backpressure_scale(1.0)
+        base.close()
+        if born is not None:
+            born.close()
+
+
+# ---------------------------------------------------------------------------
+# actuator 1: replica drain & re-admit (8 emulated devices)
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def _mesh(spec: str):
+    import jax
+
+    from pathway_tpu.analysis.mesh import MeshSpec
+    from pathway_tpu.internals import mesh_backend
+
+    need = MeshSpec.parse(spec).devices()
+    if len(jax.devices()) < need:
+        pytest.skip(f"needs {need} devices (conftest emulates 8)")
+    backend = mesh_backend.activate(MeshSpec.parse(spec))
+    try:
+        yield backend
+    finally:
+        mesh_backend.deactivate()
+
+
+def _trip_straggler(backend, replica_rows):
+    from pathway_tpu.internals import mesh_backend
+
+    for _ in range(mesh_backend.SKEW_PATIENCE + 2):
+        backend.note_dispatch_device_time(0.01, replica_rows=replica_rows)
+
+
+def test_straggler_drain_and_readmit_cycle():
+    """Injected slow replica -> controller drains it (action counter +
+    flight event + gauge), routes new ingest around it, and re-admits it
+    after READMIT_PROBES healthy ticks once the fault clears."""
+    c = health.controller()
+    with _mesh("dp=4,tp=2") as backend:
+        faults.install("slow_replica@replica=2,factor=8")
+        _trip_straggler(backend, [4, 4, 4, 4])
+        assert backend.straggler() is not None
+
+        c.on_epoch(0, epoch=10)
+        assert backend.drained_replicas() == [2]
+        assert c.action_counts()["drain"] == 1
+        ev = [e["kind"] for e in c.recorder.tail(32)]
+        assert "health_drain" in ev
+        assert "replica_drained" in [
+            e["kind"] for e in backend.recorder.tail(32)
+        ]
+        # deterministic detour: keys that hashed to replica 2 now land on
+        # the same surviving replica every time
+        assert backend.dp_shard_of(2) != 2
+        assert backend.dp_shard_of(2) == backend.dp_shard_of(2)
+        for k in range(8):
+            assert backend.dp_shard_of(k) != 2
+
+        # while the injected slowdown is armed the replica never heals
+        for epoch in range(11, 11 + health.READMIT_PROBES + 2):
+            c.on_epoch(0, epoch)
+        assert backend.drained_replicas() == [2]
+        assert c.action_counts()["readmit"] == 0
+
+        # fault cleared: READMIT_PROBES consecutive healthy ticks re-admit
+        faults.clear()
+        for epoch in range(30, 30 + health.READMIT_PROBES):
+            c.on_epoch(0, epoch)
+        assert backend.drained_replicas() == []
+        assert c.action_counts()["readmit"] == 1
+        assert backend.dp_shard_of(2) == 2  # routing restored
+        status = c.status()
+        assert status["drained_replicas"] == {}
+        assert any(
+            e["kind"] == "health_readmit" for e in c.recorder.tail(32)
+        )
+
+
+def test_drain_preserves_ranking_exact_retrieval():
+    """The acceptance property: searches during a drain return exactly
+    the single-device results — the drained replica's index shard stays
+    searchable, only NEW ingest re-routes."""
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    rng = np.random.default_rng(11)
+    d = 16
+    vecs = rng.standard_normal((64, d)).astype(np.float32)
+    queries = rng.standard_normal((5, d)).astype(np.float32)
+
+    reference = DeviceKnnIndex(d, metric="l2sq", reserved_space=64)
+    c = health.controller()
+    with _mesh("dp=4,tp=2") as backend:
+        sharded = DeviceKnnIndex(
+            d, metric="l2sq", reserved_space=64, mesh=backend.mesh
+        )
+        # first half ingested while healthy, routed by dp shard
+        keys1 = [f"k{i}" for i in range(32)]
+        sharded.add_batch(
+            keys1, vecs[:32], shards=[backend.dp_shard_of(k) for k in keys1]
+        )
+        reference.add_batch(keys1, vecs[:32])
+
+        faults.install("slow_replica@replica=1,factor=8")
+        _trip_straggler(backend, [4, 4, 4, 4])
+        c.on_epoch(0, epoch=5)
+        assert backend.drained_replicas() == [1]
+
+        # second half lands mid-drain: routing detours around replica 1
+        keys2 = [f"k{i}" for i in range(32, 64)]
+        shards2 = [backend.dp_shard_of(k) for k in keys2]
+        assert 1 not in shards2
+        sharded.add_batch(keys2, vecs[32:], shards=shards2)
+        reference.add_batch(keys2, vecs[32:])
+
+        got = sharded.search_keys(queries, 8)
+        want = reference.search_keys(queries, 8)
+        for got_row, want_row in zip(got, want):
+            assert [k for k, _s in got_row] == [k for k, _s in want_row]
+            for (_gk, gs), (_wk, ws) in zip(got_row, want_row):
+                assert gs == pytest.approx(ws, rel=1e-5)
+        faults.clear()
+
+
+def test_drain_never_removes_last_replica():
+    c = health.controller()
+    with _mesh("dp=2,tp=1") as backend:
+        assert backend.drain_replica(0, reason="test")
+        # draining the survivor must refuse
+        assert not backend.drain_replica(1, reason="test")
+        assert backend.drained_replicas() == [0]
+        assert backend.dp_shard_of(0) == 1
+
+
+def test_drain_records_barrier_duration():
+    """The drain actuator barriers in-flight pipeline windows from a
+    helper thread and records the duration on the drain record."""
+    c = health.controller()
+    with _mesh("dp=4,tp=2") as backend:
+        faults.install("slow_replica@replica=3,factor=8")
+        _trip_straggler(backend, [4, 4, 4, 4])
+        c.on_epoch(0, epoch=1)
+        assert backend.drained_replicas() == [3]
+        deadline = time_mod.monotonic() + 5.0
+        while time_mod.monotonic() < deadline:
+            info = c._drained.get(3)
+            if info is not None and "drain_barrier_s" in info:
+                break
+            time_mod.sleep(0.01)
+        else:
+            pytest.fail("drain barrier never completed")
+        ev = [e["kind"] for e in c.recorder.tail(32)]
+        assert "health_drain_complete" in ev
+        status = c.status()
+        assert status["drained_replicas"]["3"]["drain_barrier_s"] is not None
+        faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# actuator 2: rolling restart (state machine + directive + HTTP route)
+# ---------------------------------------------------------------------------
+
+
+def test_rolling_restart_state_machine_one_at_a_time():
+    c = health.controller()
+    st = c.request_rolling_restart([0, 1])
+    assert st["in_progress"] and st["current"]["worker"] == 0
+    assert st["queued"] == [1]
+    # a second request while rolling is refused (one roll at a time)
+    with pytest.raises(RuntimeError):
+        c.request_rolling_restart([0])
+
+    # other workers tick through unaffected; the target is killed
+    c.on_epoch(1, 4)
+    with pytest.raises(faults.WorkerRestart):
+        c.on_epoch(0, 5)
+    assert c.action_counts()["restart"] == 1
+    # worker 1 is NOT the target yet — it keeps ticking
+    c.on_epoch(1, 5)
+
+    # respawned worker 0's first tick completes its recovery, arms w1
+    c.on_epoch(0, 6)
+    st = c.rolling_restart_status()
+    assert st["current"]["worker"] == 1
+    assert st["recovery"][0]["worker"] == 0
+    assert c.action_counts()["restart_done"] == 1
+
+    with pytest.raises(faults.WorkerRestart):
+        c.on_epoch(1, 7)
+    c.on_epoch(1, 8)
+    st = c.rolling_restart_status()
+    assert not st["in_progress"]
+    assert st["last"]["workers"] == [0, 1]
+    assert st["last"]["max_recovery_s"] >= 0
+    assert len(st["last"]["recovery"]) == 2
+    assert c.action_counts() == {
+        "drain": 0,
+        "readmit": 0,
+        "restart": 2,
+        "restart_done": 2,
+        "throttle": 0,
+        "relax": 0,
+    }
+    ev = [e["kind"] for e in c.recorder.tail(32)]
+    assert "health_roll_requested" in ev and "health_roll_complete" in ev
+
+    # the roll finished: a new request is accepted again
+    st = c.request_rolling_restart([0])
+    assert st["in_progress"]
+
+
+def test_restart_worker_directive_raises_graceful_restart():
+    """restart_worker@worker,epoch fires WorkerRestart (a WorkerKilled
+    subclass, so every failover path absorbs it) exactly once, on the
+    right worker."""
+    faults.install("restart_worker@worker=1,epoch=3")
+    faults.on_epoch(0, 3)  # wrong worker: nothing
+    faults.on_epoch(1, 2)  # right worker, too early: nothing
+    with pytest.raises(faults.WorkerRestart) as exc_info:
+        faults.on_epoch(1, 3)
+    assert isinstance(exc_info.value, faults.WorkerKilled)
+    faults.on_epoch(1, 4)  # fires once
+    assert [k for k, _d, _t in faults.events] == ["restart_worker"]
+
+
+def test_supervisor_graceful_restart_skips_crash_budget():
+    from pathway_tpu.internals.supervisor import (
+        WORKER_RESTART_EXIT,
+        RestartPolicy,
+    )
+
+    policy = RestartPolicy(max_restarts=1)
+    assert policy.may_restart(injected=True)
+    policy.note_restart()
+    # crash budget exhausted...
+    assert not policy.may_restart(injected=True)
+    # ...but graceful rolls still respawn, billed separately
+    assert policy.may_restart(injected=True, graceful=True)
+    policy.note_restart(graceful=True)
+    assert policy.restarts == 1 and policy.graceful_restarts == 1
+    assert WORKER_RESTART_EXIT != 0
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_restart_http_endpoint_queues_roll_and_409s_when_busy():
+    import pathway_tpu as pw
+    from pathway_tpu.internals.monitoring import PrometheusServer
+    from pathway_tpu.internals.runner import run_tables
+
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+    (cap,) = run_tables(t.select(b=pw.this.a + 1))
+    server = PrometheusServer(cap.engine, port=_free_port())
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/restart?workers=0", timeout=5) as r:
+            payload = json.loads(r.read().decode())
+        assert payload["requested"] == [0]
+        assert payload["rolling_restart"]["in_progress"]
+        # a second request while the roll is pending: 409 + roll status
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(base + "/restart", timeout=5)
+        assert exc_info.value.code == 409
+        body = json.loads(exc_info.value.read().decode())
+        assert body["rolling_restart"]["in_progress"]
+        # /status surfaces the in-progress roll under "health"
+        with urllib.request.urlopen(base + "/status", timeout=5) as r:
+            status = json.loads(r.read().decode())
+        assert status["health"]["enabled"]
+        assert status["health"]["rolling_restart"]["in_progress"]
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# faults: read-only replica_slowed probe
+# ---------------------------------------------------------------------------
+
+
+def test_replica_slowed_probe_is_read_only():
+    faults.install("slow_replica@replica=2,factor=8,count=2")
+    # polling never consumes the count budget
+    for _ in range(10):
+        assert faults.replica_slowed(2)
+    assert not faults.replica_slowed(1)
+    # the real accounting hook does consume it
+    assert faults.replica_factor(2) == 8.0
+    assert faults.replica_factor(2) == 8.0
+    assert faults.replica_factor(2) == 1.0  # budget gone
+    assert not faults.replica_slowed(2)
+
+
+# ---------------------------------------------------------------------------
+# disabled path: PATHWAY_HEALTH=0
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_health_reports_and_skips_hooks():
+    """PATHWAY_HEALTH=0: ENABLED False, /status says disabled, no
+    registry is exported, and a full pw.run never instantiates the
+    controller (subprocess: env must be set before import)."""
+    code = r"""
+import os, sys
+os.environ["PATHWAY_HEALTH"] = "0"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+from pathway_tpu.internals import health
+
+assert health.ENABLED is False
+assert health.health_status() == {"enabled": False}
+assert health.health_metrics() is None
+
+t = pw.debug.table_from_markdown('''
+a
+1
+''')
+rows = []
+pw.io.subscribe(
+    t.select(b=pw.this.a * 2),
+    on_change=lambda key, row, time, is_addition: rows.append(row),
+)
+pw.run(monitoring_level=None)
+assert rows == [{"b": 2}]
+# the singleton never materialized: every hook was one attribute read
+assert health._CONTROLLER is None
+print("OK")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "OK" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: one run's throttle never leaks into the next
+# ---------------------------------------------------------------------------
+
+
+def test_run_lifecycle_resets_backpressure():
+    c = health.controller()
+    faults.install("mem_pressure@bytes=1000000,epoch=0")
+    faults.on_epoch(0, 0)
+    c.on_epoch(0, 0)
+    assert c._bp_scale < 1.0 and c.throttle_delay() > 0.0
+    c.on_run_end()
+    assert c._bp_scale == 1.0
+    assert c.throttle_delay() == 0.0
+    assert device_pipeline.backpressure_scale() == 1.0
+    # on_run_start from a dirty state also normalizes
+    c._bp_scale = 0.5
+    c._drained[3] = {"drained_at": 0.0, "healthy_probes": 0, "reason": "x"}
+    c.on_run_start()
+    assert c._bp_scale == 1.0 and c._drained == {}

@@ -9,8 +9,23 @@ import time
 import urllib.error
 import urllib.request
 
+import pytest
+
 import pathway_tpu as pw
 from pathway_tpu.io.http._server import PathwayWebserver, rest_connector
+
+
+@pytest.fixture(autouse=True)
+def _terminate_background_run():
+    # the webserver pipeline never terminates on its own; without this
+    # the daemon pw.run thread keeps ticking its driver loop (and the
+    # chaos/health hooks) for the rest of the test session
+    yield
+    from pathway_tpu.internals import runner
+
+    eng = runner.last_engine()
+    if eng is not None:
+        eng.terminate_flag.set()
 
 
 def _free_port() -> int:

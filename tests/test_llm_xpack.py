@@ -24,6 +24,19 @@ from pathway_tpu.xpacks.llm.splitters import (
 )
 
 
+@pytest.fixture
+def terminate_background_run():
+    # for tests that leave pw.run serving on a daemon thread: without
+    # this the never-terminating driver loop keeps ticking (including
+    # the chaos/health hooks) for the rest of the test session
+    yield
+    from pathway_tpu.internals import runner
+
+    eng = runner.last_engine()
+    if eng is not None:
+        eng.terminate_flag.set()
+
+
 class FakeEmbedder(UDF):
     """Characteristic one-hot-ish embeddings so KNN results are exact."""
 
@@ -394,7 +407,7 @@ def test_sharepoint_connector_with_fake_client():
     assert seen == {"/site/docs/a.txt": b"alpha", "/site/docs/b.txt": b"bravo"}
 
 
-def test_mcp_server_tool_roundtrip():
+def test_mcp_server_tool_roundtrip(terminate_background_run):
     """McpServer end-to-end: JSON-RPC initialize / tools/list / tools/call
     over HTTP against a live dataflow (reference: mcp_server.py:143)."""
     import json as json_mod

@@ -832,6 +832,294 @@ def test_store_failure_mid_snapshot_job_continues(tmp_path):
     )
 
 
+# -- self-healing runtime (internals/health.py): rolling restarts under
+# load and adaptive backpressure, exactly-once sinks throughout ----------
+
+
+def test_thread_rolling_restart_exactly_once_sinks(
+    two_thread_workers, tmp_path
+):
+    """A rolling restart requested mid-run (the /restart path) drains and
+    respawns worker 1 under load via the thread failover machinery; both
+    transactional sinks stay exactly-once and /status reports the
+    per-worker recovery time."""
+    import sqlite3
+    import threading
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals import health
+    from pathway_tpu.internals.monitoring import PrometheusServer
+    from pathway_tpu.internals.runner import last_engine
+
+    health.reset_for_tests()
+    n_rows = 80
+    tmp = str(tmp_path)
+    db = os.path.join(tmp, "mockpg.db")
+    with sqlite3.connect(db) as conn:
+        conn.execute(
+            "CREATE TABLE agg_rows "
+            "(k INTEGER, s INTEGER, time INTEGER, diff INTEGER)"
+        )
+
+    def pg_conn():
+        return sqlite3.connect(db, timeout=30, check_same_thread=False)
+
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time as time_mod
+
+            for i in range(n_rows):
+                self.next(k=i % 4, v=i)
+                self.commit()
+                time_mod.sleep(0.012)
+
+    t = pw.io.python.read(
+        Subject(),
+        schema=pw.schema_from_types(k=int, v=int),
+        name="roll_src",
+    )
+    sel = t.select(pw.this.k, pw.this.v)
+    agg = t.groupby(pw.this.k).reduce(
+        pw.this.k, s=pw.reducers.sum(pw.this.v)
+    )
+    pw.io.fs.write(sel, os.path.join(tmp, "out.jsonl"), format="json")
+    pw.io.postgres.write(
+        agg, {}, "agg_rows", _connection=pg_conn, _placeholder="?", name="pg"
+    )
+
+    seen = {"n": 0}
+    request_lock = threading.Lock()
+
+    def on_change(key, row, time, is_addition):
+        with request_lock:
+            seen["n"] += 1
+            # the job is demonstrably under load: ask for the roll once
+            if seen["n"] == 10:
+                health.controller().request_rolling_restart([1])
+
+    pw.io.subscribe(sel, on_change=on_change)
+
+    pw.run(
+        monitoring_level=None,
+        autocommit_duration_ms=15,
+        persistence_config=pw.persistence.Config(
+            pw.persistence.Backend.filesystem(os.path.join(tmp, "pstore")),
+            snapshot_interval_ms=20,
+        ),
+    )
+
+    # the roll completed: kill + respawn + recovery recorded
+    c = health.controller()
+    st = c.rolling_restart_status()
+    assert not st["in_progress"], st
+    assert st["last"] is not None, "rolling restart never completed"
+    assert st["last"]["workers"] == [1]
+    assert 0 <= st["last"]["max_recovery_s"] < 30.0
+    actions = c.action_counts()
+    assert actions["restart"] == 1 and actions["restart_done"] == 1
+    engine = last_engine()
+    assert engine is not None and engine.failover_count >= 1
+
+    # /status carries the bounded recovery time under "health"
+    status = PrometheusServer(engine).status_json()
+    roll = status["health"]["rolling_restart"]
+    assert roll["last"]["recovery"][0]["worker"] == 1
+    assert roll["last"]["recovery"][0]["recovery_s"] < 30.0
+
+    # jsonlines: every input row exactly once across the roll
+    rows = _read_json_parts(tmp, "out.jsonl")
+    assert all(r["diff"] == 1 for r in rows)
+    got = sorted((r["k"], r["v"]) for r in rows)
+    assert got == sorted((i % 4, i) for i in range(n_rows))
+
+    # postgres-mock: consolidated change stream nets to the final
+    # aggregate and the commit frontier advanced transactionally
+    expected = {
+        k: sum(i for i in range(n_rows) if i % 4 == k) for k in range(4)
+    }
+    with sqlite3.connect(db) as conn:
+        cons: dict = {}
+        for k, s, _time, diff in conn.execute(
+            "SELECT k, s, time, diff FROM agg_rows"
+        ):
+            cons[(k, s)] = cons.get((k, s), 0) + diff
+        final = {k: s for (k, s), net in cons.items() if net == 1}
+        assert final == expected, cons
+        assert all(net in (0, 1) for net in cons.values()), cons
+        committed = dict(
+            conn.execute("SELECT sink, frontier FROM __pathway_commit")
+        )
+    assert committed, "no transactional sink commit survived the roll"
+
+
+ROLL_TCP_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, "@@REPO@@")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+from pathway_tpu.internals.faults import WorkerKilled, WorkerRestart
+
+out_dir, pstore, n_rows = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+class Subject(pw.io.python.ConnectorSubject):
+    def run(self):
+        import time as time_mod
+        for i in range(n_rows):
+            self.next(k=i % 4, v=i)
+            self.commit()
+            time_mod.sleep(0.01)
+
+t = pw.io.python.read(
+    Subject(), schema=pw.schema_from_types(k=int, v=int), name="roll_src"
+)
+sel = t.select(pw.this.k, pw.this.v)
+pw.io.fs.write(sel, out_dir + "/out.jsonl", format="json")
+try:
+    pw.run(
+        monitoring_level=None,
+        autocommit_duration_ms=15,
+        persistence_config=pw.persistence.Config(
+            pw.persistence.Backend.filesystem(pstore),
+            snapshot_interval_ms=20,
+        ),
+    )
+except WorkerRestart:
+    sys.exit(44)  # graceful roll: WORKER_RESTART_EXIT
+except WorkerKilled:
+    sys.exit(43)
+"""
+
+
+def test_tcp_rolling_restart_graceful_respawn_exactly_once(tmp_path):
+    """TCP mode: an injected restart_worker directive rolls worker 1
+    (exit 44); the supervisor respawns it WITHOUT burning the crash
+    budget, it rejoins the running job, and output stays exactly-once."""
+    import subprocess
+
+    from _fakes import free_port_base
+
+    from pathway_tpu.internals.supervisor import (
+        WORKER_RESTART_EXIT,
+        ProcessSupervisor,
+        scrubbed_env,
+    )
+
+    tmp = str(tmp_path)
+    pstore = os.path.join(tmp, "pstore")
+    n_rows = 60
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(tmp, "roll_worker.py")
+    with open(script, "w") as f:
+        f.write(ROLL_TCP_SCRIPT.replace("@@REPO@@", repo))
+    base = free_port_base(2)
+
+    def env_for(pid):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            PATHWAY_PROCESSES="2",
+            PATHWAY_PROCESS_ID=str(pid),
+            PATHWAY_FIRST_PORT=str(base),
+        )
+        return env
+
+    env1 = env_for(1)
+    env1["PATHWAY_FAULTS"] = "restart_worker@worker=1,epoch=12"
+    spawned = {"n": 0}
+
+    def spawn1():
+        env = env1 if spawned["n"] == 0 else scrubbed_env(env1)
+        spawned["n"] += 1
+        return subprocess.Popen(
+            [sys.executable, script, tmp, pstore, str(n_rows)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+
+    proc0 = subprocess.Popen(
+        [sys.executable, script, tmp, pstore, str(n_rows)],
+        env=env_for(0),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+    )
+    # budget 0: ONLY graceful restarts may respawn — proves the roll
+    # never bills the crash budget
+    sup = ProcessSupervisor(spawn1, max_restarts=0)
+    sup.start()
+    rc1 = sup.watch(timeout_s=150)
+    last = sup.proc
+    out1, err1 = last.communicate(timeout=30)
+    assert rc1 == 0, err1.decode()[-2000:]
+    assert sup.exit_codes == [WORKER_RESTART_EXIT, 0], sup.exit_codes
+    assert sup.policy.graceful_restarts == 1
+    assert sup.policy.restarts == 0
+    out0, err0 = proc0.communicate(timeout=150)
+    assert proc0.returncode == 0, err0.decode()[-2000:]
+
+    rows = _read_json_parts(tmp, "out.jsonl")
+    assert all(r["diff"] == 1 for r in rows)
+    got = sorted((r["k"], r["v"]) for r in rows)
+    assert got == sorted((i % 4, i) for i in range(n_rows))
+
+
+def test_mem_pressure_throttles_then_recovers(tmp_path):
+    """Injected memory pressure mid-stream: the controller throttles the
+    pipeline budget (before any headroom floor is hit — no OOM), the
+    stream completes exactly-once, and the budget is restored to 1.0 by
+    the AIMD ramp once pressure clears — all within the run."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import device_pipeline, faults, health
+
+    health.reset_for_tests()
+    tmp = str(tmp_path)
+
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time as time_mod
+
+            for i in range(40):
+                self.next(k=i % 3, v=i)
+                self.commit()
+                time_mod.sleep(0.01)
+
+    t = pw.io.python.read(
+        Subject(),
+        schema=pw.schema_from_types(k=int, v=int),
+        name="mp_src",
+    )
+    pw.io.fs.write(
+        t.select(pw.this.k, pw.this.v),
+        os.path.join(tmp, "out.jsonl"),
+        format="json",
+    )
+    faults.install("mem_pressure@bytes=99999999999,epoch=5,until=12")
+    try:
+        pw.run(monitoring_level=None, autocommit_duration_ms=10)
+        kinds = [k for k, _d, _t in faults.events]
+        assert "mem_pressure" in kinds, "pressure directive never fired"
+        assert "mem_pressure_clear" in kinds, "pressure never cleared"
+    finally:
+        faults.clear()
+        pw.G.clear()
+
+    c = health.controller()
+    actions = c.action_counts()
+    assert actions["throttle"] >= 1, actions
+    # the AIMD ramp restored full budget DURING the run (relax fired),
+    # not merely via the end-of-run cleanup
+    assert actions["relax"] == 1, actions
+    assert device_pipeline.backpressure_scale() == 1.0
+    ev = [e["kind"] for e in c.recorder.tail(64)]
+    assert "health_throttle" in ev and "health_relax" in ev
+
+    rows = _read_json_parts(tmp, "out.jsonl")
+    assert all(r["diff"] == 1 for r in rows)
+    assert sorted((r["k"], r["v"]) for r in rows) == sorted(
+        (i % 3, i) for i in range(40)
+    )
+
+
 def test_device_flap_degrades_and_repromotes():
     """Injected device-probe flaps walk the monitor HEALTHY -> DEGRADED
     (host fallback gate flips on) -> HEALTHY again, without erroring."""
