@@ -711,6 +711,7 @@ def _fallback_payload(err: str, device_status: dict) -> dict:
         "observability_overhead": _observability_overhead(),
         "tracing_overhead": _tracing_overhead(),
         "failover_recovery_s": _failover_recovery_s(),
+        **_serving_facts(),
         **_multichip_facts(),
         **_degraded_facts(),
         **_memory_facts(),
@@ -872,6 +873,7 @@ def _run_device_round(device_status: dict) -> None:
                     _mfu_facts(device_rate, docs)["mfu_pct"],
                 ),
                 **_generation_facts(),
+                **_serving_facts(rtt_ms=rtt),
                 **_multichip_facts(),
                 **_degraded_facts(),
                 **_memory_facts(),
@@ -903,6 +905,42 @@ def _generation_facts() -> dict:
         return {"generation": json.loads(line)}
     except Exception as exc:  # noqa: BLE001 — never sink the main bench
         return {"generation": {"error": f"{type(exc).__name__}: {exc}"}}
+
+
+def _serving_facts(rtt_ms: float | None = None) -> dict:
+    """BENCH r06 serving baseline: closed-loop clients against the REST
+    connector in a CPU-pinned subprocess (benchmarks/serving_bench.py),
+    latency measured by the query tracer's mergeable digests — the same
+    numbers `/status "queries"` serves.  The pipeline is pure host, so
+    the section is never null on device-down rounds.  When the device is
+    up, `rtt_ms` (the device_probe RTT gauge's view of the tunnel) adds
+    the projection: a device-backed query pays at least one tunnel round
+    trip on top of this host-path p50, so `p50_ms_with_tunnel` is the
+    ex-tunnel/tunnel split stated as data."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    script = os.path.join(repo, "benchmarks", "serving_bench.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    try:
+        proc = subprocess.run(
+            [sys.executable, script],
+            capture_output=True,
+            timeout=600,
+            text=True,
+            env=env,
+        )
+        line = proc.stdout.strip().splitlines()[-1]
+        facts = json.loads(line)
+        if rtt_ms is not None and isinstance(
+            facts.get("p50_ms"), (int, float)
+        ):
+            facts["device_rtt_ms"] = round(rtt_ms, 2)
+            facts["p50_ms_with_tunnel"] = round(facts["p50_ms"] + rtt_ms, 2)
+        return {"serving": facts}
+    except Exception as exc:  # noqa: BLE001 — never sink the main bench
+        return {"serving": {"error": f"{type(exc).__name__}: {exc}"}}
 
 
 def _multichip_facts() -> dict:

@@ -1,0 +1,183 @@
+"""Serving baseline: closed-loop clients against the REST connector.
+
+The framework path of a query service (BENCH r06): HTTP ingress
+(io/http/_server.py rest_connector) -> engine batch -> select -> writer
+-> HTTP response, with latency measured by the query tracer's mergeable
+digests (internals/qtrace.py) — the SAME numbers `/status "queries"`
+and `pathway-tpu status` serve in production, so the bench certifies
+the observability path and the serving path in one run.
+
+Reported:
+  * digest p50/p95/p99/p999 of end-to-end latency plus the per-stage
+    breakdown (network / queue / batch / device / merge / emit);
+  * client-observed wall p50/p99 as a cross-check — the digest view is
+    measured server-side, so digest_total <= client_wall always, and a
+    big gap means connection handling (outside the span) dominates;
+  * closed-loop QPS at N_CLIENTS concurrent clients;
+  * SLO burn state after the run (pw.run(slo=...) exercises the
+    plumbing; the target is set loose enough that a healthy host run
+    never burns — `burning: true` here is itself a red flag).
+
+Pure host dataflow (the pipeline is a scalar select, no accelerator),
+so the section is identical on device-up and device-down rounds; the
+parent bench pairs it with the device RTT gauge for the tunnel
+projection.  Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_CLIENTS = 4
+N_PER_CLIENT = 64
+N_WARMUP = 8
+SLO_P99_MS = 2000.0
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_http(port: int, timeout: float = 30.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/_schema", timeout=5
+            ):
+                return
+        except Exception:
+            time.sleep(0.1)
+    raise TimeoutError("webserver did not come up")
+
+
+def _query(port: int, value: int, timeout: float = 60.0) -> float:
+    """One POST; returns client-observed wall seconds."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/serve",
+        data=json.dumps({"value": value}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        body = json.loads(resp.read())
+    wall = time.perf_counter() - t0
+    got = body if isinstance(body, int) else body.get("result")
+    assert got == value * 2, body
+    return wall
+
+
+def main() -> None:
+    # the serving path is pure host; keep any jax import off the device
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("PATHWAY_DEVICE_PROBE", "0")
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals import qtrace
+    from pathway_tpu.internals import runner as _runner
+    from pathway_tpu.io.http._server import PathwayWebserver, rest_connector
+
+    if not qtrace.ENABLED:
+        print(json.dumps({"error": "qtrace disabled (PATHWAY_QTRACE=0)"}))
+        return
+
+    port = _free_port()
+    webserver = PathwayWebserver("127.0.0.1", port)
+
+    class QuerySchema(pw.Schema):
+        value: int
+
+    queries, writer = rest_connector(
+        webserver=webserver,
+        route="/serve",
+        schema=QuerySchema,
+        methods=("POST",),
+        delete_completed_queries=False,
+    )
+    writer(queries.select(result=pw.this.value * 2))
+
+    run_thread = threading.Thread(
+        target=lambda: pw.run(slo=SLO_P99_MS), daemon=True
+    )
+    run_thread.start()
+    try:
+        _wait_http(port)
+        for i in range(N_WARMUP):
+            _query(port, i)
+        qtrace.reset()  # scope the digests to the measured window
+        tq = qtrace.tracker()
+        tq.set_slo(SLO_P99_MS)
+
+        walls: list[float] = []
+        walls_lock = threading.Lock()
+
+        def client(cid: int) -> None:
+            mine = []
+            for i in range(N_PER_CLIENT):
+                mine.append(_query(port, cid * N_PER_CLIENT + i))
+            with walls_lock:
+                walls.extend(mine)
+
+        t0 = time.perf_counter()
+        clients = [
+            threading.Thread(target=client, args=(c,))
+            for c in range(N_CLIENTS)
+        ]
+        for c in clients:
+            c.start()
+        for c in clients:
+            c.join(timeout=300)
+        elapsed = time.perf_counter() - t0
+    finally:
+        eng = _runner.last_engine()
+        if eng is not None:
+            eng.terminate_flag.set()
+
+    n = N_CLIENTS * N_PER_CLIENT
+    status = tq.status()
+    walls.sort()
+
+    def wall_q(q: float) -> float:
+        return round(walls[min(int(q * len(walls)), len(walls) - 1)] * 1000, 3)
+
+    total = status["stages"].get("total", {})
+    stage_p99 = {
+        s: ent.get("p99_ms")
+        for s, ent in status["stages"].items()
+        if s != "total"
+    }
+    print(
+        json.dumps(
+            {
+                "metric": "rest_serving_latency",
+                "n_clients": N_CLIENTS,
+                "n_queries": n,
+                "completed": status["completed"],
+                "qps": round(n / max(elapsed, 1e-9), 1),
+                "p50_ms": total.get("p50_ms"),
+                "p95_ms": total.get("p95_ms"),
+                "p99_ms": total.get("p99_ms"),
+                "p999_ms": total.get("p999_ms"),
+                "stage_p99_ms": stage_p99,
+                "client_wall_p50_ms": wall_q(0.50),
+                "client_wall_p99_ms": wall_q(0.99),
+                "slo_target_p99_ms": SLO_P99_MS,
+                "slo_burning": status["slo"]["burning"],
+                "slo_violations": status["slo"]["violations"],
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from pathway_tpu.engine.stream import Delta, TableState, consolidate
 from pathway_tpu.engine.value import ERROR, Error, Pointer
+from pathway_tpu.internals import qtrace as _qtrace
 
 
 class EngineError(Exception):
@@ -420,6 +421,10 @@ class Engine:
                 self.current_node = None
             for node in self.nodes:
                 node.on_time_end(time)
+        if _qtrace.ENABLED and self.worker_count > 1:
+            # query spans: non-zero workers ship their marks to worker 0,
+            # worker 0 absorbs whatever arrived (MSG_STAMP side-channel)
+            _qtrace.tracker().on_tick(self)
         self._gc_pulse()
 
     def _process_time_metrics(self, time: int, m) -> None:
@@ -557,6 +562,12 @@ class Engine:
 
         events = gather_trace_events(self)
         trace = build_chrome_trace(events)
+        if _qtrace.ENABLED:
+            # per-query span trees ride along under their own "queries"
+            # process row (internals/qtrace.py)
+            trace["traceEvents"].extend(
+                _qtrace.tracker().chrome_trace()["traceEvents"]
+            )
         validate_chrome_trace(trace)
         if path is not None:
             import json as json_mod

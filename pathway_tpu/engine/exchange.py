@@ -148,6 +148,17 @@ class Coordinator:
         when peers' sampling config diverges."""
         return {}
 
+    def send_qspans(self, dest: int, origin: int, payload: Any) -> None:
+        """Ship a query-span payload (internals/qtrace.py marks) toward
+        one destination worker.  Fire-and-forget like stamps: rides the
+        per-peer FIFO, never counted toward punctuation.  Single-worker
+        and same-process workers share one tracker, so the default is a
+        no-op."""
+
+    def take_qspans(self) -> list:
+        """Pop every received query-span payload: [(origin, payload)]."""
+        return []
+
     def close(self) -> None:
         pass
 
@@ -247,6 +258,9 @@ class TcpCoordinator(Coordinator):
         self._punct: Dict[Tuple[int, int], set] = {}
         # (channel, time) -> {origin: (send_wall, recv_wall)} tracing stamps
         self._stamps: Dict[Tuple[int, int], dict] = {}
+        # received query-span payloads: [(origin, payload)] — bounded by
+        # the drain in take_qspans(); capped defensively on receive
+        self._qspans: list = []
         # round -> {worker: payload}
         self._coord: Dict[int, Dict[int, Any]] = {}
         self._round = 0
@@ -532,6 +546,10 @@ class TcpCoordinator(Coordinator):
                         self._stamps.setdefault((channel, time), {})[
                             origin
                         ] = (wall, time_mod.time())
+                    elif kind == "qspan":
+                        _, origin, payload = msg
+                        if len(self._qspans) < 4096:  # drop, never grow
+                            self._qspans.append((origin, payload))
                     elif kind == "coord":
                         _, round_no, payload = msg
                         if round_no == FENCE_ROUND:
@@ -615,6 +633,7 @@ class TcpCoordinator(Coordinator):
             got.discard(peer)
         for stamps in self._stamps.values():
             stamps.pop(peer, None)
+        self._qspans = [q for q in self._qspans if q[0] != peer]
         for votes in self._coord.values():
             votes.pop(peer, None)
 
@@ -849,6 +868,16 @@ class TcpCoordinator(Coordinator):
     def take_stamps(self, channel: int, time: int) -> dict:
         with self._cv:
             return self._stamps.pop((channel, time), {})
+
+    def send_qspans(self, dest: int, origin: int, payload: Any) -> None:
+        if dest == self.worker_id:
+            return
+        self._dispatch(dest, self._encode_frame(("qspan", origin, payload)))
+
+    def take_qspans(self) -> list:
+        with self._cv:
+            out, self._qspans = self._qspans, []
+            return out
 
     def collect(self, channel: int, time: int, timeout: float = 600.0) -> list:
         """Block until every peer punctuated channel@time; return received
@@ -1307,6 +1336,19 @@ class _ThreadWorkerCoordinator(Coordinator):
                 origin,
                 wall,
             )
+
+    def send_qspans(self, dest: int, origin: int, payload: Any) -> None:
+        g = self.group
+        dest_p, _dest_t = divmod(dest, g.threads)
+        if dest_p == g.process_id:
+            return  # same process: the qtrace tracker is already shared
+        g.tcp.send_qspans(dest_p, origin, payload)
+
+    def take_qspans(self) -> list:
+        g = self.group
+        if g.tcp is None:
+            return []
+        return g.tcp.take_qspans()
 
     def take_stamps(self, channel: int, time: int) -> dict:
         g = self.group

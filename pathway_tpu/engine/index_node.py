@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, List, Optional, Set
 from pathway_tpu.engine.engine import Engine, Node
 from pathway_tpu.engine.operators import _DiffCache
 from pathway_tpu.engine.value import ERROR, Error, Pointer
+from pathway_tpu.internals import qtrace as _qtrace
 
 
 class IndexImpl:
@@ -223,7 +224,8 @@ class ExternalIndexNode(Node):
                         prev = self._emitted_asof.pop(qk, None)
                         if prev is not None:
                             out.append((qk, prev, -1))
-                results = self.index.search_many(
+                results = self._timed_search(
+                    [qk for qk, _, _, _, _ in live],
                     [v for _, v, _, _, _ in live],
                     [int(k) if k is not None else 3 for _, _, k, _, _ in live],
                     [f for _, _, _, f, _ in live],
@@ -243,7 +245,8 @@ class ExternalIndexNode(Node):
 
         if not self.as_of_now and (index_changed or query_deltas):
             items = list(self.query_rows.items())
-            results = self.index.search_many(
+            results = self._timed_search(
+                [qk for qk, _ in items],
                 [v for _, (v, _, _) in items],
                 [int(k) if k is not None else 3 for _, (_, k, _) in items],
                 [f for _, (_, _, f) in items],
@@ -258,6 +261,25 @@ class ExternalIndexNode(Node):
             for qk in gone:
                 self.cache.diff(qk, {}, out)
         self.emit(time, out)
+
+    def _timed_search(self, q_keys, values, ks, filters) -> List[List[tuple]]:
+        """search_many wrapped with query-span marks: stamp search_start
+        for every traced query in the batch, charge the batch's device
+        wall time back to them after.  One attribute read + one dict
+        truthiness check when nothing is traced."""
+        if not (_qtrace.ENABLED and _qtrace.tracker()._pending_keys):
+            return self.index.search_many(values, ks, filters)
+        import time as time_mod
+
+        tq = _qtrace.tracker()
+        tq.mark_keys(q_keys, "search_start")
+        t0 = time_mod.perf_counter()
+        # search results materialize as host lists, so this wall time
+        # includes the device round trip (async *ingest* pipelines only
+        # defer add_many, never search)
+        results = self.index.search_many(values, ks, filters)
+        tq.note_device_keys(q_keys, time_mod.perf_counter() - t0)
+        return results
 
     def _result_row(self, matches: List[tuple]) -> tuple:
         ids = tuple(k for k, _s in matches)

@@ -15,6 +15,7 @@ Frame layout (inside the existing 4-byte length prefix):
       0x03 punct : u32 channel, zz64 time
       0x04 coord : u64 round, value payload
       0x05 stamp : u32 channel, zz64 time, u32 origin, f64 send_wall
+      0x06 qspan : u32 origin, uvarint len, JSON query-span payload
     deltas  := uvarint n, n x (key(16B LE) zz diff, uvarint ncols, values)
     value   := tag(1B) payload   (tags below)
 
@@ -75,6 +76,12 @@ MSG_COORD = 0x04
 # Python-codec only: the native twin predates it and must keep rejecting
 # unknown types, so encode/decode route 0x05 around the ext explicitly.
 MSG_STAMP = 0x05
+# query-span shipment: u32 origin worker + uvarint-length JSON blob of
+# per-query marks (internals/qtrace.py).  Like MSG_STAMP it is a
+# diagnostics-only side channel: Python-codec only, never counted toward
+# punctuation, rides the per-peer FIFO so spans for an epoch arrive
+# before the punctuation that completes it.
+MSG_QSPAN = 0x06
 
 _pack_d = struct.Struct("<d")
 _pack_u32 = struct.Struct("<I")
@@ -546,6 +553,14 @@ def py_encode_message(msg: tuple) -> bytes:
         _zigzag(out, msg[2])
         out += _pack_u32.pack(msg[3])
         out += _pack_d.pack(msg[4])
+    elif kind == "qspan":
+        import json as _json
+
+        out.append(MSG_QSPAN)
+        out += _pack_u32.pack(msg[1])
+        raw = _json.dumps(msg[2], separators=(",", ":")).encode("utf-8")
+        _uvarint(out, len(raw))
+        out += raw
     else:
         raise WireError(f"unknown message kind {kind!r}")
     return bytes(out)
@@ -587,6 +602,15 @@ def _py_decode_message(blob: bytes) -> tuple:
         origin = _pack_u32.unpack(r.take(4))[0]
         wall = _pack_d.unpack(r.take(8))[0]
         msg = ("stamp", channel, time, origin, wall)
+    elif kind == MSG_QSPAN:
+        import json as _json
+
+        origin = _pack_u32.unpack(r.take(4))[0]
+        try:
+            payload = _json.loads(r.take(r.uvarint()).decode("utf-8"))
+        except (UnicodeDecodeError, ValueError) as exc:
+            raise WireError(f"bad qspan payload: {exc}") from None
+        msg = ("qspan", origin, payload)
     else:
         raise WireError(f"unknown message type {kind}")
     if r.pos != r.end:
@@ -609,7 +633,7 @@ def _load_native():
 
 
 def encode_message(msg: tuple) -> bytes:
-    if msg[0] == "stamp":
+    if msg[0] in ("stamp", "qspan"):
         # newer than the native twin: pure-Python codec only
         return py_encode_message(msg)
     ext = _load_native()
@@ -619,7 +643,7 @@ def encode_message(msg: tuple) -> bytes:
 
 
 def decode_message(blob: bytes) -> tuple:
-    if blob and blob[0] == MSG_STAMP:
+    if blob and blob[0] in (MSG_STAMP, MSG_QSPAN):
         return py_decode_message(blob)
     ext = _load_native()
     if ext is not None:
@@ -639,7 +663,7 @@ def encode_frame(msg: tuple) -> bytes:
     """The full length-prefixed wire frame for `msg` in one buffer — the
     native path reserves the 4-byte length slot up front and patches it
     after the body lands, avoiding the `pack(n) + blob` concat copy."""
-    ext = None if msg[0] == "stamp" else _load_native()
+    ext = None if msg[0] in ("stamp", "qspan") else _load_native()
     if ext is not None and hasattr(ext, "encode_frame"):
         return ext.encode_frame(msg)
     blob = encode_message(msg)

@@ -333,6 +333,12 @@ class DevicePipeline:
                     int(meta.get("rows", 0)),
                 )
             )
+        from pathway_tpu.internals import qtrace
+
+        if qtrace.ENABLED:
+            # ingest dispatches competing with the serving path show up
+            # in slow-query exemplars as concurrent device pressure
+            qtrace.tracker().note_device_window(device_s, source="ingest")
         if utilization.ENABLED:
             utilization.tracker().note_span("device", device_s)
             if self.replicas > 1:

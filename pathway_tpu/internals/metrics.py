@@ -112,14 +112,19 @@ class Histogram:
     scrapes want)."""
 
     kind = "histogram"
-    __slots__ = ("counts", "sum")
+    __slots__ = ("counts", "sum", "digest")
 
     def __init__(self) -> None:
         self.counts = [0] * (_N_BUCKETS + 1)  # last slot = +Inf
         self.sum = 0.0
+        # companion quantile digest: exposition still renders the log2
+        # buckets (stable scrape format), but percentile() answers from
+        # the digest so dashboard p50/p99 stop being bucket midpoints
+        self.digest = Digest()
 
     def observe(self, x: float) -> None:
         self.sum += x
+        self.digest.observe(x)
         if x > 0.0:
             # frexp: x = m * 2**e with 0.5 <= m < 1, so 2**(e-1) <= x < 2**e
             # and the le=2**e bucket (index e - _MIN_EXP) contains x.
@@ -143,10 +148,16 @@ class Histogram:
         for i in range(len(cs)):
             cs[i] += os_[i]
         self.sum += other.sum
+        other_digest = getattr(other, "digest", None)
+        if other_digest is not None:
+            self.digest.merge(other_digest)
 
     def percentile(self, q: float) -> Optional[float]:
-        """Approximate quantile (0..100): geometric midpoint of the bucket
-        holding the q-th observation; None when empty."""
+        """Quantile (0..100): digest-backed when observations flowed
+        through this process; geometric bucket midpoint as the fallback
+        for histograms reconstructed from bare bucket counts."""
+        if self.digest.count:
+            return self.digest.percentile(q)
         total = sum(self.counts)
         if total == 0:
             return None
@@ -176,6 +187,201 @@ class Histogram:
         braced = f"{{{labels}}}" if labels else ""
         yield f"{name}_sum{braced} {_fmt_value(self.sum)}"
         yield f"{name}_count{braced} {acc}"
+
+
+class Digest:
+    """Mergeable streaming quantile digest (merging t-digest).
+
+    Log2 buckets answer "which power of two" — good enough for node
+    latency dashboards, useless for certifying an SLO (a p99 that is
+    really a bucket midpoint can be off by ~40%).  This keeps a bounded
+    set of (mean, weight) centroids whose size is governed by the k1
+    scale function, so tails stay near-exact (clusters near q=0/1 hold
+    ~1 sample) while the middle compresses.  Properties the query path
+    relies on:
+
+      * ``observe`` is an amortized O(1) list append; compression runs
+        every ``_BUF_LIMIT`` samples (one sort of ~buffer+centroids);
+      * ``merge`` treats the other digest's centroids as weighted
+        samples — merge order changes centroid layout slightly but
+        quantiles agree within the accuracy bound (pinned by test);
+      * ``to_dict``/``from_dict`` round-trip through JSON so digests
+        ship across workers like registries do.
+    """
+
+    __slots__ = (
+        "compression", "_means", "_weights", "_buf", "_buf_limit",
+        "count", "sum", "min", "max",
+    )
+
+    # delta for the k1 scale: sized so p999 tail clusters stay at ~1
+    # sample on 10k-observation windows (the 1% accuracy pin in
+    # tests/test_qtrace.py) — ~1.3k centroids / ~20 KB per digest
+    def __init__(self, compression: int = 2048):
+        self.compression = compression
+        self._means: List[float] = []
+        self._weights: List[float] = []
+        self._buf: List[float] = []
+        # buffer scales with delta so the per-observe amortized compress
+        # cost stays flat as compression grows (a compress pass is
+        # O(centroids + buffer), and centroids ~ 0.65*delta)
+        self._buf_limit = max(512, compression)
+        self.count = 0.0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, x: float) -> None:
+        self._buf.append(x)
+        self.count += 1.0
+        self.sum += x
+        if x < self.min:
+            self.min = x
+        if x > self.max:
+            self.max = x
+        if len(self._buf) >= self._buf_limit:
+            self._compress()
+
+    # Histogram-compatible alias
+    add = observe
+
+    def merge(self, other: "Digest") -> None:
+        if other.count == 0:
+            return
+        pts = list(zip(other._means, other._weights))
+        pts.extend((v, 1.0) for v in other._buf)
+        self.count += other.count
+        self.sum += other.sum
+        if other.min < self.min:
+            self.min = other.min
+        if other.max > self.max:
+            self.max = other.max
+        self._means.extend(m for m, _ in pts)
+        self._weights.extend(w for _, w in pts)
+        self._compress()
+
+    def _k(self, q: float) -> float:
+        # k1 scale: steep near 0/1 => tail clusters stay tiny
+        return (self.compression / (2.0 * math.pi)) * math.asin(
+            2.0 * q - 1.0
+        )
+
+    def _q_limit(self, k: float) -> float:
+        # inverse of _k: the largest q a cluster starting at scale
+        # position k-1 may extend to.  Computed once per OUTPUT cluster
+        # so the inner compress loop is pure arithmetic (the per-point
+        # asin of the textbook formulation dominates compress cost)
+        if k >= self.compression / 4.0:  # _k(1.0)
+            return 1.0
+        return 0.5 * (
+            math.sin(k * (2.0 * math.pi) / self.compression) + 1.0
+        )
+
+    def _compress(self) -> None:
+        pts = sorted(
+            list(zip(self._means, self._weights))
+            + [(v, 1.0) for v in self._buf]
+        )
+        self._buf.clear()
+        if not pts:
+            return
+        total = self.count
+        means: List[float] = []
+        weights: List[float] = []
+        cur_m, cur_w = pts[0]
+        w_before = 0.0  # weight fully to the left of the current cluster
+        q_limit = self._q_limit(self._k(0.0) + 1.0)
+        for m, w in pts[1:]:
+            q_hi = (w_before + cur_w + w) / total
+            if q_hi <= q_limit:  # i.e. _k(q_hi) - k_lo <= 1 (monotonic)
+                # weighted-mean fold into the current cluster
+                cur_m += (m - cur_m) * (w / (cur_w + w))
+                cur_w += w
+            else:
+                means.append(cur_m)
+                weights.append(cur_w)
+                w_before += cur_w
+                q_limit = self._q_limit(self._k(w_before / total) + 1.0)
+                cur_m, cur_w = m, w
+        means.append(cur_m)
+        weights.append(cur_w)
+        self._means = means
+        self._weights = weights
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Value at quantile ``q`` (0..1); None when empty."""
+        if self.count == 0:
+            return None
+        if self._buf:
+            self._compress()
+        means, weights = self._means, self._weights
+        if len(means) == 1:
+            return means[0]
+        q = min(max(q, 0.0), 1.0)
+        target = q * self.count
+        # centroid i's mass is centered at cum_before + w_i/2; a
+        # weight-1 centroid is an EXACT sample (the k1 scale keeps tail
+        # clusters at ~1 sample precisely so p999 doesn't smear) — inside
+        # its unit of mass we return its mean instead of interpolating
+        cum = 0.0
+        prev_c = 0.0
+        prev_m = self.min
+        prev_w = 0.0
+        for m, w in zip(means, weights):
+            center = cum + w / 2.0
+            if target < center:
+                # a singleton at cumulative weight c owns the mass
+                # interval (c, c+1]: an exact integer target resolves to
+                # order statistic ceil(target), matching the rank
+                # convention of Histogram.percentile's bucket fallback
+                if prev_w == 1.0 and target <= cum:
+                    return prev_m  # still inside the previous singleton
+                if w <= 1.0 and target > cum:
+                    return m  # inside this singleton's own mass
+                span = center - prev_c
+                if span <= 0.0:
+                    return m
+                frac = (target - prev_c) / span
+                return prev_m + (m - prev_m) * frac
+            prev_c, prev_m, prev_w = center, m, w
+            cum += w
+        # beyond the last centroid center: interpolate toward max
+        if prev_w == 1.0:
+            return prev_m if target <= cum else self.max
+        span = self.count - prev_c
+        if span <= 0.0:
+            return self.max
+        frac = (target - prev_c) / span
+        return min(prev_m + (self.max - prev_m) * frac, self.max)
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Histogram-compatible percentile (0..100)."""
+        return self.quantile(p / 100.0)
+
+    def to_dict(self) -> Dict[str, Any]:
+        if self._buf:
+            self._compress()
+        return {
+            "compression": self.compression,
+            "means": [round(m, 9) for m in self._means],
+            "weights": list(self._weights),
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min if self.count else None,
+            "max": self.max if self.count else None,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Digest":
+        out = cls(compression=int(d.get("compression", 2048)))
+        out._means = [float(m) for m in d.get("means", ())]
+        out._weights = [float(w) for w in d.get("weights", ())]
+        out.count = float(d.get("count", sum(out._weights)))
+        out.sum = float(d.get("sum", 0.0))
+        mn, mx = d.get("min"), d.get("max")
+        out.min = float(mn) if mn is not None else math.inf
+        out.max = float(mx) if mx is not None else -math.inf
+        return out
 
 
 _CHILD_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}

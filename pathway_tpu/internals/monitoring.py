@@ -278,6 +278,44 @@ class StatsMonitor:
                             f" ({cur.get('phase')})"
                         )
                     table.add_row("health", row)
+            # serving path (internals/qtrace.py): QPS + digest-backed
+            # per-stage tail latency + SLO burn state
+            from pathway_tpu.internals import qtrace
+
+            if qtrace.ENABLED:
+                qs = qtrace.tracker().status()
+                if qs.get("completed"):
+                    total = qs["stages"].get("total", {})
+                    row = (
+                        f"qps={qs['qps']}"
+                        f" p50={total.get('p50_ms')}ms"
+                        f" p99={total.get('p99_ms')}ms"
+                        f" n={qs['completed']}"
+                    )
+                    table.add_row("queries", row)
+                    slo = qs.get("slo", {})
+                    if slo.get("target_p99_ms") is not None:
+                        row = (
+                            f"target={slo['target_p99_ms']}ms"
+                            f" burn={slo.get('burn_rate')}"
+                            f" violations={slo.get('violations')}"
+                        )
+                        if slo.get("burning"):
+                            row += " BURNING"
+                        table.add_row("slo", row)
+                    slowest = {
+                        s: st.get("p99_ms")
+                        for s, st in qs["stages"].items()
+                        if s != "total"
+                    }
+                    if slowest:
+                        table.add_row(
+                            "query stages p99",
+                            " ".join(
+                                f"{s}={v}ms"
+                                for s, v in sorted(slowest.items())
+                            ),
+                        )
             # critical-path attribution for the latest sampled epoch
             tr = getattr(m, "trace", None)
             cp = tr.critical_path() if tr is not None else None
@@ -345,7 +383,8 @@ class PrometheusServer:
 
     Routes: ``/metrics`` (and ``/``) — Prometheus exposition format;
     ``/status`` — JSON with graph topology, per-node p50/p99 latency,
-    connector stats, and the flight-recorder tail per worker."""
+    connector stats, and the flight-recorder tail per worker;
+    ``/qtrace`` — Chrome-trace JSON of recent query span trees."""
 
     def __init__(self, engine, process_id: int = 0, port: int | None = None):
         self.engine = engine
@@ -410,6 +449,12 @@ class PrometheusServer:
         from pathway_tpu.internals.health import health_metrics
 
         add(health_metrics())
+        # query-path SLO observability (internals/qtrace.py): digest
+        # quantiles pathway_query_latency_seconds{stage,quantile}, QPS,
+        # SLO burn rate
+        from pathway_tpu.internals.qtrace import qtrace_metrics
+
+        add(qtrace_metrics())
         return regs
 
     def metrics_text(self) -> str:
@@ -483,6 +528,7 @@ class PrometheusServer:
         from pathway_tpu.internals.health import health_status
         from pathway_tpu.internals.memtrack import memory_status
         from pathway_tpu.internals.mesh_backend import mesh_status
+        from pathway_tpu.internals.qtrace import qtrace_status
         from pathway_tpu.internals.tracing import merged_critical_path
         from pathway_tpu.internals.utilization import utilization_status
 
@@ -516,6 +562,10 @@ class PrometheusServer:
             # replicas, backpressure scale, rolling-restart progress and
             # per-worker recovery times, recent actions
             "health": health_status(),
+            # query-path SLO observability (internals/qtrace.py): QPS,
+            # digest-backed per-stage p50/p95/p99/p999, SLO burn state,
+            # slow-query exemplars
+            "queries": qtrace_status(),
             # findings from pw.run(analysis=...): deployed graphs report
             # their own lint state (None when analysis was off)
             "analysis": getattr(e0, "analysis", None),
@@ -525,8 +575,10 @@ class PrometheusServer:
         }
 
     def _merged_freshness(self) -> list:
-        """Per-sink freshness p50/p99 merged across workers (the log2
-        histograms share boundaries, so merging is a counts add)."""
+        """Per-sink freshness p50/p99 merged across workers: bucket
+        counts add (shared log2 boundaries) and the companion t-digests
+        merge centroid-wise, so the merged percentiles are digest-exact
+        rather than bucket midpoints."""
         from pathway_tpu.internals.metrics import Histogram
 
         merged: Dict[str, Any] = {}
@@ -643,6 +695,18 @@ class PrometheusServer:
                     # request thread for the capture window, the
                     # ThreadingHTTPServer keeps /metrics answering
                     code, payload = monitor._profile_request(self.path)
+                    body = json.dumps(payload, default=str).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/qtrace"):
+                    # Chrome/Perfetto trace_event JSON of recent query
+                    # span trees (internals/qtrace.py) — save and open
+                    # at ui.perfetto.dev
+                    from pathway_tpu.internals import qtrace
+
+                    if qtrace.ENABLED:
+                        payload = qtrace.tracker().chrome_trace()
+                    else:
+                        payload, code = {"error": "qtrace disabled"}, 404
                     body = json.dumps(payload, default=str).encode()
                     ctype = "application/json"
                 else:

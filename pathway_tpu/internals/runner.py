@@ -182,6 +182,7 @@ def run(
     analysis=None,
     analysis_baseline=None,
     mesh=None,
+    slo: float | None = None,
     **kwargs,
 ) -> None:
     """pw.run — execute every registered sink (reference:
@@ -191,7 +192,11 @@ def run(
     the run intends to shard over: the PWT4xx mesh-compatibility pass
     runs before execution and its ERROR findings abort the run.
     `analysis_baseline` names a findings snapshot (analysis/baseline.py)
-    so strict mode only trips on NEW findings."""
+    so strict mode only trips on NEW findings.
+    `slo` declares a p99 latency target in milliseconds for the traced
+    query path (internals/qtrace.py): burn-rate gauges, warn-once burn
+    events and slow-query exemplars key off it.  Equivalent to setting
+    PATHWAY_SLO_P99_MS."""
     global _last_engine
     from pathway_tpu.internals import faults, health, telemetry
     from pathway_tpu.internals.config import pathway_config as cfg
@@ -200,6 +205,18 @@ def run(
         from pathway_tpu.analysis.mesh import MeshSpec
 
         mesh = MeshSpec.parse(mesh)
+
+    from pathway_tpu.internals import qtrace as _qtrace
+
+    if _qtrace.ENABLED:
+        if slo is not None:
+            _qtrace.tracker().set_slo(slo)
+        if cfg.processes > 1:
+            # this process's first global worker id: non-zero processes
+            # ship their query marks to worker 0 for span merge
+            _qtrace.tracker().attach_worker(
+                cfg.process_id * max(1, cfg.threads)
+            )
 
     # Arm the chaos harness once per run, before any worker starts
     # (per-worker arming would race and reset fire-once budgets).

@@ -224,6 +224,41 @@ def render_status(status: dict) -> str:
                 f"{k}:{v}" for k, v in sorted(acted.items())
             )
         lines.append(line)
+    queries = status.get("queries")
+    if queries and queries.get("enabled") and queries.get("completed"):
+        stages = queries.get("stages") or {}
+        total = stages.get("total") or {}
+        lines.append(
+            f"queries: qps={queries.get('qps')} "
+            f"p50={total.get('p50_ms')}ms p99={total.get('p99_ms')}ms "
+            f"p999={total.get('p999_ms')}ms n={queries.get('completed')} "
+            f"inflight={queries.get('inflight')}"
+        )
+        for stage in ("network", "queue", "batch", "device", "merge", "emit"):
+            st = stages.get(stage)
+            if st:
+                lines.append(
+                    f"  stage {stage}: p50={st.get('p50_ms')}ms "
+                    f"p99={st.get('p99_ms')}ms"
+                )
+        slo = queries.get("slo") or {}
+        if slo.get("target_p99_ms") is not None:
+            line = (
+                f"  slo: target_p99={slo['target_p99_ms']}ms "
+                f"burn_rate={slo.get('burn_rate')} "
+                f"violations={slo.get('violations')}"
+            )
+            if slo.get("burning"):
+                line += " BURNING"
+            lines.append(line)
+        for ex in queries.get("exemplars") or []:
+            line = (
+                f"  slow query {ex.get('qid')}: {ex.get('total_ms')}ms "
+                f"(slowest stage: {ex.get('slowest_stage')}"
+            )
+            if ex.get("replica") is not None:
+                line += f", replica {ex['replica']}"
+            lines.append(line + ")")
     analysis = status.get("analysis")
     if analysis and analysis.get("findings"):
         lines.append(f"analysis findings: {len(analysis['findings'])}")

@@ -555,6 +555,7 @@ class StreamingDriver:
 
         from pathway_tpu.engine.engine import EngineError, FailoverRequired
         from pathway_tpu.internals import faults, health
+        from pathway_tpu.internals import qtrace as _qtrace
 
         threads = []
         active = 0
@@ -847,6 +848,10 @@ class StreamingDriver:
                         state["counter"] = counters.get(live, 0)
                         writer.write_batch(batch, state)
                     node_of(live).push(time, batch)
+                    if _qtrace.ENABLED:
+                        # stamp queries leaving the connector buffer for the
+                        # engine tick (no-op unless a query is in flight)
+                        _qtrace.tracker().mark_batch(batch, "picked")
                 # sink freshness: stamp when this epoch's data entered the
                 # process (oldest buffered event, or now for commit-only
                 # flushes) — SubscribeNode sinks close the interval at

@@ -24,6 +24,7 @@ from pathway_tpu.internals.schema import (
     Schema,
     schema_from_columns,
 )
+from pathway_tpu.internals import qtrace as _qtrace
 from pathway_tpu.io._connector_runtime import (
     ConnectorSubjectBase,
     connector_table,
@@ -164,6 +165,8 @@ class PathwayWebserver:
         return fut
 
     def complete(self, key: Pointer, payload: Any) -> None:
+        if _qtrace.ENABLED:
+            _qtrace.tracker().mark(str(key), "emitted")
         fut = self._pending.pop(key, None)
         if fut is not None and self._loop is not None:
             self._loop.call_soon_threadsafe(
@@ -212,6 +215,8 @@ class _RestSubject(ConnectorSubjectBase):
                 except Exception as exc:  # noqa: BLE001
                     raise _RequestRejected(str(exc)) from exc
             key = ref_scalar("rest", self.route, next(_request_ids))
+            if _qtrace.ENABLED:
+                _qtrace.tracker().begin(str(key), route=self.route, key=key)
             row = {}
             for name in names:
                 if name in payload:
@@ -224,7 +229,11 @@ class _RestSubject(ConnectorSubjectBase):
             self._payloads[key] = row
             self.next(**row, _pw_key=key)
             self.commit()
+            if _qtrace.ENABLED:
+                _qtrace.tracker().mark(str(key), "enqueued")
             result = await fut
+            if _qtrace.ENABLED:
+                _qtrace.tracker().finish(str(key))
             if self.delete_completed_queries:
                 old = self._payloads.pop(key, None)
                 if old is not None:

@@ -718,6 +718,11 @@ class FusedEmbedSearch:
         )
         self.index._flush()
         k_eff = min(k, self.index.capacity)
+        import time as time_mod
+
+        from pathway_tpu.internals import qtrace as _qtrace
+
+        t0 = time_mod.perf_counter() if _qtrace.ENABLED else 0.0
         # ids/mask are wire-narrowed by encode_batch (one shared dtype);
         # the fused jit upcasts on device
         packed = self._fn(k_eff)(
@@ -727,6 +732,12 @@ class FusedEmbedSearch:
             self.index._valid_dev,
         )
         packed = np.asarray(packed)[: len(texts)]
+        if _qtrace.ENABLED:
+            # pure device portion of the query (encode+search dispatch to
+            # host materialization) into the tail-attribution window
+            _qtrace.tracker().note_device_window(
+                time_mod.perf_counter() - t0, source="knn_search"
+            )
         scores = packed[:, :k_eff]
         idx = packed[:, k_eff:].astype(np.int64)
         return _format_rows(scores, idx, self.index._key_of_slot)

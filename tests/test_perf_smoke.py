@@ -971,6 +971,162 @@ def test_health_disabled_is_single_attribute_read():
 
 
 @pytest.mark.perf_smoke
+def test_qtrace_default_sampling_overhead_under_5pct():
+    """Query tracing at default sampling (every query traced) on the
+    serving path: per tick the microbench runs ONE full span lifecycle —
+    begin, the mark chain, a device charge, finish into the digests —
+    mirroring the rest connector's one-commit-per-query shape.  Ticks
+    are sized at 1024 rows (~0.8 ms) to match the measured serving-path
+    per-query engine cost (benchmarks/serving_bench.py p50 ~1.1 ms), so
+    the ratio guards the real claim: hooks <5% of a served query.  The
+    span lifecycle itself measures ~18 us.  Paired per-rep ratios with
+    the min judged, as in the health-controller guard: each rep's
+    on/off runs are back-to-back so slow drift cannot fake a ratio, and
+    a systematically >5% hook pushes EVERY pair above threshold."""
+    import gc
+    from time import perf_counter
+
+    from pathway_tpu.engine.engine import InputQueueSource, RowwiseNode
+    from pathway_tpu.internals import qtrace
+
+    ROWS, TICKS, REPS = 1024, 40, 9
+    deltas = [(ref_scalar("k", i), (i,), 1) for i in range(ROWS)]
+
+    def ident(keys, cols):
+        return cols[0]
+
+    def run_once(enabled: bool) -> float:
+        saved = qtrace.ENABLED
+        qtrace.ENABLED = enabled
+        qtrace.reset()
+        eng = Engine(metrics=False)
+        src = InputQueueSource(eng)
+        node = src
+        for _ in range(3):
+            node = RowwiseNode(eng, [node], ident)
+        qn = 0
+
+        def one_query() -> None:
+            nonlocal qn
+            if qtrace.ENABLED:
+                tq = qtrace.tracker()
+                qid = f"q{qn}"
+                qn += 1
+                tq.begin(qid)
+                tq.mark(qid, "enqueued")
+                tq.mark(qid, "picked")
+                tq.mark(qid, "search_start")
+                tq.note_device(qid, seconds=0.0004, replica_times=None)
+                tq.mark(qid, "device_end")
+                tq.mark(qid, "emitted")
+                tq.finish(qid)
+
+        try:
+            time = 2
+            for _ in range(8):  # warmup
+                src.push(time, deltas)
+                one_query()
+                eng.process_time(time)
+                time += 2
+            t0 = perf_counter()
+            for _ in range(TICKS):
+                src.push(time, deltas)
+                one_query()
+                eng.process_time(time)
+                time += 2
+            return perf_counter() - t0
+        finally:
+            qtrace.ENABLED = saved
+            eng._gc_unfreeze()
+
+    ratios = []
+    gc.collect()
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        for i in range(REPS):
+            first = i % 2 == 0  # alternate arm order against drift
+            a = run_once(first)
+            b = run_once(not first)
+            on_t, off_t = (a, b) if first else (b, a)
+            ratios.append(on_t / off_t)
+    finally:
+        from pathway_tpu.internals import qtrace as _q
+
+        _q.reset()
+        if gc_was_enabled:
+            gc.enable()
+    ratio = min(ratios)
+    assert ratio < 1.05, (
+        f"qtrace default-sampling overhead {ratio:.3f}x (pair ratios "
+        f"{[round(r, 3) for r in ratios]})"
+    )
+
+
+@pytest.mark.perf_smoke
+def test_digest_render_within_budget_of_log2():
+    """The metrics histograms grew a companion t-digest; a scrape
+    (percentiles + exposition render) with digest-backed quantiles must
+    stay within budget of the log2 bucket walk it replaced.  The log2
+    arm is the reconstructed-from-wire state (bucket counts, empty
+    digest -> `percentile` takes the geometric-midpoint fallback).  A
+    trickle of fresh observations lands between scrapes, as in
+    production: a regression that compresses the digest on every
+    percentile call (instead of only when the buffer has data and at
+    most once per scrape) costs ~ms per series and fails both bounds.
+    Budget: 20x the log2 walk (measured ~6x: a ~1.3k-centroid walk vs
+    ~40 buckets) and 50 ms absolute for the 8-series scrape."""
+    import random
+    from time import perf_counter
+
+    from pathway_tpu.internals.metrics import MetricsRegistry
+
+    K, N, TRICKLE = 8, 10_000, 64
+    rng = random.Random(11)
+    vals = [rng.expovariate(1000.0) for _ in range(N)]
+
+    def build(digest_backed: bool):
+        reg = MetricsRegistry(worker="0")
+        fam = reg.histogram("scrape_seconds", help="x", labels=("op",))
+        hs = []
+        for k in range(K):
+            h = fam.labels(f"op{k}")
+            for v in vals:
+                h.observe(v)
+            if not digest_backed:
+                h.digest = type(h.digest)()  # wire-reconstructed state
+            hs.append(h)
+        return reg, hs
+
+    def steady_scrape(reg, hs) -> float:
+        best = None
+        for _ in range(5):
+            for h in hs:
+                for v in vals[:TRICKLE]:
+                    h.observe(v)
+            t0 = perf_counter()
+            for h in hs:
+                h.percentile(50)
+                h.percentile(99)
+            reg.render()
+            dt = perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    reg_d, hs_d = build(True)
+    reg_l, hs_l = build(False)
+    steady_scrape(reg_d, hs_d)  # warmup: absorb the first-compress cost
+    steady_scrape(reg_l, hs_l)
+    digest_s = steady_scrape(reg_d, hs_d)
+    log2_s = steady_scrape(reg_l, hs_l)
+    assert digest_s < 0.050, f"digest scrape {digest_s * 1000:.1f}ms"
+    assert digest_s / log2_s < 20.0, (
+        f"digest-backed scrape {digest_s / log2_s:.1f}x the log2 walk "
+        f"(digest={digest_s * 1000:.2f}ms log2={log2_s * 1000:.2f}ms)"
+    )
+
+
+@pytest.mark.perf_smoke
 def test_profiler_idle_is_noop():
     """With no capture requested the profiler must be pure state reads:
     importing internals/profiler.py and consulting its status must not

@@ -1,0 +1,480 @@
+"""Query-path SLO observability (internals/qtrace.py): digest math
+pins, span lifecycle + stage attribution, charged-time exemplars under
+injected faults, SLO burn events, cross-worker span merge (thread and
+TCP), Chrome-trace export, and the PATHWAY_QTRACE=0 guard."""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+
+import pytest
+
+from pathway_tpu.engine import wire
+from pathway_tpu.internals import faults, qtrace
+from pathway_tpu.internals.metrics import Digest
+from pathway_tpu.internals.qtrace import STAGES, QueryTracer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracker():
+    qtrace.reset()
+    yield
+    faults.clear()
+    qtrace.reset()
+
+
+# ---------------------------------------------------------------------------
+# digest math pins (the acceptance bound: within 1% of the sorted
+# reference at p50/p95/p99/p999 on fixed-seed 10k samples)
+# ---------------------------------------------------------------------------
+
+def _samples(dist: str, seed: int, n: int = 10_000) -> list:
+    rng = random.Random(seed)
+    if dist == "uniform":
+        return [rng.uniform(0.001, 1.0) for _ in range(n)]
+    if dist == "exp":
+        return [rng.expovariate(1.0) for _ in range(n)]
+    return [math.exp(rng.gauss(0.0, 1.0)) for _ in range(n)]  # lognormal
+
+
+def _sorted_quantile(xs_sorted: list, q: float) -> float:
+    # ceil-rank order statistic — the convention Digest.quantile and
+    # Histogram.percentile's bucket fallback share (rank ceil(q*n))
+    rank = max(1, math.ceil(q * len(xs_sorted)))
+    return xs_sorted[min(rank, len(xs_sorted)) - 1]
+
+
+@pytest.mark.parametrize("dist", ["uniform", "exp", "lognormal"])
+@pytest.mark.parametrize("seed", [7, 23])
+def test_digest_quantiles_within_1pct_of_sorted_reference(dist, seed):
+    xs = _samples(dist, seed)
+    d = Digest()
+    for x in xs:
+        d.observe(x)
+    xs.sort()
+    for q in (0.5, 0.95, 0.99, 0.999):
+        ref = _sorted_quantile(xs, q)
+        est = d.quantile(q)
+        assert est is not None
+        assert abs(est - ref) / ref <= 0.01, (dist, seed, q, est, ref)
+    assert d.count == len(xs)
+    assert d.min == xs[0] and d.max == xs[-1]
+    assert abs(d.sum - sum(xs)) < 1e-6 * abs(sum(xs))
+
+
+def test_digest_merge_is_order_insensitive_and_accurate():
+    """Shard 10k lognormal samples 4 ways; merging the shards in any
+    order (and any grouping) must agree with each other within the
+    accuracy bound and with the sorted reference within 1%."""
+    xs = _samples("lognormal", 31)
+    shards = []
+    for i in range(4):
+        d = Digest()
+        for x in xs[i::4]:
+            d.observe(x)
+        shards.append(d)
+
+    def merged(order):
+        out = Digest()
+        for i in order:
+            out.merge(Digest.from_dict(shards[i].to_dict()))
+        return out
+
+    a = merged([0, 1, 2, 3])
+    b = merged([3, 1, 0, 2])
+    # grouped differently: (0+1) + (2+3)
+    left, right = Digest(), Digest()
+    left.merge(shards[0]); left.merge(shards[1])
+    right.merge(shards[2]); right.merge(shards[3])
+    left.merge(right)
+    xs.sort()
+    for q in (0.5, 0.95, 0.99, 0.999):
+        ref = _sorted_quantile(xs, q)
+        for d in (a, b, left):
+            assert abs(d.quantile(q) - ref) / ref <= 0.01, (q, ref)
+    assert a.count == b.count == left.count == len(xs)
+
+
+def test_digest_serialization_round_trips_through_json():
+    xs = _samples("exp", 5, n=3000)
+    d = Digest()
+    for x in xs:
+        d.observe(x)
+    blob = json.dumps(d.to_dict())
+    back = Digest.from_dict(json.loads(blob))
+    assert back.count == d.count
+    assert back.min == d.min and back.max == d.max
+    for q in (0.5, 0.99, 0.999):
+        assert back.quantile(q) == pytest.approx(d.quantile(q), rel=1e-9)
+    # an empty digest survives the trip too
+    empty = Digest.from_dict(json.loads(json.dumps(Digest().to_dict())))
+    assert empty.count == 0 and empty.quantile(0.5) is None
+
+
+# ---------------------------------------------------------------------------
+# wire codec: the qspan side-channel message
+# ---------------------------------------------------------------------------
+
+def test_qspan_codec_round_trip():
+    payload = {
+        "spans": [
+            {
+                "qid": "^X7:abc",
+                "marks": {"picked": 1722860000.25, "device_end": 1722860000.5},
+                "meta": {"device_s": 0.25, "replica_times": {"2": 0.25}},
+            }
+        ]
+    }
+    msg = ("qspan", 3, payload)
+    blob = wire.encode_message(msg)
+    assert blob[0] == wire.MSG_QSPAN
+    assert wire.decode_message(blob) == msg
+    # truncated frames fail typed, never undefined
+    with pytest.raises((wire.WireError, ValueError)):
+        wire.py_decode_message(blob[: len(blob) // 2])
+
+
+# ---------------------------------------------------------------------------
+# span lifecycle + stage attribution
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    """Deterministic stand-in for qtrace's wall clock."""
+
+    def __init__(self, now: float = 0.0):
+        self.now = now
+
+    def time(self) -> float:
+        return self.now
+
+
+@pytest.fixture()
+def clock(monkeypatch):
+    c = _Clock()
+    monkeypatch.setattr(qtrace, "time_mod", c)
+    return c
+
+
+def _span(tq: QueryTracer, qid: str, walls: dict, clock: _Clock, **device):
+    """Drive one span through the tracer under the fake clock, pinning
+    each mark to the given synthetic wall so stage math is exact.  The
+    implicit respond wall is the latest mark unless given."""
+    clock.now = walls["ingress"]
+    assert tq.begin(qid, route="/t", key=("k", qid))
+    for name, wall in walls.items():
+        if name in ("ingress", "respond"):
+            continue
+        clock.now = wall
+        tq.mark(qid, name)
+    if device:
+        tq.note_device(qid, device["seconds"],
+                       replica_times=device.get("replica_times"))
+    clock.now = walls.get("respond", max(walls.values()))
+    return tq.finish(qid)
+
+
+def test_stage_breakdown_from_mark_chain(clock):
+    tq = QueryTracer()
+    t0 = 1000.0
+    rec = _span(tq, "q1", {
+        "ingress": t0,
+        "enqueued": t0 + 0.010,
+        "picked": t0 + 0.030,
+        "search_start": t0 + 0.034,
+        "device_end": t0 + 0.054,
+        "emitted": t0 + 0.060,
+    }, clock)
+    s = rec["stages_ms"]
+    assert s["network"] == pytest.approx(10.0, abs=0.01)
+    assert s["queue"] == pytest.approx(20.0, abs=0.01)
+    assert s["batch"] == pytest.approx(4.0, abs=0.01)
+    assert s["device"] == pytest.approx(20.0, abs=0.01)
+    assert s["merge"] == pytest.approx(6.0, abs=0.01)
+    assert rec["slowest_stage"] in ("queue", "device", "emit")
+    assert tq.completed == 1
+    # every stage digest observed exactly once
+    for stage in STAGES:
+        assert tq.stage_digests[stage].count == 1
+    assert tq.total_digest.count == 1
+    # a missing mark collapses its stage to 0, never negative
+    rec2 = _span(tq, "q2", {"ingress": t0, "emitted": t0 + 0.005}, clock)
+    assert rec2["stages_ms"]["queue"] == 0.0
+    assert rec2["stages_ms"]["batch"] == 0.0
+    assert all(v >= 0.0 for v in rec2["stages_ms"].values())
+
+
+def test_charged_device_time_counts_toward_total(clock):
+    """The exemplar/SLO trigger uses charged time: a device charge
+    larger than the observed wall must dominate total_ms (emulated-mesh
+    fault factors surface even when wall time is unaffected)."""
+    tq = QueryTracer()
+    t0 = 2000.0
+    rec = _span(
+        tq, "q1",
+        {"ingress": t0, "emitted": t0 + 0.002},
+        clock,
+        seconds=0.5,
+    )
+    assert rec["stages_ms"]["device"] == pytest.approx(500.0, abs=0.01)
+    assert rec["total_ms"] >= 500.0
+    assert rec["slowest_stage"] == "device"
+
+
+def test_slow_replica_fault_produces_exemplar_with_replica_blame(clock):
+    """Acceptance: an injected slow_replica fault must surface as a
+    slow-query exemplar naming the guilty replica, via the charged-time
+    contract (note_device consults the fault harness)."""
+    faults.install("slow_replica@replica=2,factor=100")
+    tq = QueryTracer()
+    tq.set_slo(10.0)  # 10 ms target; the charged time will blow past it
+    t0 = 3000.0
+    rec = _span(
+        tq, "slow1",
+        {"ingress": t0, "emitted": t0 + 0.002},
+        clock,
+        seconds=0.005,  # 5 ms real dispatch -> charged 500 ms on replica 2
+    )
+    assert rec["total_ms"] >= 400.0
+    assert len(tq.exemplars) == 1
+    ex = tq.exemplars[0]
+    assert ex["replica"] == 2
+    assert ex["slowest_stage"] == "device"
+    assert ex["total_ms"] > ex["threshold_ms"]
+    kinds = [e["kind"] for e in tq.recorder.tail(16)]
+    assert "slow_query" in kinds
+    assert tq.slo_violations == 1
+    status = tq.status()
+    assert status["exemplars"][0]["replica"] == 2
+    assert status["slo"]["violations"] == 1
+
+
+def test_fast_queries_leave_no_exemplar(clock):
+    faults.clear()
+    tq = QueryTracer()
+    tq.set_slo(10_000.0)
+    t0 = 4000.0
+    for i in range(8):
+        _span(tq, f"ok{i}",
+              {"ingress": t0 + i, "emitted": t0 + i + 0.001}, clock)
+    assert len(tq.exemplars) == 0
+    assert tq.slo_violations == 0
+
+
+def test_slo_burn_records_event_and_warns_once(clock, caplog):
+    """Sustained burn (>1% of queries over target for burn_sustain_s)
+    must bump burn_episodes exactly once per episode, drop a
+    flight-recorder event, and log one warning."""
+    tq = QueryTracer()
+    tq.set_slo(1.0)  # 1 ms — everything below violates
+    tq.burn_sustain_s = 0.0  # warn on the second violating finish
+    t0 = 5000.0
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="pathway_tpu.qtrace"):
+        for i in range(6):
+            _span(tq, f"b{i}", {
+                "ingress": t0 + i, "emitted": t0 + i + 0.050,
+            }, clock)
+    assert tq.burn_episodes == 1  # warn-once per episode
+    kinds = [e["kind"] for e in tq.recorder.tail(32)]
+    assert "slo_burn" in kinds
+    burn_logs = [r for r in caplog.records if "SLO burn" in r.getMessage()]
+    assert len(burn_logs) == 1
+    status = tq.status()
+    assert status["slo"]["burning"] is True
+    assert status["slo"]["burn_rate"] >= 1.0
+
+
+# ---------------------------------------------------------------------------
+# cross-worker span merge
+# ---------------------------------------------------------------------------
+
+class _FakeCoord:
+    """Capture-side stub implementing the Coordinator qspan surface."""
+
+    def __init__(self):
+        self.sent = []  # (dest, origin, payload)
+        self.inbox = []  # [(origin, payload)]
+
+    def send_qspans(self, dest, origin, payload):
+        self.sent.append((dest, origin, payload))
+
+    def take_qspans(self):
+        out, self.inbox = self.inbox, []
+        return out
+
+
+class _FakeEngine:
+    def __init__(self, coord):
+        self.coord = coord
+
+
+def test_remote_worker_marks_merge_into_worker0_span():
+    """Worker 1 stamps picked/device_end on its copy of the span; the
+    payload it ships must merge into worker 0's pending record without
+    clobbering worker-0-side marks, and the finished breakdown must use
+    the remote device charge."""
+    # worker 1 side: same qid, attached as a non-zero worker
+    w1 = QueryTracer()
+    w1.attach_worker(1)
+    w1.begin("qX", route="/m", key=("k", "qX"))
+    w1.mark("qX", "picked")
+    w1.note_device("qX", 0.040)
+    assert w1._remote_out  # marks queued for shipment
+    coord1 = _FakeCoord()
+    w1.on_tick(_FakeEngine(coord1))
+    assert not w1._remote_out  # flushed
+    (dest, origin, payload) = coord1.sent[0]
+    assert dest == 0 and origin == 1
+    # the payload is exactly what rides MSG_QSPAN: json-round-trip it
+    payload = wire.decode_message(
+        wire.encode_message(("qspan", origin, payload))
+    )[2]
+
+    # worker 0 side: span is pending (ingress stamped at the connector)
+    w0 = QueryTracer()
+    w0.begin("qX", route="/m", key=("k", "qX"))
+    coord0 = _FakeCoord()
+    coord0.inbox.append((origin, payload))
+    w0.on_tick(_FakeEngine(coord0))  # worker 0 absorbs
+    rec = w0._pending["qX"]
+    assert "picked" in rec["marks"] and "device_end" in rec["marks"]
+    assert rec["meta"]["worker"] == 1
+    assert rec["meta"]["device_s"] == pytest.approx(0.04)
+    fin = w0.finish("qX")
+    assert fin["stages_ms"]["device"] >= 40.0
+
+
+def test_late_qspans_merge_into_recent_finished_span():
+    """Marks arriving after the response closed the span still land (the
+    _recent ring) so the exported trace is complete."""
+    w0 = QueryTracer()
+    w0.begin("qL", key=("k", "qL"))
+    w0.finish("qL")
+    w0._absorb_span(2, {
+        "qid": "qL",
+        "marks": {"picked": 1.0},
+        "meta": {"device_s": 0.001},
+    })
+    rec = next(r for r in w0._recent if r["qid"] == "qL")
+    assert rec["marks"]["picked"] == 1.0
+    assert rec["meta"]["worker"] == 2
+
+
+def test_qspan_merge_over_real_tcp_pair():
+    """2-worker TCP acceptance: worker 1's qspan frame crosses a real
+    socket pair and lands in worker 0's take_qspans()."""
+    import threading
+    import time as time_mod
+
+    from pathway_tpu.engine.exchange import TcpCoordinator
+
+    from _fakes import free_port_base
+
+    port = free_port_base(2)
+    coords = {}
+
+    def start(worker_id):
+        coords[worker_id] = TcpCoordinator(
+            worker_id, 2, port, run_id="qspantest", connect_timeout=10
+        )
+
+    threads = [
+        threading.Thread(target=start, args=(w,), daemon=True)
+        for w in (0, 1)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert set(coords) == {0, 1}
+    try:
+        payload = {"spans": [{"qid": "qT", "marks": {"picked": 42.5},
+                              "meta": {}}]}
+        coords[1].send_qspans(0, 1, payload)
+        deadline = time_mod.monotonic() + 10
+        got = []
+        while time_mod.monotonic() < deadline and not got:
+            got = coords[0].take_qspans()
+            if not got:
+                time_mod.sleep(0.05)
+        assert got == [(1, payload)]
+        # sending to yourself is a no-op, not a loopback frame
+        coords[0].send_qspans(0, 0, payload)
+        assert coords[0].take_qspans() == []
+    finally:
+        coords[0].close()
+        coords[1].close()
+
+
+# ---------------------------------------------------------------------------
+# chrome trace export
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_exports_complete_stage_breakdown(clock):
+    from pathway_tpu.internals.tracing import validate_chrome_trace
+
+    tq = QueryTracer()
+    t0 = 6000.0
+    _span(tq, "c1", {
+        "ingress": t0,
+        "enqueued": t0 + 0.001,
+        "picked": t0 + 0.002,
+        "search_start": t0 + 0.003,
+        "device_end": t0 + 0.004,
+        "emitted": t0 + 0.005,
+    }, clock)
+    trace = tq.chrome_trace()
+    validate_chrome_trace(trace)
+    evs = trace["traceEvents"]
+    assert all(e["pid"] == qtrace._TRACE_PID for e in evs)
+    stage_names = {e["name"] for e in evs if e.get("cat") == "stage"}
+    assert stage_names == set(STAGES)
+    query_spans = [e for e in evs if e.get("cat") == "query"]
+    assert len(query_spans) == 1
+    # timestamps are rebased: the query starts near 0, not at epoch us
+    assert query_spans[0]["ts"] < 1e6
+    # filtering by qid returns only that query
+    assert tq.chrome_trace(qid="nope")["traceEvents"][0]["ph"] == "M"
+
+
+# ---------------------------------------------------------------------------
+# disabled guard
+# ---------------------------------------------------------------------------
+
+def test_qtrace_disabled_is_single_attribute_read():
+    """PATHWAY_QTRACE=0: importing the module must not instantiate the
+    tracker or pull in jax; every hook guard is the module attribute."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import sys;"
+        "from pathway_tpu.internals import qtrace;"
+        "assert qtrace.ENABLED is False;"
+        "assert qtrace._tracker is None;"
+        "assert qtrace.qtrace_metrics() is None;"
+        "assert qtrace.qtrace_status() == {'enabled': False};"
+        "assert qtrace._tracker is None, 'status instantiated it';"
+        "assert 'jax' not in sys.modules, 'qtrace pulled in jax'"
+    )
+    env = dict(os.environ)
+    env["PATHWAY_QTRACE"] = "0"
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_sampling_stride_traces_every_nth_query():
+    tq = QueryTracer()
+    tq.sample_every = 4
+    opened = [tq.begin(f"s{i}") for i in range(8)]
+    assert opened.count(True) == 2
+    # untraced qids no-op everywhere
+    tq.mark("s1", "picked")
+    assert tq.finish("s1") is None
