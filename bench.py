@@ -916,7 +916,14 @@ def _serving_facts(rtt_ms: float | None = None) -> dict:
     up, `rtt_ms` (the device_probe RTT gauge's view of the tunnel) adds
     the projection: a device-backed query pays at least one tunnel round
     trip on top of this host-path p50, so `p50_ms_with_tunnel` is the
-    ex-tunnel/tunnel split stated as data."""
+    ex-tunnel/tunnel split stated as data.
+
+    PR 16 adds the micro-batched-vs-per-query A/B inside serving_bench
+    itself (SERVING_BENCH_ARM subprocess arms); the `speedup` key —
+    micro-batched QPS over the per-query baseline — is the serving
+    tier's headline number and is kept present (null only when an arm
+    crashed) in healthy AND fallback artifacts alike, since both payload
+    shapes call this helper."""
     import subprocess
     import sys
 
@@ -927,12 +934,13 @@ def _serving_facts(rtt_ms: float | None = None) -> dict:
         proc = subprocess.run(
             [sys.executable, script],
             capture_output=True,
-            timeout=600,
+            timeout=1800,
             text=True,
             env=env,
         )
         line = proc.stdout.strip().splitlines()[-1]
         facts = json.loads(line)
+        facts.setdefault("speedup", None)
         if rtt_ms is not None and isinstance(
             facts.get("p50_ms"), (int, float)
         ):
@@ -940,7 +948,12 @@ def _serving_facts(rtt_ms: float | None = None) -> dict:
             facts["p50_ms_with_tunnel"] = round(facts["p50_ms"] + rtt_ms, 2)
         return {"serving": facts}
     except Exception as exc:  # noqa: BLE001 — never sink the main bench
-        return {"serving": {"error": f"{type(exc).__name__}: {exc}"}}
+        return {
+            "serving": {
+                "error": f"{type(exc).__name__}: {exc}",
+                "speedup": None,
+            }
+        }
 
 
 def _multichip_facts() -> dict:

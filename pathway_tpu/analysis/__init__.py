@@ -27,6 +27,7 @@ from pathway_tpu.analysis.capacity import capacity_pass, verify_capacity
 from pathway_tpu.analysis.fusion import FusionChain, FusionPlan, plan_fusion
 from pathway_tpu.analysis.graph import GraphView
 from pathway_tpu.analysis.mesh import MeshSpec
+from pathway_tpu.analysis.serving import serving_pass
 from pathway_tpu.analysis.passes import (
     columnar_pass,
     dead_pass,
@@ -66,6 +67,7 @@ def analyze(
     extra_tables: Iterable[Any] = (),
     workers: Optional[int] = None,
     mesh: Any = None,
+    slo: Optional[float] = None,
 ) -> AnalysisResult:
     """Run every pass over `graph` (default: the global parse graph).
 
@@ -73,7 +75,9 @@ def analyze(
     run_tables captures); `workers` overrides the configured worker
     count for the exchange-related lints; `mesh` (a MeshSpec,
     "dp=4,tp=2" string or mapping) additionally runs the PWT4xx
-    mesh-compatibility pass against that device topology."""
+    mesh-compatibility pass against that device topology; `slo` is the
+    declared p99 target in milliseconds (pw.run(slo=)), consumed by the
+    PWT70x serving lints (PATHWAY_SLO_P99_MS is the fallback)."""
     if graph is None:
         from pathway_tpu.internals.parse_graph import G as graph
     if workers is None:
@@ -91,6 +95,7 @@ def analyze(
     fusion_pass(view, result)
     mesh_pass(view, result, mesh=mesh, workers=workers)
     capacity_pass(view, result, mesh=mesh, workers=workers)
+    serving_pass(view, result, slo=slo)
     return result
 
 
@@ -109,6 +114,7 @@ __all__ = [
     "capacity_pass",
     "make_diag",
     "plan_fusion",
+    "serving_pass",
     "verify_against_plan",
     "verify_capacity",
     "verify_fusion",

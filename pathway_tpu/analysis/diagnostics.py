@@ -71,6 +71,9 @@ CODES: Dict[str, tuple] = {
     "PWT604": (Severity.WARNING, "predicted HBM headroom below threshold"),
     "PWT605": (Severity.INFO, "encoder params replicated per dp replica"),
     "PWT699": (Severity.ERROR, "capacity plan disagrees with live accounting"),
+    # PWT7xx — serving tier (internals/serving.py)
+    "PWT701": (Severity.WARNING, "serving enabled over a non-batchable index"),
+    "PWT702": (Severity.WARNING, "serving batch window exceeds the SLO target"),
 }
 
 # JSON schema version for analyze --json payloads and the golden matrix.

@@ -19,6 +19,7 @@ from pathway_tpu.engine.engine import Engine, Node
 from pathway_tpu.engine.operators import _DiffCache
 from pathway_tpu.engine.value import ERROR, Error, Pointer
 from pathway_tpu.internals import qtrace as _qtrace
+from pathway_tpu.internals import serving as _serving
 
 
 class IndexImpl:
@@ -268,7 +269,7 @@ class ExternalIndexNode(Node):
         wall time back to them after.  One attribute read + one dict
         truthiness check when nothing is traced."""
         if not (_qtrace.ENABLED and _qtrace.tracker()._pending_keys):
-            return self.index.search_many(values, ks, filters)
+            return self._search_many(values, ks, filters)
         import time as time_mod
 
         tq = _qtrace.tracker()
@@ -277,9 +278,29 @@ class ExternalIndexNode(Node):
         # search results materialize as host lists, so this wall time
         # includes the device round trip (async *ingest* pipelines only
         # defer add_many, never search)
-        results = self.index.search_many(values, ks, filters)
+        results = self._search_many(values, ks, filters)
         tq.note_device_keys(q_keys, time_mod.perf_counter() - t0)
         return results
+
+    def _search_many(self, values, ks, filters) -> List[List[tuple]]:
+        """search_many behind the serving result cache when a serving
+        tier is live and the backend opts in (`supports_result_cache` —
+        set only by impls whose EVERY mutation flows through the
+        DeviceKnnIndex generation hooks, so cached reads can never be
+        stale).  One attribute read + one None check otherwise."""
+        if (
+            _serving.ENABLED
+            and _serving._TIER is not None
+            and getattr(self.index, "supports_result_cache", False)
+        ):
+            return _serving._TIER.cached_search(
+                values,
+                ks,
+                filters,
+                self.index.search_many,
+                index_id=id(self.index),
+            )
+        return self.index.search_many(values, ks, filters)
 
     def _result_row(self, matches: List[tuple]) -> tuple:
         ids = tuple(k for k, _s in matches)

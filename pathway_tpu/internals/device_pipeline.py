@@ -115,6 +115,12 @@ class DevicePipeline:
         # and restores them when pressure clears (AIMD)
         self._base_max_prepared = self.max_prepared
         self._base_max_in_flight = self.max_in_flight
+        # two independent throttles compose multiplicatively: the health
+        # AIMD pressure scale and the serving tier's priority-lane scale
+        # (internals/serving.py shrinks ingest windows while the query
+        # SLO burns so serving dispatches get the freed device slots)
+        self._pressure_scale = 1.0
+        self._serve_scale = 1.0
         # mesh backend: dispatches are SPMD across dp replicas, so every
         # replica holds its own copy of the in-flight window; meta may
         # carry "replica_rows" / "replica_real_tokens" /
@@ -152,6 +158,9 @@ class DevicePipeline:
         if _PRESSURE_SCALE < 1.0:
             # born under pressure: adopt the process-wide throttle
             self.set_pressure_scale(_PRESSURE_SCALE)
+        if _SERVE_SCALE < 1.0:
+            # born while serving holds priority: cede the slots too
+            self.set_serve_scale(_SERVE_SCALE)
         _PIPELINES.add(self)
 
     # -- producer side ----------------------------------------------------
@@ -207,17 +216,30 @@ class DevicePipeline:
             )
 
     def set_pressure_scale(self, scale: float) -> None:
-        """Scale the live queue/window sizes to `scale` of their
+        """Scale the live queue/window sizes toward `scale` of their
         configured ceilings (floor 1 each — the pipeline never stalls
         outright).  Shrinking takes effect as in-flight work retires;
         expanding wakes any submitter blocked on the old bound."""
-        scale = min(1.0, max(0.0, float(scale)))
+        self._pressure_scale = min(1.0, max(0.0, float(scale)))
+        self._apply_scales()
+
+    def set_serve_scale(self, scale: float) -> None:
+        """Serving-priority lane: while the query SLO burns, the serving
+        tier shrinks this ingest window so its batches stop queueing
+        behind a full in-flight window.  Composes multiplicatively with
+        the health pressure scale — whichever throttle is tighter wins
+        and releasing one never masks the other."""
+        self._serve_scale = min(1.0, max(0.0, float(scale)))
+        self._apply_scales()
+
+    def _apply_scales(self) -> None:
+        eff = self._pressure_scale * self._serve_scale
         with self._cond:
             self.max_prepared = max(
-                1, int(self._base_max_prepared * scale)
+                1, int(self._base_max_prepared * eff)
             )
             self.max_in_flight = max(
-                1, int(self._base_max_in_flight * scale)
+                1, int(self._base_max_in_flight * eff)
             )
             self._cond.notify_all()
 
@@ -483,6 +505,27 @@ def set_backpressure_scale(scale: float) -> float:
 
 def backpressure_scale() -> float:
     return _PRESSURE_SCALE
+
+
+# serving-priority scale (internals/serving.py partitioner); same
+# adopt-at-birth contract as the pressure scale
+_SERVE_SCALE = 1.0
+
+
+def set_serving_scale(scale: float) -> float:
+    """Apply the serving partitioner's priority-lane scale to every live
+    pipeline (and remember it for pipelines created while serving holds
+    priority).  Returns the clamped scale actually applied."""
+    global _SERVE_SCALE
+    scale = min(1.0, max(0.0, float(scale)))
+    _SERVE_SCALE = scale
+    for p in list(_PIPELINES):
+        p.set_serve_scale(scale)
+    return scale
+
+
+def serving_scale() -> float:
+    return _SERVE_SCALE
 # The pipeline is a process-wide resource (one set of gauges regardless of
 # how many engine workers share the process), so its series carry the
 # conventional worker="0" constant label the exposition contract requires.
@@ -603,6 +646,7 @@ def pipeline_status() -> Dict[str, Any]:
         "active": len(pipes),
         "fallbacks": _STATS["fallbacks"],
         "backpressure_scale": _PRESSURE_SCALE,
+        "serving_scale": _SERVE_SCALE,
     }
     if pipes:
         agg = {

@@ -95,7 +95,12 @@ BP_MIN_SCALE = _env_float("PATHWAY_HEALTH_BP_MIN_SCALE", 0.125)
 # evaluates so chaos runs stay deterministic in logical time.
 PRESSURE_CHECK_S = _env_float("PATHWAY_HEALTH_PRESSURE_CHECK_S", 0.2)
 
-_ACTIONS = ("drain", "readmit", "restart", "restart_done", "throttle", "relax")
+_ACTIONS = (
+    "drain", "readmit", "restart", "restart_done", "throttle", "relax",
+    # serving-tier device-time partitioner transitions (internals/
+    # serving.py): priority slots granted to / reclaimed from serving
+    "serve_priority", "serve_release",
+)
 
 
 class HealthController:
@@ -161,6 +166,18 @@ class HealthController:
             return
         self._tick_drain(epoch)
         self._tick_pressure(epoch)
+        self._tick_serving()
+
+    def _tick_serving(self) -> None:
+        """Give the serving partitioner a control-loop heartbeat from the
+        driver side: during mixed ingest+serve phases the batcher's own
+        flush callback already ticks it, but a pure-ingest stretch (no
+        queries arriving) still has to RELEASE priority promptly once the
+        burn clears — this tick is what does that."""
+        from pathway_tpu.internals import serving
+
+        if serving.ENABLED and serving._TIER is not None:
+            serving._TIER.partitioner.maybe_tick()
 
     # -- actuator 1: replica drain & re-admit ------------------------------
 

@@ -115,6 +115,24 @@ class MeshBackend:
         self._skew_streak = 0
         self._straggler: Optional[Dict[str, Any]] = None
         self._straggler_warned = False
+        # serving-tier read fan-out accounting: a batched serve search is
+        # one SPMD program touching every dp replica's index shard, so
+        # each batch counts one read against every ACTIVE replica
+        # (drained replicas stay searchable but take no serve credit —
+        # the detour moves their ingest keys, search still merges all
+        # shards, so results stay ranking-exact)
+        self._serve_batches = 0
+        self._serve_queries = 0
+        self._serve_reads: List[int] = [0] * self.dp
+        self.metrics.counter(
+            "pathway_mesh_serve_reads_total",
+            help="Serving search batches fanned out to each dp replica",
+            labels=("replica",),
+            callback=lambda: [
+                ((str(r),), float(n))
+                for r, n in enumerate(self._serve_reads)
+            ],
+        )
 
     # -- sharding contract -------------------------------------------------
 
@@ -297,6 +315,18 @@ class MeshBackend:
 
     # -- degradation bookkeeping -------------------------------------------
 
+    def note_serve_batch(self, n_queries: int) -> None:
+        """One batched serve search dispatched across the mesh: the
+        fused program reads every active replica's shard in parallel and
+        the host merges, so each active replica is charged one read."""
+        with self._lock:
+            self._serve_batches += 1
+            self._serve_queries += int(n_queries)
+            drained = self._drained
+            for r in range(self.dp):
+                if r not in drained:
+                    self._serve_reads[r] += 1
+
     def note_replica_degraded(self, replica: int) -> None:
         with self._lock:
             self._degraded_replicas.add(int(replica) % self.dp)
@@ -332,6 +362,9 @@ class MeshBackend:
             "replica_device_s": window,
             "skew_ratio": self._skew_ratio_or_none(),
             "straggler": self.straggler(),
+            "serve_batches": self._serve_batches,
+            "serve_queries": self._serve_queries,
+            "serve_reads": list(self._serve_reads),
             "events": self.recorder.tail(),
         }
 

@@ -316,6 +316,31 @@ class StatsMonitor:
                                 for s, v in sorted(slowest.items())
                             ),
                         )
+            # serving tier (internals/serving.py): batch coalescing,
+            # cache effectiveness, admission sheds, priority lane
+            from pathway_tpu.internals import serving
+
+            if serving.ENABLED:
+                ss = serving.serving_status()
+                if ss.get("active") and (
+                    ss.get("batches")
+                    or ss.get("admission", {}).get("shed_total")
+                    or ss.get("cache", {}).get("hits")
+                ):
+                    row = (
+                        f"batches={ss['batches']}"
+                        f" occ_p50={ss.get('batch_occupancy_p50')}"
+                        f" occ_p99={ss.get('batch_occupancy_p99')}"
+                    )
+                    cache = ss.get("cache", {})
+                    if cache.get("hit_rate") is not None:
+                        row += f" cache_hit={cache['hit_rate']}"
+                    adm = ss.get("admission", {})
+                    if adm.get("shed_total"):
+                        row += f" shed={adm['shed_total']}"
+                    if ss.get("partitioner", {}).get("priority"):
+                        row += " PRIORITY"
+                    table.add_row("serving", row)
             # critical-path attribution for the latest sampled epoch
             tr = getattr(m, "trace", None)
             cp = tr.critical_path() if tr is not None else None
@@ -455,6 +480,11 @@ class PrometheusServer:
         from pathway_tpu.internals.qtrace import qtrace_metrics
 
         add(qtrace_metrics())
+        # serving tier (internals/serving.py): batch occupancy, cache
+        # hit/miss/invalidation, sheds by reason, priority-lane gauge
+        from pathway_tpu.internals.serving import serving_metrics
+
+        add(serving_metrics())
         return regs
 
     def metrics_text(self) -> str:
@@ -529,6 +559,7 @@ class PrometheusServer:
         from pathway_tpu.internals.memtrack import memory_status
         from pathway_tpu.internals.mesh_backend import mesh_status
         from pathway_tpu.internals.qtrace import qtrace_status
+        from pathway_tpu.internals.serving import serving_status
         from pathway_tpu.internals.tracing import merged_critical_path
         from pathway_tpu.internals.utilization import utilization_status
 
@@ -566,6 +597,10 @@ class PrometheusServer:
             # digest-backed per-stage p50/p95/p99/p999, SLO burn state,
             # slow-query exemplars
             "queries": qtrace_status(),
+            # serving tier (internals/serving.py): micro-batch occupancy
+            # p50/p99, result-cache hit rate, admission sheds + tenant
+            # limiter states, device-time partitioner verdict
+            "serving": serving_status(),
             # findings from pw.run(analysis=...): deployed graphs report
             # their own lint state (None when analysis was off)
             "analysis": getattr(e0, "analysis", None),

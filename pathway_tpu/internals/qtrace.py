@@ -271,6 +271,26 @@ class QueryTracer:
             if qid is not None:
                 self.mark(qid, name, **meta)
 
+    def note_batch_occupancy(
+        self, keys, occupancy: int, waited_ms: Optional[float] = None
+    ) -> None:
+        """Annotate pending queries with the serving micro-batch they
+        rode in: how many queries shared the flush and how long the first
+        arrival waited for company.  Meta-only (no timestamp mark) — the
+        span timeline already has 'enqueued' at flush time."""
+        pk = self._pending_keys
+        if not pk:
+            return
+        meta: Dict[str, Any] = {"batch_occupancy": int(occupancy)}
+        if waited_ms is not None:
+            meta["batch_wait_ms"] = round(float(waited_ms), 3)
+        for k in keys:
+            qid = pk.get(k)
+            if qid is not None:
+                rec = self._pending.get(qid)
+                if rec is not None:
+                    rec["meta"].update(meta)
+
     def note_device_keys(
         self,
         keys,

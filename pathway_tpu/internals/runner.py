@@ -119,7 +119,9 @@ def last_engine():
     return _last_engine
 
 
-def _apply_analysis(engine: Engine, mode, mesh=None, baseline=None) -> None:
+def _apply_analysis(
+    engine: Engine, mode, mesh=None, baseline=None, slo=None
+) -> None:
     """Run the static analyzer over the registered sinks, verify its
     columnar predictions and the fusion plan against the freshly built
     nodes, and attach the result to the engine (the /status endpoint
@@ -146,7 +148,7 @@ def _apply_analysis(engine: Engine, mode, mesh=None, baseline=None) -> None:
         verify_fusion,
     )
 
-    result = analyze(G, workers=engine.worker_count, mesh=mesh)
+    result = analyze(G, workers=engine.worker_count, mesh=mesh, slo=slo)
     verify_against_plan(engine, result)
     verify_fusion(engine, result)
     verify_capacity(engine, result)
@@ -250,6 +252,7 @@ def run(
                 analysis=analysis,
                 analysis_baseline=analysis_baseline,
                 mesh=mesh,
+                slo=slo,
                 **kwargs,
             )
         finally:
@@ -276,7 +279,8 @@ def run(
                 nodes = [ctx.node(t) for t in sink.tables]
                 sink.attach(ctx, nodes)
         _apply_analysis(
-            engine, analysis, mesh=mesh, baseline=analysis_baseline
+            engine, analysis, mesh=mesh, baseline=analysis_baseline,
+            slo=slo,
         )
         _attach_monitoring(engine)
         monitor = _maybe_start_dashboard(engine, monitoring_level)
@@ -328,6 +332,7 @@ def _run_threaded(
     analysis=None,
     analysis_baseline=None,
     mesh=None,
+    slo: float | None = None,
     **kwargs,
 ) -> None:
     """workers = threads x processes (reference:
@@ -383,7 +388,7 @@ def _run_threaded(
                 if thread_index == 0:
                     _apply_analysis(
                         engine, analysis, mesh=mesh,
-                        baseline=analysis_baseline,
+                        baseline=analysis_baseline, slo=slo,
                     )
             _attach_monitoring(engine)
             monitor = None

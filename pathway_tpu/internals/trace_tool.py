@@ -259,6 +259,43 @@ def render_status(status: dict) -> str:
             if ex.get("replica") is not None:
                 line += f", replica {ex['replica']}"
             lines.append(line + ")")
+    serving = status.get("serving")
+    if serving and serving.get("enabled") and serving.get("active"):
+        line = (
+            f"serving: window={serving.get('batch_window_ms')}ms "
+            f"batches={serving.get('batches')} "
+            f"occ_p50={serving.get('batch_occupancy_p50')} "
+            f"occ_p99={serving.get('batch_occupancy_p99')}"
+        )
+        part = serving.get("partitioner") or {}
+        if part.get("priority"):
+            line += (
+                f" PRIORITY[scale={part.get('serving_scale')}]"
+            )
+        lines.append(line)
+        cache = serving.get("cache") or {}
+        if cache.get("hits") or cache.get("misses"):
+            lines.append(
+                f"  cache: hit_rate={cache.get('hit_rate')} "
+                f"entries={cache.get('entries')} "
+                f"invalidations={cache.get('invalidations')}"
+            )
+        adm = serving.get("admission") or {}
+        if adm.get("shed_total"):
+            sheds = adm.get("sheds") or {}
+            lines.append(
+                "  shed: total="
+                f"{adm['shed_total']} "
+                + " ".join(
+                    f"{r}={n}" for r, n in sorted(sheds.items()) if n
+                )
+            )
+        tenants = adm.get("tenants") or {}
+        for tenant, tb in sorted(tenants.items()):
+            lines.append(
+                f"  tenant {tenant}: tokens={tb.get('tokens')} "
+                f"rate={tb.get('rate')}/s burst={tb.get('burst')}"
+            )
     analysis = status.get("analysis")
     if analysis and analysis.get("findings"):
         lines.append(f"analysis findings: {len(analysis['findings'])}")

@@ -25,6 +25,7 @@ from pathway_tpu.internals.schema import (
     schema_from_columns,
 )
 from pathway_tpu.internals import qtrace as _qtrace
+from pathway_tpu.internals import serving as _serving
 from pathway_tpu.io._connector_runtime import (
     ConnectorSubjectBase,
     connector_table,
@@ -138,6 +139,21 @@ class PathwayWebserver:
                     payload = {"value": payload}
             try:
                 result = await handler(payload, request)
+            except _RequestThrottled as exc:
+                import math
+
+                resp = web.json_response(
+                    {"error": str(exc), "reason": exc.reason},
+                    status=429,
+                    headers={
+                        "Retry-After": str(
+                            max(1, math.ceil(exc.retry_after))
+                        )
+                    },
+                )
+                if self.with_cors:
+                    resp = self._with_cors_headers(resp)
+                return resp
             except _RequestRejected as exc:
                 return web.json_response({"error": str(exc)}, status=400)
             resp = web.json_response(result)
@@ -178,6 +194,16 @@ class _RequestRejected(Exception):
     pass
 
 
+class _RequestThrottled(Exception):
+    """Admission-control shed: becomes a 429 with a Retry-After header —
+    the request never touched the engine or the device."""
+
+    def __init__(self, retry_after: float, reason: str):
+        super().__init__(f"overloaded ({reason})")
+        self.retry_after = retry_after
+        self.reason = reason
+
+
 class _RestSubject(ConnectorSubjectBase):
     def __init__(
         self,
@@ -198,6 +224,10 @@ class _RestSubject(ConnectorSubjectBase):
         self.request_validator = request_validator
         self.documentation = documentation
         self._payloads: Dict[Pointer, dict] = {}
+        # next()/commit() are called from the aiohttp loop (per-query
+        # path, delete-completed), and from the serving batcher's flush
+        # thread — one lock keeps each commit an atomic engine batch
+        self._emit_lock = threading.Lock()
 
     def run(self) -> None:
         names = list(self.schema.keys())
@@ -205,41 +235,72 @@ class _RestSubject(ConnectorSubjectBase):
         defaults = self.schema.default_values()
 
         async def handler(payload: dict, request):
-            if self.request_validator is not None:
-                try:
-                    validation = self.request_validator(payload)
-                    if validation is not None and validation is not True:
-                        raise _RequestRejected(str(validation))
-                except _RequestRejected:
-                    raise
-                except Exception as exc:  # noqa: BLE001
-                    raise _RequestRejected(str(exc)) from exc
-            key = ref_scalar("rest", self.route, next(_request_ids))
-            if _qtrace.ENABLED:
-                _qtrace.tracker().begin(str(key), route=self.route, key=key)
-            row = {}
-            for name in names:
-                if name in payload:
-                    row[name] = _coerce(payload[name], dtypes[name])
-                elif name in defaults:
-                    row[name] = defaults[name]
+            # admission first: shedding must cost less than anything it
+            # sheds (no validation, no engine row, no device work)
+            tier = _serving.tier() if _serving.ENABLED else None
+            admitted = False
+            if tier is not None:
+                tenant = (
+                    request.headers.get("X-Tenant", "default")
+                    if request is not None
+                    else "default"
+                )
+                verdict = tier.admission.admit(tenant)
+                if verdict is not None:
+                    retry_after, reason = verdict
+                    raise _RequestThrottled(retry_after, reason)
+                admitted = True
+            try:
+                if self.request_validator is not None:
+                    try:
+                        validation = self.request_validator(payload)
+                        if validation is not None and validation is not True:
+                            raise _RequestRejected(str(validation))
+                    except _RequestRejected:
+                        raise
+                    except Exception as exc:  # noqa: BLE001
+                        raise _RequestRejected(str(exc)) from exc
+                key = ref_scalar("rest", self.route, next(_request_ids))
+                if _qtrace.ENABLED:
+                    _qtrace.tracker().begin(
+                        str(key), route=self.route, key=key
+                    )
+                row = {}
+                for name in names:
+                    if name in payload:
+                        row[name] = _coerce(payload[name], dtypes[name])
+                    elif name in defaults:
+                        row[name] = defaults[name]
+                    else:
+                        row[name] = None
+                fut = self.webserver._register_pending(key)
+                self._payloads[key] = row
+                if tier is not None and tier.window_ms > 0:
+                    # park on the micro-batcher: concurrent requests
+                    # coalesce under ONE commit → one engine batch →
+                    # one fused device dispatch for the whole flush
+                    tier.batcher(self.route, self._flush_batch).submit(
+                        (key, row)
+                    )
                 else:
-                    row[name] = None
-            fut = self.webserver._register_pending(key)
-            self._payloads[key] = row
-            self.next(**row, _pw_key=key)
-            self.commit()
-            if _qtrace.ENABLED:
-                _qtrace.tracker().mark(str(key), "enqueued")
-            result = await fut
-            if _qtrace.ENABLED:
-                _qtrace.tracker().finish(str(key))
-            if self.delete_completed_queries:
-                old = self._payloads.pop(key, None)
-                if old is not None:
-                    self._remove({**old, "_pw_key": key})
-                    self.commit()
-            return result
+                    with self._emit_lock:
+                        self.next(**row, _pw_key=key)
+                        self.commit()
+                    if _qtrace.ENABLED:
+                        _qtrace.tracker().mark(str(key), "enqueued")
+                result = await fut
+                if _qtrace.ENABLED:
+                    _qtrace.tracker().finish(str(key))
+                if self.delete_completed_queries:
+                    old = self._payloads.pop(key, None)
+                    if old is not None:
+                        with self._emit_lock:
+                            self._remove({**old, "_pw_key": key})
+                            self.commit()
+                return result
+            finally:
+                if admitted:
+                    tier.admission.release()
 
         self.webserver.register_route(
             self.route, self.methods, handler, self.documentation
@@ -247,6 +308,20 @@ class _RestSubject(ConnectorSubjectBase):
         self.webserver._ensure_started()
         # block forever: requests arrive via the aiohttp loop
         threading.Event().wait()
+
+    def _flush_batch(self, items) -> None:
+        """Serving-batcher flush: push every parked (key, row) and commit
+        ONCE — the engine sees one batch, the index one fused dispatch.
+        Runs on the batcher thread."""
+        with self._emit_lock:
+            for key, row in items:
+                self.next(**row, _pw_key=key)
+            self.commit()
+        if _qtrace.ENABLED:
+            keys = [key for key, _row in items]
+            tq = _qtrace.tracker()
+            tq.mark_keys(keys, "enqueued")
+            tq.note_batch_occupancy(keys, len(items))
 
 
 def _coerce(value, dtype: dt.DType):

@@ -26,6 +26,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from pathway_tpu.internals import memtrack
+from pathway_tpu.internals import serving as _serving
 
 
 def _format_rows(scores, idx, key_of_slot) -> list:
@@ -321,6 +322,10 @@ class DeviceKnnIndex:
             )
         if memtrack.ENABLED and key not in self._slot_of_key:
             self._note_ingest(1)
+        if _serving.ENABLED:
+            # cache invalidation rides the delta stream: an insert OR an
+            # update can enter any cached query's top-k → global bump
+            _serving.note_index_add(1)
         slot = self._assign_slot(key)
         self._dirty[slot] = self._normalize(vector)
 
@@ -330,6 +335,8 @@ class DeviceKnnIndex:
         owning replica's row range so engine sharding and device
         sharding agree."""
         keys = list(keys)
+        if _serving.ENABLED and keys:
+            _serving.note_index_add(len(keys))
         if _is_device_array(vectors):
             # keep the batch on device: assign slots, one scatter, no host
             # round trip
@@ -386,6 +393,10 @@ class DeviceKnnIndex:
         slot = self._slot_of_key.pop(key, None)
         if slot is None:
             return
+        if _serving.ENABLED:
+            # removal is monotone — it can only change cached queries
+            # whose results contained this key → cluster-precise bump
+            _serving.note_index_remove(key)
         del self._key_of_slot[slot]
         self._push_free(slot)
         self._dirty[slot] = None
@@ -506,6 +517,43 @@ def _compiled_fused_search(config, metric: str, k: int, mesh=None, n_rows: int =
         if mesh is not None:
             # per-shard top-k + [Q, k] all-gather merge over the sharded
             # buffer (NOT a full-buffer gather), still inside this one jit
+            top_scores, top_idx = _sharded_search_body(
+                mesh, n_rows, k, metric
+            )(buffer, valid, emb)
+        else:
+            scores = _similarity(buffer, valid, emb, metric)
+            top_scores, top_idx = jax.lax.top_k(scores, k)
+        return jnp.concatenate(
+            [top_scores, top_idx.astype(jnp.float32)], axis=1
+        )
+
+    return jax.jit(fused)
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_fused_packed_search(
+    config, metric: str, k: int, max_segments: int, mesh=None, n_rows: int = 0
+):
+    """Packed-query variant of the fused program: the query batch arrives
+    as tokenizer.pack_batch slabs (ids/seg [R, L] + per-query gather
+    indices), so a coalesced serving batch costs one slab-sized encode
+    instead of one padded [B, L] encode — same one-jit discipline."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.transformer import forward
+
+    def fused(params, ids, seg, rows, segs, buffer, valid):
+        pooled = forward(
+            params,
+            config,
+            ids.astype(jnp.int32),
+            None,
+            seg=seg.astype(jnp.int32),
+            max_segments=max_segments,
+        )
+        emb = pooled[rows, segs]  # [Q, H], device-side gather
+        if mesh is not None:
             top_scores, top_idx = _sharded_search_body(
                 mesh, n_rows, k, metric
             )(buffer, valid, emb)
@@ -711,11 +759,9 @@ class FusedEmbedSearch:
     def search_texts(self, texts, k: int) -> list:
         from pathway_tpu.models.tokenizer import encode_batch
 
+        texts = list(texts)
         if not len(self.index):
             return [[] for _ in texts]
-        ids, mask = encode_batch(
-            self.encoder.tokenizer, list(texts), max_len=self.encoder.max_len
-        )
         self.index._flush()
         k_eff = min(k, self.index.capacity)
         import time as time_mod
@@ -723,14 +769,20 @@ class FusedEmbedSearch:
         from pathway_tpu.internals import qtrace as _qtrace
 
         t0 = time_mod.perf_counter() if _qtrace.ENABLED else 0.0
-        # ids/mask are wire-narrowed by encode_batch (one shared dtype);
-        # the fused jit upcasts on device
-        packed = self._fn(k_eff)(
-            self._params(),
-            np.stack([ids, mask]),
-            self.index._buffer,
-            self.index._valid_dev,
-        )
+        if _serving.ENABLED and len(texts) > 1 and _serving.pack_queries():
+            packed = self._packed_query_search(texts, k_eff)
+        else:
+            # ids/mask are wire-narrowed by encode_batch (one shared
+            # dtype); the fused jit upcasts on device
+            ids, mask = encode_batch(
+                self.encoder.tokenizer, texts, max_len=self.encoder.max_len
+            )
+            packed = self._fn(k_eff)(
+                self._params(),
+                np.stack([ids, mask]),
+                self.index._buffer,
+                self.index._valid_dev,
+            )
         packed = np.asarray(packed)[: len(texts)]
         if _qtrace.ENABLED:
             # pure device portion of the query (encode+search dispatch to
@@ -738,9 +790,50 @@ class FusedEmbedSearch:
             _qtrace.tracker().note_device_window(
                 time_mod.perf_counter() - t0, source="knn_search"
             )
+        if self.backend is not None:
+            self.backend.note_serve_batch(len(texts))
         scores = packed[:, :k_eff]
         idx = packed[:, k_eff:].astype(np.int64)
         return _format_rows(scores, idx, self.index._key_of_slot)
+
+    def _packed_query_search(self, texts, k_eff: int):
+        """Serving opt-in (PATHWAY_SERVE_PACK_QUERIES=1): tokenize the
+        coalesced query batch into token-budget slabs and run packed
+        encode → per-query gather → similarity → top_k as ONE jit.  Off
+        by default — the packed reduction order is numerically equivalent
+        but not bitwise identical to the classic bucketed encode."""
+        from pathway_tpu.models.tokenizer import (
+            PACK_MAX_SEGMENTS,
+            pack_batch,
+            pack_token_budget,
+        )
+
+        ids, seg, slots = pack_batch(
+            self.encoder.tokenizer,
+            texts,
+            max_len=self.encoder.max_len,
+            token_budget=pack_token_budget() or 256,
+            max_segments=PACK_MAX_SEGMENTS,
+        )
+        # gather indices bucketed so occupancy jitter between serving
+        # batches reuses the same compiled executable
+        qb = _next_bucket(len(slots))
+        rows = np.zeros((qb,), dtype=np.int64)
+        segs = np.zeros((qb,), dtype=np.int64)
+        for i, (r, s) in enumerate(slots):
+            rows[i] = r
+            segs[i] = s
+        return _compiled_fused_packed_search(
+            self.encoder.config,
+            self.index.metric,
+            k_eff,
+            PACK_MAX_SEGMENTS,
+            mesh=self.index.mesh,
+            n_rows=self.index.capacity if self.index.mesh is not None else 0,
+        )(
+            self._params(), ids, seg, rows, segs,
+            self.index._buffer, self.index._valid_dev,
+        )
 
 
 def _sharded_search_body(mesh, n_rows: int, k: int, metric: str):

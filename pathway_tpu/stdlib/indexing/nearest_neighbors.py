@@ -18,6 +18,7 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from pathway_tpu.engine.index_node import IndexImpl
+from pathway_tpu.internals import serving as _serving
 from pathway_tpu.ops.knn import DeviceKnnIndex
 from pathway_tpu.stdlib.indexing._filters import evaluate_filter
 from pathway_tpu.stdlib.indexing.data_index import DataIndex, InnerIndex
@@ -58,6 +59,11 @@ class _KnnIndexImpl(IndexImpl):
     device dispatch — a dead tunnel would hang one indefinitely.  On
     re-promotion the next device search flushes everything staged in the
     interim.  The mirror costs one float32 copy per live vector."""
+
+    # every mutation flows through DeviceKnnIndex.add/remove, whose
+    # serving generation hooks invalidate cached results — so the
+    # serving result cache may front search_many (engine/index_node.py)
+    supports_result_cache = True
 
     def __init__(self, dimensions: int, metric: str, reserved_space: int, mesh=None):
         if mesh is None:
@@ -150,6 +156,10 @@ class _FusedKnnIndexImpl(IndexImpl):
     top_k as a single jit call (ops/knn.py FusedEmbedSearch). Document
     embeddings are computed and scattered into the device index without ever
     leaving HBM. This is the framework wiring of SURVEY §3.4's hot path."""
+
+    # adds (sync or pipelined) and removes all land in DeviceKnnIndex,
+    # whose serving generation hooks keep the result cache sound
+    supports_result_cache = True
 
     def __init__(self, encoder, metric: str, reserved_space: int, mesh=None):
         from pathway_tpu.ops.knn import DeviceKnnIndex, FusedEmbedSearch
@@ -284,6 +294,12 @@ class _FusedKnnIndexImpl(IndexImpl):
 
         texts = [v if isinstance(v, str) else str(v) for v in values]
         keys = list(keys)
+        if _serving.ENABLED and keys:
+            # the pipelined path defers the DeviceKnnIndex scatter (and
+            # its generation hook) until dispatch; bump at SUBMIT so a
+            # cache consult racing the pipeline can only over-invalidate,
+            # never serve a result that predates this delta
+            _serving.note_index_add(len(keys))
         if texts and self._use_pipeline():
             pipe = self._ensure_pipeline()
             step = self._pipeline_step(len(texts))

@@ -226,6 +226,7 @@ def test_matrix_covers_enough_codes():
         "PWT402", "PWT403", "PWT404", "PWT405",
         "PWT501", "PWT502", "PWT503", "PWT504",
         "PWT601", "PWT602", "PWT603", "PWT605",
+        "PWT701",
     } <= codes, codes
 
 
@@ -342,6 +343,81 @@ def test_empty_graph_is_clean():
     assert result.findings == [] and result.predictions == []
     assert result.max_severity() is None
     assert result.render_text() == "no findings"
+
+
+# ---------------------------------------------------------------------------
+# serving pass (PWT7xx)
+# ---------------------------------------------------------------------------
+
+
+def _serving_indexed_graph(encoder):
+    from pathway_tpu.internals.parse_graph import record_op
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str), [("a",), ("b",)]
+    )
+    idx = t.select(name=t.name)
+    record_op(
+        idx, "external_index", (t,),
+        index="BruteForceKnn", dimensions=32, reserved_space=64,
+        metric="cosine_similarity", encoder=encoder,
+    )
+    _sink(idx)
+    return idx
+
+
+def test_pwt701_index_without_encoder_cannot_fuse_batches():
+    from pathway_tpu.internals import serving
+
+    assert serving.ENABLED  # default-on in the test env
+    keep = _serving_indexed_graph(encoder=None)
+    codes = {f.code for f in analyze(G, workers=1).findings}
+    assert "PWT701" in codes
+    del keep
+
+    G.clear()
+    keep = _serving_indexed_graph(
+        encoder={"vocab_size": 512, "hidden": 32, "layers": 1,
+                 "mlp_dim": 64, "max_len": 32}
+    )
+    codes = {f.code for f in analyze(G, workers=1).findings}
+    assert "PWT701" not in codes
+    del keep
+
+
+def test_pwt702_batch_window_exceeding_slo(monkeypatch):
+    monkeypatch.setenv("PATHWAY_SERVE_BATCH_WINDOW_MS", "50")
+    keep = _serving_indexed_graph(encoder=None)
+    # window 50 ms > 10 ms p99 target: unmeetable by configuration
+    fs = [f for f in analyze(G, workers=1, slo=10.0).findings
+          if f.code == "PWT702"]
+    assert len(fs) == 1
+    assert "50" in fs[0].message and "10" in fs[0].message
+    # a sane target is silent
+    codes = {f.code for f in analyze(G, workers=1, slo=500.0).findings}
+    assert "PWT702" not in codes
+    # CLI path: the env fallback carries the target when pw.run(slo=)
+    # never ran
+    monkeypatch.setenv("PATHWAY_SLO_P99_MS", "10")
+    codes = {f.code for f in analyze(G, workers=1).findings}
+    assert "PWT702" in codes
+    del keep
+
+
+def test_serving_pass_gated_off(monkeypatch):
+    from pathway_tpu.internals import serving
+
+    keep = _serving_indexed_graph(encoder=None)
+    # a zero window disarms the batcher: nothing to lint
+    monkeypatch.setenv("PATHWAY_SERVE_BATCH_WINDOW_MS", "0")
+    codes = {f.code for f in analyze(G, workers=1, slo=1.0).findings}
+    assert not {"PWT701", "PWT702"} & codes
+    monkeypatch.delenv("PATHWAY_SERVE_BATCH_WINDOW_MS")
+    # serving disabled: the pass never runs
+    monkeypatch.setattr(serving, "ENABLED", False)
+    codes = {f.code for f in analyze(G, workers=1, slo=1.0).findings}
+    assert not {"PWT701", "PWT702"} & codes
+    del keep
 
 
 # ---------------------------------------------------------------------------

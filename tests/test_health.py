@@ -364,6 +364,8 @@ def test_rolling_restart_state_machine_one_at_a_time():
         "restart_done": 2,
         "throttle": 0,
         "relax": 0,
+        "serve_priority": 0,
+        "serve_release": 0,
     }
     ev = [e["kind"] for e in c.recorder.tail(32)]
     assert "health_roll_requested" in ev and "health_roll_complete" in ev

@@ -1147,3 +1147,102 @@ def test_profiler_idle_is_noop():
         timeout=120,
     )
     assert proc.returncode == 0, proc.stderr
+
+
+@pytest.mark.perf_smoke
+def test_serving_armed_idle_overhead_under_5pct():
+    """The serving tier armed but idle — tier live, micro-batcher flush
+    thread parked on its condition variable, zero queries in flight —
+    must cost under 5% on the engine ingest microbench.  Each tick runs
+    the real ingest-side hook (serving.note_index_add: one module-attr
+    read, one None check, and when armed one cache-generation bump), so
+    the guard covers both the hook and any ambient cost of the live
+    flush thread.  Same paired min-of-N protocol as the health guard."""
+    import gc
+    from time import perf_counter
+
+    from pathway_tpu.engine.engine import InputQueueSource, RowwiseNode
+    from pathway_tpu.internals import serving
+
+    ROWS, TICKS, REPS = 512, 80, 9
+    deltas = [(ref_scalar("k", i), (i,), 1) for i in range(ROWS)]
+
+    def ident(keys, cols):
+        return cols[0]
+
+    def run_once(armed: bool) -> float:
+        saved = serving.ENABLED
+        serving.ENABLED = armed
+        if armed:
+            serving.reset_for_tests()  # tier + parked flush machinery
+        else:
+            serving.shutdown()
+        eng = Engine(metrics=False)
+        src = InputQueueSource(eng)
+        node = src
+        for _ in range(3):
+            node = RowwiseNode(eng, [node], ident)
+        try:
+            time = 2
+            for _ in range(8):  # warmup outside the timed region
+                src.push(time, deltas)
+                serving.note_index_add(ROWS)
+                eng.process_time(time)
+                time += 2
+            t0 = perf_counter()
+            for _ in range(TICKS):
+                src.push(time, deltas)
+                serving.note_index_add(ROWS)
+                eng.process_time(time)
+                time += 2
+            return perf_counter() - t0
+        finally:
+            serving.ENABLED = saved
+            eng._gc_unfreeze()
+
+    ratios = []
+    gc.collect()
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        for _ in range(REPS):
+            ratios.append(run_once(True) / run_once(False))
+    finally:
+        serving.shutdown()
+        if gc_was_enabled:
+            gc.enable()
+    # paired per-rep ratios, best pair judged (see the health guard for
+    # why min-of-pairs is drift-immune on a shared box)
+    ratio = min(ratios)
+    assert ratio < 1.05, (
+        f"serving armed-idle overhead {ratio:.3f}x (pair ratios "
+        f"{[round(r, 3) for r in ratios]})"
+    )
+
+
+@pytest.mark.perf_smoke
+def test_serving_disabled_is_single_attribute_read():
+    """PATHWAY_SERVING=0: importing the module and consulting status
+    must never instantiate the tier, and the ingest hooks reduce to one
+    module-attribute read against None."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "from pathway_tpu.internals import serving;"
+        "assert serving.ENABLED is False;"
+        "assert serving._TIER is None;"
+        "serving.note_index_add(4);"
+        "serving.note_index_remove('k');"
+        "assert serving.serving_metrics() is None;"
+        "assert serving.serving_status() == {'enabled': False};"
+        "assert serving._TIER is None, 'status/hooks instantiated it'"
+    )
+    env = dict(os.environ)
+    env["PATHWAY_SERVING"] = "0"
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr

@@ -1,0 +1,643 @@
+"""Serving tier (internals/serving.py): micro-batch coalescing,
+admission control with 429 + Retry-After at REST ingress, the
+retraction-driven result cache (zero stale reads through mid-stream
+update/delete chaos), the device-time partitioner's priority lanes, and
+drained-replica serving on an active mesh backend."""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import serving
+from pathway_tpu.internals.runner import run_tables
+from pathway_tpu.models.transformer import TransformerConfig
+
+
+@contextlib.contextmanager
+def _env(**kv):
+    saved = {k: os.environ.get(k) for k in kv}
+    for k, v in kv.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tier():
+    """Every test gets a tier built from its own env; the process
+    singleton never leaks across tests (or into other test files)."""
+    yield
+    serving.shutdown()
+    from pathway_tpu.internals import runner
+
+    eng = runner.last_engine()
+    if eng is not None:
+        eng.terminate_flag.set()
+
+
+def _tiny_embedder(name: str):
+    from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+
+    tiny = TransformerConfig(
+        vocab_size=512, hidden=32, layers=1, heads=2, mlp_dim=64, max_len=32
+    )
+    return SentenceTransformerEmbedder(name, config=tiny, max_len=16)
+
+
+# -- unit: token bucket / admission ------------------------------------------
+
+
+def test_token_bucket_retry_after_hint():
+    b = serving._TokenBucket(rate=2.0, burst=1.0)
+    now = time.monotonic()
+    assert b.take(now) is None  # burst token
+    retry = b.take(now)
+    assert retry is not None and 0 < retry <= 0.5  # 1 token / 2 per s
+    # tokens accrue with time
+    assert b.take(now + 1.0) is None
+
+
+def test_admission_queue_full_sheds_before_device():
+    with _env(PATHWAY_SERVE_QUEUE="2", PATHWAY_SERVE_TENANT_RATE=None):
+        adm = serving.AdmissionController()
+        assert adm.admit("t") is None
+        assert adm.admit("t") is None
+        verdict = adm.admit("t")
+        assert verdict is not None
+        retry_after, reason = verdict
+        assert reason == "queue_full" and retry_after > 0
+        adm.release()
+        assert adm.admit("t") is None  # slot freed
+        st = adm.status()
+        assert st["sheds"]["queue_full"] == 1
+        assert st["shed_total"] == 1
+        assert st["queue_depth"] == 3 - 1
+
+
+def test_admission_tenant_token_buckets_are_per_tenant():
+    with _env(
+        PATHWAY_SERVE_TENANT_RATE="0.5",
+        PATHWAY_SERVE_TENANT_BURST="1",
+        PATHWAY_SERVE_QUEUE="64",
+    ):
+        adm = serving.AdmissionController()
+        assert adm.admit("alice") is None
+        verdict = adm.admit("alice")  # burst spent, 1 token per 2 s
+        assert verdict is not None and verdict[1] == "tenant_limit"
+        assert verdict[0] > 0  # Retry-After hint
+        # bob has his own bucket
+        assert adm.admit("bob") is None
+        st = adm.status()
+        assert st["sheds"]["tenant_limit"] == 1
+        assert st["tenant_count"] == 2
+        assert st["tenants"]["alice"]["rate"] == 0.5
+
+
+def test_admission_bound_halves_under_health_backpressure():
+    from pathway_tpu.internals import health
+
+    if not health.ENABLED:
+        pytest.skip("health controller disabled")
+    with _env(PATHWAY_SERVE_QUEUE="8"):
+        adm = serving.AdmissionController()
+        assert adm._effective_bound() == (8, False)
+        ctrl = health.controller()
+        saved = ctrl._pressure
+        ctrl._pressure = True
+        try:
+            assert adm._effective_bound() == (4, True)
+            for _ in range(4):
+                assert adm.admit("t") is None
+            verdict = adm.admit("t")
+            assert verdict is not None and verdict[1] == "backpressure"
+        finally:
+            ctrl._pressure = saved
+
+
+# -- unit: micro-batcher ------------------------------------------------------
+
+
+def test_micro_batcher_coalesces_on_window():
+    flushes = []
+    done = threading.Event()
+
+    def flush(items):
+        flushes.append(list(items))
+        done.set()
+
+    b = serving.MicroBatcher(flush, window_ms=30.0, max_batch=64)
+    try:
+        for i in range(5):
+            b.submit(i)
+        assert done.wait(timeout=5)
+        time.sleep(0.05)  # no second flush may trail the first
+        assert flushes == [[0, 1, 2, 3, 4]]
+        assert b.flushes == 1 and b.flushed_items == 5
+    finally:
+        b.close()
+
+
+def test_micro_batcher_size_trigger_beats_window():
+    flushes = []
+    sem = threading.Semaphore(0)
+
+    def flush(items):
+        flushes.append(list(items))
+        sem.release()
+
+    b = serving.MicroBatcher(flush, window_ms=10_000.0, max_batch=4)
+    try:
+        t0 = time.monotonic()
+        for i in range(4):
+            b.submit(i)
+        assert sem.acquire(timeout=5)
+        assert time.monotonic() - t0 < 5.0  # did not wait out the window
+        assert flushes == [[0, 1, 2, 3]]
+    finally:
+        b.close()
+
+
+def test_micro_batcher_survives_poisoned_flush():
+    calls = []
+    sem = threading.Semaphore(0)
+
+    def flush(items):
+        calls.append(list(items))
+        sem.release()
+        if len(calls) == 1:
+            raise RuntimeError("poisoned batch")
+
+    b = serving.MicroBatcher(flush, window_ms=1.0, max_batch=64)
+    try:
+        b.submit("a")
+        assert sem.acquire(timeout=5)
+        b.submit("b")  # the flush thread must still be alive
+        assert sem.acquire(timeout=5)
+        assert calls == [["a"], ["b"]]
+    finally:
+        b.close()
+
+
+# -- unit: result cache -------------------------------------------------------
+
+
+def test_result_cache_generations_are_exact():
+    cache = serving.ResultCache()
+    k1 = cache.make_key(1, "  What   IS pathway? ", 3, None)
+    assert k1 == (1, "what is pathway?", 3, None)
+    assert cache.make_key(1, b"vector", 3, None) is None  # text only
+
+    cache.put(k1, [("docA", 0.9), ("docB", 0.8)])
+    assert cache.get(k1) == [("docA", 0.9), ("docB", 0.8)]
+
+    # removal of an unrelated key (different cluster) keeps the entry
+    unrelated = "zzz-unrelated"
+    if cache._cluster(unrelated) in {
+        cache._cluster("docA"), cache._cluster("docB")
+    }:
+        unrelated = "zzz-unrelated-2"
+    cache.note_remove(unrelated)
+    assert cache.get(k1) is not None
+
+    # removal of a member key invalidates exactly this entry
+    cache.note_remove("docA")
+    assert cache.get(k1) is None
+    assert cache.invalidations == 1
+
+    # any insert/update bumps the global generation: everything drops
+    cache.put(k1, [("docA", 0.9)])
+    cache.note_add(1)
+    assert cache.get(k1) is None
+    assert cache.invalidations == 2
+
+
+def test_cached_search_order_preserving_hit_miss_split():
+    with _env(PATHWAY_SERVE_CACHE="64"):
+        tier = serving.reset_for_tests()
+        searched = []
+
+        def search_fn(values, ks, filters):
+            searched.append(list(values))
+            return [[(v, 1.0)] for v in values]
+
+        out = tier.cached_search(
+            ["a", "b", "c"], [1, 1, 1], [None] * 3, search_fn
+        )
+        assert out == [[("a", 1.0)], [("b", 1.0)], [("c", 1.0)]]
+        assert searched == [["a", "b", "c"]]
+        # second call: b+c hit, only the new query d misses; order kept
+        out = tier.cached_search(
+            ["c", "d", "b"], [1, 1, 1], [None] * 3, search_fn
+        )
+        assert out == [[("c", 1.0)], [("d", 1.0)], [("b", 1.0)]]
+        assert searched[-1] == ["d"]
+        assert tier.cache.hits == 2 and tier.cache.misses == 4
+
+
+# -- REST ingress: coalescing, 429 + Retry-After ------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_http(port, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/_schema", timeout=5
+            ):
+                return
+        except Exception:
+            time.sleep(0.1)
+    raise TimeoutError("webserver did not come up")
+
+
+def _post(port, payload, tenant=None):
+    headers = {"Content-Type": "application/json"}
+    if tenant is not None:
+        headers["X-Tenant"] = tenant
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/serve",
+        data=json.dumps(payload).encode(),
+        headers=headers,
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def _double_app(port):
+    from pathway_tpu.io.http._server import PathwayWebserver, rest_connector
+
+    webserver = PathwayWebserver("127.0.0.1", port)
+
+    class QuerySchema(pw.Schema):
+        value: int
+
+    queries, writer = rest_connector(
+        webserver=webserver,
+        route="/serve",
+        schema=QuerySchema,
+        methods=("POST",),
+        delete_completed_queries=False,
+    )
+    writer(queries.select(result=pw.this.value * 2))
+    threading.Thread(target=pw.run, daemon=True).start()
+    _wait_http(port)
+
+
+def test_rest_requests_coalesce_into_one_commit():
+    """Concurrent REST queries ride ONE micro-batch flush (occupancy > 1)
+    and every request still gets its own correct, de-multiplexed
+    answer."""
+    with _env(
+        PATHWAY_SERVE_BATCH_WINDOW_MS="40",
+        PATHWAY_SERVE_MAX_BATCH="64",
+    ):
+        serving.reset_for_tests()
+        port = _free_port()
+        _double_app(port)
+
+        results = {}
+        lock = threading.Lock()
+
+        def one(i):
+            body = _post(port, {"value": i})
+            got = body.get("result") if isinstance(body, dict) else body
+            with lock:
+                results[i] = got
+
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert results == {i: i * 2 for i in range(8)}
+
+        tier = serving.tier()
+        st = tier.status()
+        assert st["batches"] >= 1
+        assert st["batched_queries"] == 8
+        # 8 concurrent queries into a 40 ms window: they coalesced
+        assert st["batches"] < 8
+        assert st["batch_occupancy_p99"] > 1
+
+
+def test_rest_tenant_limit_responds_429_with_retry_after():
+    with _env(
+        PATHWAY_SERVE_TENANT_RATE="0.2",
+        PATHWAY_SERVE_TENANT_BURST="1",
+        PATHWAY_SERVE_BATCH_WINDOW_MS="1",
+    ):
+        serving.reset_for_tests()
+        port = _free_port()
+        _double_app(port)
+
+        assert _post(port, {"value": 1}, tenant="alice") in (
+            2, {"result": 2},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            _post(port, {"value": 2}, tenant="alice")
+        err = exc_info.value
+        assert err.code == 429
+        retry_after = err.headers.get("Retry-After")
+        assert retry_after is not None and int(retry_after) >= 1
+        body = json.loads(err.read())
+        assert body["reason"] == "tenant_limit"
+        # a different tenant is not throttled
+        assert _post(port, {"value": 3}, tenant="bob") in (
+            6, {"result": 6},
+        )
+        sheds = serving.tier().admission.sheds
+        assert sheds["tenant_limit"] == 1
+
+
+def test_serving_disabled_rest_path_still_serves():
+    saved = serving.ENABLED
+    serving.ENABLED = False
+    try:
+        port = _free_port()
+        _double_app(port)
+        assert _post(port, {"value": 21}) in (42, {"result": 42})
+        assert serving._TIER is None  # nothing instantiated the tier
+    finally:
+        serving.ENABLED = saved
+
+
+# -- chaos: retraction stream invalidates cached results ----------------------
+
+
+def _fused_index(docs, name):
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+        BruteForceKnnFactory,
+        _FusedKnnIndexImpl,
+    )
+
+    embedder = _tiny_embedder(name)
+    inner = BruteForceKnnFactory(
+        embedder=embedder, reserved_space=64
+    ).build_inner_index(docs.text)
+    assert isinstance(inner._make_impl(), _FusedKnnIndexImpl)
+    from pathway_tpu.stdlib.indexing.data_index import DataIndex
+
+    return DataIndex(docs, inner)
+
+
+def test_chaos_delete_mid_stream_invalidates_cached_result():
+    """An indexed doc is deleted mid-stream AFTER a query result
+    containing it was cached: the retraction must invalidate the cached
+    entry before the next read — the final answer is the post-delete
+    truth, never the stale cache fill (zero stale reads)."""
+    with _env(PATHWAY_SERVE_CACHE="64", PATHWAY_SERVE_BATCH_WINDOW_MS="2"):
+        tier = serving.reset_for_tests()
+        docs = pw.debug.table_from_markdown(
+            """
+            text                | __time__ | __diff__
+            alpha_bravo_charlie | 2        | 1
+            delta_echo_foxtrot  | 2        | 1
+            alpha_bravo_charlie | 4        | -1
+            """
+        )
+        index = _fused_index(docs, "serving-chaos-del")
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(q=str), [("alpha_bravo_charlie",)]
+        )
+        res = index.query(queries.q, number_of_matches=1).select(
+            m=pw.this.text
+        )
+        (cap,) = run_tables(res, record_stream=True)
+        ((m,),) = cap.state.rows.values()
+        # the t=2 answer (the exact-match doc) was cached, then the doc
+        # was deleted at t=4: the final state is the re-searched truth
+        assert m == ("delta_echo_foxtrot",)
+        st = tier.cache.status()
+        assert st["invalidations"] >= 1, st
+        # the stale t=2 answer was retracted on the stream
+        retractions = [d for _t, d in cap.stream if d[2] < 0]
+        assert any(
+            d[1][0] == ("alpha_bravo_charlie",) for d in retractions
+        )
+
+
+def test_chaos_update_mid_stream_invalidates_cached_result():
+    """A re-embedded (updated) doc bumps the GLOBAL generation: any
+    cached result may contain it post-update, so every entry filled
+    before the update is dead."""
+    with _env(PATHWAY_SERVE_CACHE="64", PATHWAY_SERVE_BATCH_WINDOW_MS="2"):
+        tier = serving.reset_for_tests()
+        docs = pw.debug.table_from_markdown(
+            """
+            text                | __time__ | __diff__
+            alpha_bravo_charlie | 2        | 1
+            golf_hotel_india    | 2        | 1
+            golf_hotel_india    | 4        | -1
+            alpha_bravo_zulu    | 4        | 1
+            """
+        )
+        index = _fused_index(docs, "serving-chaos-upd")
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(q=str), [("alpha_bravo_zulu",)]
+        )
+        res = index.query(queries.q, number_of_matches=1).select(
+            m=pw.this.text
+        )
+        (cap,) = run_tables(res)
+        ((m,),) = cap.state.rows.values()
+        # post-update truth: the new doc text is the exact match
+        assert m == ("alpha_bravo_zulu",)
+        assert tier.cache.gen_global >= 2  # both timestamps bumped it
+
+
+def test_cache_generation_bumps_ride_knn_mutations():
+    """ops/knn.py add/add_batch/remove are the invalidation hook sites:
+    mutations through DeviceKnnIndex must move the tier's generations
+    without any engine in the loop."""
+    import numpy as np
+
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    tier = serving.reset_for_tests()
+    idx = DeviceKnnIndex(4, metric="cos", reserved_space=8)
+    g0 = tier.cache.gen_global
+    idx.add("k1", np.ones(4, dtype=np.float32))
+    assert tier.cache.gen_global == g0 + 1
+    cluster = tier.cache._cluster("k1")
+    c0 = tier.cache.cluster_gens[cluster]
+    idx.remove("k1")
+    assert tier.cache.cluster_gens[cluster] == c0 + 1
+    assert tier.cache.gen_global == g0 + 1  # removals stay cluster-local
+
+
+# -- priority lanes / partitioner ---------------------------------------------
+
+
+def test_partitioner_engages_and_releases_priority():
+    from pathway_tpu.internals import device_pipeline, qtrace
+
+    if not qtrace.ENABLED:
+        pytest.skip("qtrace disabled")
+    tier = serving.reset_for_tests()
+    part = tier.partitioner
+    qtrace.reset()
+    tq = qtrace.tracker()
+    tq.set_slo(10.0)  # 10 ms p99 target
+    try:
+        # burn the SLO: slow spans push p99 far past the target
+        for i in range(32):
+            assert tq.begin(f"q{i}")
+            # retro-date ingress: 500 ms of synthetic latency
+            tq._pending[f"q{i}"]["marks"]["ingress"] -= 0.5
+            tq.finish(f"q{i}")
+        assert (tq.burn_rate() or 0) >= 1.0
+        part._next_tick = 0.0
+        part.maybe_tick()
+        assert part.priority is True
+        assert device_pipeline.serving_scale() == serving.PRIORITY_SCALE
+        assert part.status()["shifts"] == 1
+
+        # burn clears -> ingest reclaims the slots
+        qtrace.reset()
+        tq = qtrace.tracker()
+        tq.set_slo(10_000.0)
+        part._next_tick = 0.0
+        part.maybe_tick()
+        assert part.priority is False
+        assert device_pipeline.serving_scale() == 1.0
+    finally:
+        part.release_for_tests()
+        qtrace.reset()
+
+
+def test_serving_scale_shrinks_pipeline_windows():
+    from pathway_tpu.internals import device_pipeline
+
+    pipe = device_pipeline.DevicePipeline(
+        prepare=lambda item: item,
+        dispatch=lambda prepared: None,
+        name="serve-scale-test",
+    )
+    try:
+        base_prepared = pipe.max_prepared
+        base_inflight = pipe.max_in_flight
+        device_pipeline.set_serving_scale(0.5)
+        assert pipe.max_prepared == max(1, int(base_prepared * 0.5))
+        assert pipe.max_in_flight == max(1, int(base_inflight * 0.5))
+        assert device_pipeline.pipeline_status()["serving_scale"] == 0.5
+        device_pipeline.set_serving_scale(1.0)
+        assert pipe.max_prepared == base_prepared
+        assert pipe.max_in_flight == base_inflight
+    finally:
+        device_pipeline.set_serving_scale(1.0)
+        pipe.close()
+
+
+# -- drained-replica serving (mesh backend) -----------------------------------
+
+
+def test_drained_replica_serving_is_ranking_exact():
+    """Serving with a drained replica: the drained replica takes no new
+    ingest and no serve-read credit, but its shard stays searchable —
+    rankings are EXACT through the drain (the detour only affects new
+    keys' placement)."""
+    import jax
+
+    from pathway_tpu.analysis.mesh import MeshSpec
+    from pathway_tpu.internals import mesh_backend
+    from pathway_tpu.models.minilm import SentenceEncoder
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+        _FusedKnnIndexImpl,
+    )
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices (conftest emulates them)")
+
+    serving.reset_for_tests()
+    tiny = TransformerConfig(
+        vocab_size=512, hidden=32, layers=1, heads=2, mlp_dim=64, max_len=64
+    )
+    enc = SentenceEncoder("serving-drain-tiny", config=tiny, max_len=16)
+    texts = [f"alpha doc{i} bravo token{i % 5}" for i in range(24)]
+    queries = [texts[3], texts[17], "token3 alpha"]
+
+    backend = mesh_backend.activate(MeshSpec.parse("dp=4,tp=2"))
+    try:
+        impl = _FusedKnnIndexImpl(enc, "cos", 64)
+        impl.add_many(range(24), texts, [None] * 24)
+        impl.drain()
+        before = impl.search_many(queries, [3] * 3, [None] * 3)
+
+        assert backend.drain_replica(2, "rolling restart")
+        after = impl.search_many(queries, [3] * 3, [None] * 3)
+        # ranking-exact: same keys, same order, same scores
+        assert [[k for k, _ in r] for r in after] == [
+            [k for k, _ in r] for r in before
+        ]
+        for ra, rb in zip(after, before):
+            for (_, sa), (_, sb) in zip(ra, rb):
+                assert abs(sa - sb) < 1e-6
+
+        # serve-read accounting skipped the drained replica
+        st = backend.status()
+        assert st["serve_batches"] >= 1
+        assert st["serve_reads"][2] < max(st["serve_reads"])
+        assert backend.readmit_replica(2)
+    finally:
+        mesh_backend.deactivate()
+
+
+# -- /status & metrics surfaces -----------------------------------------------
+
+
+def test_serving_status_shapes():
+    serving.shutdown()
+    st = serving.serving_status()
+    assert st == {"enabled": True, "active": False}
+    tier = serving.tier()
+    st = serving.serving_status()
+    assert st["active"] is True
+    for key in (
+        "batch_window_ms", "max_batch", "batches", "batch_occupancy_p50",
+        "batch_occupancy_p99", "cache", "admission", "partitioner",
+    ):
+        assert key in st
+    assert serving.serving_metrics() is tier.metrics
+    rendered = tier.metrics.render()
+    assert "pathway_serving_batches_total" in rendered
+    assert "pathway_serving_shed_total" in rendered
+
+
+def test_status_json_carries_serving_key():
+    from pathway_tpu.internals.monitoring import PrometheusServer
+
+    serving.tier()
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(x=int), [(1,), (2,)]
+    )
+    (cap,) = run_tables(docs.select(y=pw.this.x + 1))
+    payload = PrometheusServer(cap.engine).status_json()
+    assert payload["serving"]["enabled"] is True
+    assert payload["serving"]["active"] is True
