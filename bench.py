@@ -715,6 +715,9 @@ def _fallback_payload(err: str, device_status: dict) -> dict:
         **_multichip_facts(),
         **_degraded_facts(),
         **_memory_facts(),
+        # the sentinel still reports (verdict "skipped" — a fallback
+        # round has no headline value to judge), never null
+        **_regression_facts(None),
     }
 
 
@@ -796,8 +799,7 @@ def _run_device_round(device_status: dict) -> None:
     ingest_runs = [round(N_DOCS / f["ingest_s"], 1) for f in runs]
     rtt = _rtt_floor_ms()
 
-    print(
-        json.dumps(
+    payload = (
             {
                 "metric": METRIC,
                 "value": round(docs_per_sec, 1),
@@ -878,8 +880,11 @@ def _run_device_round(device_status: dict) -> None:
                 **_degraded_facts(),
                 **_memory_facts(),
             }
-        )
     )
+    # the sentinel judges THIS round's numbers against the checked-in
+    # BENCH_r* series before the artifact is even written
+    payload.update(_regression_facts(payload))
+    print(json.dumps(payload))
 
 
 def _generation_facts() -> dict:
@@ -1067,6 +1072,41 @@ def _memory_facts() -> dict:
                 "predicted_vs_measured": 0.0,
                 "predicted_vs_measured_source": "error",
                 "error": f"{type(exc).__name__}: {exc}",
+            }
+        }
+
+
+def _regression_facts(current: "dict | None") -> dict:
+    """The `regression` section: benchmarks/bench_compare.py's verdict
+    on this round vs the trailing baseline of checked-in BENCH_r*.json
+    rounds.  When `current` is a healthy payload it is judged as the
+    newest round; a fallback round (current=None, or value=None) keeps
+    the sentinel's skip verdict instead.  Same never-null rule as the
+    headline value: always a dict with `verdict` and `worst` keys."""
+    try:
+        from benchmarks import bench_compare
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        rounds = bench_compare.load_rounds(here)
+        if current is not None and bench_compare.is_healthy(current):
+            rounds = rounds + [("current", current)]
+        result = bench_compare.compare_series(rounds)
+        return {
+            "regression": {
+                "verdict": result.get("verdict"),
+                "latest": result.get("latest"),
+                "baseline_rounds": result.get("baseline_rounds", []),
+                "failed": result.get("failed", []),
+                "worst": result.get("worst"),
+                "line": bench_compare.verdict_line(result),
+            }
+        }
+    except Exception as exc:  # noqa: BLE001 — never sink the main bench
+        return {
+            "regression": {
+                "verdict": "skipped",
+                "reason": f"{type(exc).__name__}: {exc}",
+                "worst": None,
             }
         }
 

@@ -24,6 +24,7 @@ from pathway_tpu.analysis.diagnostics import (
     make_diag,
 )
 from pathway_tpu.analysis.capacity import capacity_pass, verify_capacity
+from pathway_tpu.analysis.cost import cost_pass
 from pathway_tpu.analysis.fusion import FusionChain, FusionPlan, plan_fusion
 from pathway_tpu.analysis.graph import GraphView
 from pathway_tpu.analysis.mesh import MeshSpec
@@ -96,6 +97,7 @@ def analyze(
     mesh_pass(view, result, mesh=mesh, workers=workers)
     capacity_pass(view, result, mesh=mesh, workers=workers)
     serving_pass(view, result, slo=slo)
+    cost_pass(view, result)
     return result
 
 
@@ -112,6 +114,7 @@ __all__ = [
     "Severity",
     "analyze",
     "capacity_pass",
+    "cost_pass",
     "make_diag",
     "plan_fusion",
     "serving_pass",

@@ -74,6 +74,9 @@ CODES: Dict[str, tuple] = {
     # PWT7xx — serving tier (internals/serving.py)
     "PWT701": (Severity.WARNING, "serving enabled over a non-batchable index"),
     "PWT702": (Severity.WARNING, "serving batch window exceeds the SLO target"),
+    # PWT8xx — cost attribution (internals/costledger.py)
+    "PWT801": (Severity.WARNING, "tenant rate limits armed without query tracing"),
+    "PWT802": (Severity.INFO, "cost ledger without a device-capacity entry"),
 }
 
 # JSON schema version for analyze --json payloads and the golden matrix.

@@ -89,6 +89,12 @@ def _status(args) -> int:
     return main_status(args)
 
 
+def _top(args) -> int:
+    from pathway_tpu.internals.trace_tool import main_top
+
+    return main_top(args)
+
+
 def _profile(args) -> int:
     from pathway_tpu.internals.trace_tool import main_profile
 
@@ -177,6 +183,40 @@ def main(argv=None) -> int:
         "--json", action="store_true", help="raw JSON output"
     )
     status.set_defaults(func=_status)
+
+    top = sub.add_parser(
+        "top",
+        help="live cost dashboard for a running job: top tenants/routes "
+        "by device share, bound-state, HBM headroom, SLO burn "
+        "(1 Hz redraw from /status; curses-free)",
+    )
+    top.add_argument(
+        "--url", default=None, help="full /status URL (overrides --port)"
+    )
+    top.add_argument(
+        "--port",
+        type=int,
+        default=20000,
+        help="local monitoring port (default: worker 0's 20000)",
+    )
+    top.add_argument(
+        "--interval",
+        type=float,
+        default=1.0,
+        help="seconds between redraws (default 1.0)",
+    )
+    top.add_argument(
+        "--iterations",
+        type=int,
+        default=0,
+        help="stop after N frames (0 = run until interrupted)",
+    )
+    top.add_argument(
+        "--once",
+        action="store_true",
+        help="print a single frame without clearing the screen and exit",
+    )
+    top.set_defaults(func=_top)
 
     profile = sub.add_parser(
         "profile",

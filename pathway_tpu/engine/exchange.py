@@ -44,6 +44,8 @@ import threading
 import time as time_mod
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from pathway_tpu.internals import costledger as _costledger
+
 _LEN = struct.Struct("!I")
 
 logger = logging.getLogger("pathway_tpu.exchange")
@@ -418,6 +420,8 @@ class TcpCoordinator(Coordinator):
     def _send_on(self, sock: socket.socket, msg: Any) -> None:
         frame = self._encode_frame(msg)
         self._m_bytes_sent.inc(len(frame))
+        if _costledger.ENABLED:
+            _costledger.charge("ingest", bytes_moved=float(len(frame)))
         sock.sendall(frame)
 
     def _mark_peer_dead(self, peer: int) -> None:
@@ -431,6 +435,8 @@ class TcpCoordinator(Coordinator):
         it inline when writers are disabled. Send failures mark the peer
         dead; callers surface that via _check_dead / collect / agree."""
         self._m_bytes_sent.inc(len(frame))
+        if _costledger.ENABLED:
+            _costledger.charge("ingest", bytes_moved=float(len(frame)))
         writer = self._writers.get(dest)
         if writer is not None:
             writer.send(frame)
@@ -477,6 +483,10 @@ class TcpCoordinator(Coordinator):
                 if blob is None:
                     break
                 self._m_bytes_recv.inc(_LEN.size + length)
+                if _costledger.ENABLED:
+                    _costledger.charge(
+                        "ingest", bytes_moved=float(_LEN.size + length)
+                    )
                 if peer is None and (not blob or blob[0] != MSG_HELLO):
                     # refuse to even decode value payloads (incl. the
                     # pickle escape) from a connection that has not
@@ -617,6 +627,8 @@ class TcpCoordinator(Coordinator):
         frame = self._encode_frame(msg)
         for peer, sock in self._out.items():
             self._m_bytes_sent.inc(len(frame))
+            if _costledger.ENABLED:
+                _costledger.charge("ingest", bytes_moved=float(len(frame)))
             try:
                 with self._out_locks[peer]:
                     sock.sendall(frame)

@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, List, Optional, Set
 from pathway_tpu.engine.engine import Engine, Node
 from pathway_tpu.engine.operators import _DiffCache
 from pathway_tpu.engine.value import ERROR, Error, Pointer
+from pathway_tpu.internals import costledger as _costledger
 from pathway_tpu.internals import qtrace as _qtrace
 from pathway_tpu.internals import serving as _serving
 
@@ -264,25 +265,34 @@ class ExternalIndexNode(Node):
         self.emit(time, out)
 
     def _timed_search(self, q_keys, values, ks, filters) -> List[List[tuple]]:
-        """search_many wrapped with query-span marks: stamp search_start
-        for every traced query in the batch, charge the batch's device
-        wall time back to them after.  One attribute read + one dict
-        truthiness check when nothing is traced."""
-        if not (_qtrace.ENABLED and _qtrace.tracker()._pending_keys):
-            return self._search_many(values, ks, filters)
+        """search_many wrapped with query-span marks and cost
+        attribution: stamp search_start for every traced query in the
+        batch, then charge the batch's device wall time back — qtrace
+        charges every traced query the FULL batch time (latency), the
+        cost ledger splits it evenly across the batch's queries by
+        (route, tenant) so cells sum to real device time.  Two attribute
+        reads + one dict truthiness check when both layers are off."""
+        traced = _qtrace.ENABLED and bool(_qtrace.tracker()._pending_keys)
+        if not traced and not _costledger.ENABLED:
+            return self._search_many(values, ks, filters, q_keys=q_keys)
         import time as time_mod
 
-        tq = _qtrace.tracker()
-        tq.mark_keys(q_keys, "search_start")
+        tq = _qtrace.tracker() if traced else None
+        if tq is not None:
+            tq.mark_keys(q_keys, "search_start")
         t0 = time_mod.perf_counter()
         # search results materialize as host lists, so this wall time
         # includes the device round trip (async *ingest* pipelines only
         # defer add_many, never search)
-        results = self._search_many(values, ks, filters)
-        tq.note_device_keys(q_keys, time_mod.perf_counter() - t0)
+        results = self._search_many(values, ks, filters, q_keys=q_keys)
+        elapsed = time_mod.perf_counter() - t0
+        if tq is not None:
+            tq.note_device_keys(q_keys, elapsed)
+        if _costledger.ENABLED:
+            _costledger.charge_search(q_keys, elapsed, tracer=tq)
         return results
 
-    def _search_many(self, values, ks, filters) -> List[List[tuple]]:
+    def _search_many(self, values, ks, filters, q_keys=None) -> List[List[tuple]]:
         """search_many behind the serving result cache when a serving
         tier is live and the backend opts in (`supports_result_cache` —
         set only by impls whose EVERY mutation flows through the
@@ -299,6 +309,7 @@ class ExternalIndexNode(Node):
                 filters,
                 self.index.search_many,
                 index_id=id(self.index),
+                q_keys=q_keys,
             )
         return self.index.search_many(values, ks, filters)
 

@@ -103,6 +103,14 @@ def device_peak_flops(name: Optional[str] = None) -> float:
     return _lookup(DEVICE_PEAK_BF16_FLOPS, name)
 
 
+def device_capacity_known(name: Optional[str] = None) -> bool:
+    """Whether the chip table has a peak-FLOPs entry for `name` (default:
+    the attached chip).  False on CPU CI and unrecognized devices — the
+    cost ledger's efficiency gauges then report None, which analyzer
+    PWT802 surfaces so the gap is a finding instead of a silent hole."""
+    return device_peak_flops(name) > 0.0
+
+
 def device_hbm_bytes_per_sec(name: Optional[str] = None) -> float:
     """HBM bytes/s of `name` (default: the attached chip); 0.0 unknown."""
     return _lookup(DEVICE_HBM_BYTES_PER_SEC, name)

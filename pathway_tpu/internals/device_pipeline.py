@@ -361,6 +361,18 @@ class DevicePipeline:
             # ingest dispatches competing with the serving path show up
             # in slow-query exemplars as concurrent device pressure
             qtrace.tracker().note_device_window(device_s, source="ingest")
+        from pathway_tpu.internals import costledger
+
+        if costledger.ENABLED:
+            # same device_s the utilization window gets, so the ledger's
+            # ingest cells and the window total stay conserved
+            costledger.charge(
+                "ingest",
+                device_s=device_s,
+                flops=float(meta.get("useful_flops", 0.0)),
+                bytes_moved=float(meta.get("slab_bytes", 0)),
+                docs=int(meta.get("rows", 0)),
+            )
         if utilization.ENABLED:
             utilization.tracker().note_span("device", device_s)
             if self.replicas > 1:

@@ -86,6 +86,18 @@ COMPONENTS = (
 )
 TIERS = ("hbm", "host")
 
+# component -> cost-ledger workload: how HBM-resident bytes attribute in
+# the (workload, route, tenant) accounting (internals/costledger.py).
+# Index, encoder weights, and in-flight ingest slabs all exist to ingest
+# and serve the corpus (charged to ingest, the pipeline that grows
+# them); snapshot staging is maintenance.
+COMPONENT_WORKLOADS = {
+    "knn_index": "ingest",
+    "encoder_params": "ingest",
+    "pipeline_inflight": "ingest",
+    "snapshot_staging": "maintenance",
+}
+
 # Flight events from this module (headroom warnings) — merged into
 # /status dumps next to the mesh backend's recorder.
 RECORDER = FlightRecorder(capacity=128)

@@ -421,6 +421,16 @@ def active_backend() -> Optional[MeshBackend]:
     return _BACKEND
 
 
+def device_count() -> int:
+    """Devices the active mesh spans (dp x tp), or 1 without a mesh —
+    the cost ledger multiplies attributed device-seconds by this to get
+    chip-seconds of capacity (internals/costledger.py)."""
+    backend = _BACKEND
+    if backend is None:
+        return 1
+    return max(1, backend.dp * backend.tp)
+
+
 def mesh_status(engine=None) -> Optional[Dict[str, Any]]:
     """The `"mesh"` key for /status: live backend status when active,
     the (lint-only) spec dict when the engine was built with one, else

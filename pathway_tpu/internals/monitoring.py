@@ -341,6 +341,20 @@ class StatsMonitor:
                     if ss.get("partitioner", {}).get("priority"):
                         row += " PRIORITY"
                     table.add_row("serving", row)
+            # cost ledger (internals/costledger.py): who is spending the
+            # device, one line per workload with attributed seconds
+            from pathway_tpu.internals import costledger
+
+            if costledger.ENABLED:
+                cs = costledger.cost_status()
+                shares = cs.get("shares", {}).get("shares") or {}
+                parts = [
+                    f"{w}={share:.0%}"
+                    for w, share in sorted(shares.items())
+                    if share is not None and share > 0
+                ]
+                if parts:
+                    table.add_row("device share", " ".join(parts))
             # critical-path attribution for the latest sampled epoch
             tr = getattr(m, "trace", None)
             cp = tr.critical_path() if tr is not None else None
@@ -485,6 +499,12 @@ class PrometheusServer:
         from pathway_tpu.internals.serving import serving_metrics
 
         add(serving_metrics())
+        # cost ledger (internals/costledger.py): attributed
+        # device-seconds/FLOPs/bytes by (workload, route, tenant) plus
+        # derived efficiency gauges
+        from pathway_tpu.internals.costledger import cost_metrics
+
+        add(cost_metrics())
         return regs
 
     def metrics_text(self) -> str:
@@ -553,6 +573,7 @@ class PrometheusServer:
             }
             for idx, n in enumerate(e0.nodes)
         ]
+        from pathway_tpu.internals.costledger import cost_status
         from pathway_tpu.internals.device_pipeline import pipeline_status
         from pathway_tpu.internals.device_probe import device_status
         from pathway_tpu.internals.health import health_status
@@ -601,6 +622,11 @@ class PrometheusServer:
             # p50/p99, result-cache hit rate, admission sheds + tenant
             # limiter states, device-time partitioner verdict
             "serving": serving_status(),
+            # cost ledger (internals/costledger.py): per-(workload,
+            # route, tenant) device-seconds/FLOPs/bytes, workload device
+            # shares, conservation cross-check, cache savings — the view
+            # `pathway-tpu top` renders
+            "cost": cost_status(),
             # findings from pw.run(analysis=...): deployed graphs report
             # their own lint state (None when analysis was off)
             "analysis": getattr(e0, "analysis", None),

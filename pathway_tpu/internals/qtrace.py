@@ -130,8 +130,12 @@ class QueryTracer:
             1, int(_env_float("PATHWAY_QTRACE_SAMPLE", 1))
         )
         self._seq = 0
+        # "cache" is an extra reporting stage (not in the mark chain):
+        # result-cache hits book their search_start->device_end wall
+        # there with ZERO device charge, so cached and uncached latency
+        # distributions stay separable
         self.stage_digests: Dict[str, Any] = {
-            s: Digest() for s in STAGES
+            s: Digest() for s in STAGES + ("cache",)
         }
         self.total_digest = Digest()
         self.completed = 0
@@ -212,10 +216,20 @@ class QueryTracer:
         )
 
     # -- span lifecycle ----------------------------------------------------
-    def begin(self, qid: str, *, route: str = "", key: Any = None) -> bool:
+    def begin(
+        self,
+        qid: str,
+        *,
+        route: str = "",
+        key: Any = None,
+        tenant: str = "",
+    ) -> bool:
         """Open a span at HTTP ingress.  Returns False when this query
         falls outside the sampling stride (callers then skip the
-        remaining hooks for free — absent qids no-op everywhere)."""
+        remaining hooks for free — absent qids no-op everywhere).
+        `tenant` is the admission controller's resolved X-Tenant — it
+        rides the span into exemplars, per-stage digests, and the cost
+        ledger's batched-dispatch attribution."""
         self._seq += 1
         if self._seq % self.sample_every:
             return False
@@ -226,6 +240,7 @@ class QueryTracer:
             rec = {
                 "qid": qid,
                 "route": route,
+                "tenant": tenant,
                 "marks": {"ingress": now},
                 "meta": {},
                 "key": key,
@@ -290,6 +305,44 @@ class QueryTracer:
                 rec = self._pending.get(qid)
                 if rec is not None:
                     rec["meta"].update(meta)
+
+    def attribution_for_keys(self, keys) -> Dict[Any, tuple]:
+        """(route, tenant) per traced engine key — the cost ledger's
+        attribution source when it splits a batched dispatch across the
+        queries that rode in it.  Untraced keys are simply absent (the
+        ledger charges them to the ("", "") bucket)."""
+        pk = self._pending_keys
+        out: Dict[Any, tuple] = {}
+        if not pk:
+            return out
+        for k in keys:
+            qid = pk.get(k)
+            if qid is None:
+                continue
+            rec = self._pending.get(qid)
+            if rec is not None:
+                out[k] = (rec.get("route", ""), rec.get("tenant", ""))
+        return out
+
+    def note_cache_hits(self, keys) -> List[str]:
+        """Mark traced queries as result-cache hits: their span books the
+        search_start->device_end wall under the distinct "cache" stage
+        with ZERO device charge (the dispatch never happened for them).
+        Returns the tenants of the traced hits so the ledger's
+        cache-savings gauge attributes them."""
+        pk = self._pending_keys
+        tenants: List[str] = []
+        if not pk:
+            return tenants
+        for k in keys:
+            qid = pk.get(k)
+            if qid is None:
+                continue
+            rec = self._pending.get(qid)
+            if rec is not None:
+                rec["meta"]["cache_hit"] = True
+                tenants.append(rec.get("tenant", ""))
+        return tenants
 
     def note_device_keys(
         self,
@@ -415,6 +468,13 @@ class QueryTracer:
                 t = last
             stages[stage] = t - last
             last = t
+        if rec["meta"].get("cache_hit"):
+            # result-cache hit: the search_start->device_end wall is
+            # cache-lookup time, not device time — book it under the
+            # distinct "cache" stage and drop "device" entirely (a zero
+            # observation would pollute the uncached device distribution)
+            stages["cache"] = stages.pop("device")
+            return stages
         device_s = rec["meta"].get("device_s")
         if device_s is not None and device_s > stages["device"]:
             stages["device"] = float(device_s)
@@ -630,9 +690,9 @@ class QueryTracer:
                 {
                     k: e.get(k)
                     for k in (
-                        "qid", "route", "total_ms", "slowest_stage",
-                        "stages_ms", "replica", "threshold_ms", "wall",
-                        "device_busy_s_30s",
+                        "qid", "route", "tenant", "total_ms",
+                        "slowest_stage", "stages_ms", "replica",
+                        "threshold_ms", "wall", "device_busy_s_30s",
                     )
                 }
                 for e in list(self.exemplars)[-8:]

@@ -220,6 +220,12 @@ def run(
                 cfg.process_id * max(1, cfg.threads)
             )
 
+    # Instantiate the cost ledger at dataflow start so a served job
+    # always exports the pathway_cost_* families (internals/costledger.py)
+    from pathway_tpu.internals import costledger as _costledger
+
+    _costledger.on_run_start()
+
     # Arm the chaos harness once per run, before any worker starts
     # (per-worker arming would race and reset fire-once budgets).
     faults.install_from_env()

@@ -106,6 +106,14 @@ def pack_queries() -> bool:
     return os.environ.get("PATHWAY_SERVE_PACK_QUERIES", "0") != "0"
 
 
+def tenant_rate() -> float:
+    """The armed per-tenant admission rate (PATHWAY_SERVE_TENANT_RATE,
+    tokens/s); 0.0 means tenant limits are off.  Read at build time by
+    analyzer PWT801 (limits armed while query tracing is off means shed
+    decisions are unattributable)."""
+    return max(0.0, _env_float("PATHWAY_SERVE_TENANT_RATE", 0.0))
+
+
 # Result-key cluster count for remove-precision invalidation.  A removed
 # key invalidates only cached entries whose results shared its cluster.
 N_CLUSTERS = 256
@@ -117,6 +125,14 @@ PRIORITY_SCALE = _env_float("PATHWAY_SERVE_PRIORITY_SCALE", 0.5)
 # Burn-rate hysteresis: engage priority at >= ON, release at < OFF.
 BURN_ON = _env_float("PATHWAY_SERVE_BURN_ON", 1.0)
 BURN_OFF = _env_float("PATHWAY_SERVE_BURN_OFF", 0.5)
+
+# Serving's target share of attributed device time while the SLO burns.
+# With the cost ledger live the partitioner steers to this share instead
+# of the binary engage/release heuristic: priority engages only while
+# serving actually holds LESS device time than the target, and releases
+# as soon as it reaches it — burn caused by something other than device
+# contention (e.g. host-bound tokenize) no longer starves ingest.
+SERVE_SHARE_TARGET = _env_float("PATHWAY_SERVE_SHARE_TARGET", 0.5)
 
 # Partitioner tick pacing (wall clock).
 _PARTITION_TICK_S = 0.25
@@ -423,7 +439,10 @@ class DeviceTimePartitioner:
     """Arbitrates device time between ingest dispatches and serving
     batches: SLO burn engages priority (ingest pipelines' in-flight
     windows shrink to PRIORITY_SCALE of their ceilings), idle/cleared
-    burn releases it (ingest reclaims the slots)."""
+    burn releases it (ingest reclaims the slots).  When the cost ledger
+    is live its per-workload device share refines the decision — engage
+    only while serving holds less than SERVE_SHARE_TARGET of attributed
+    device time, release once it reaches it."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -431,6 +450,7 @@ class DeviceTimePartitioner:
         self.priority = False
         self.shifts = 0
         self.reason: Optional[str] = None
+        self.serve_share: Optional[float] = None
 
     def maybe_tick(self) -> None:
         now = time_mod.monotonic()
@@ -440,7 +460,7 @@ class DeviceTimePartitioner:
             if now < self._next_tick:
                 return
             self._next_tick = now + _PARTITION_TICK_S
-        from pathway_tpu.internals import qtrace, utilization
+        from pathway_tpu.internals import costledger, qtrace, utilization
 
         burn = None
         if qtrace.ENABLED:
@@ -450,17 +470,32 @@ class DeviceTimePartitioner:
             if utilization.ENABLED
             else "idle"
         )
+        # Serving's attributed device share; None when the ledger is off
+        # or the window is empty — then the binary burn heuristic below
+        # is the whole decision, exactly the pre-ledger behavior.
+        share = costledger.serve_device_share()
+        self.serve_share = share
         if not self.priority:
             if burn is not None and burn >= BURN_ON:
+                if share is not None and share >= SERVE_SHARE_TARGET:
+                    return  # burning, but serving already holds its share
                 self._engage(
-                    f"slo burn {burn:.2f} >= {BURN_ON:g} "
-                    f"[{bound_state}]"
+                    f"slo burn {burn:.2f} >= {BURN_ON:g}, serve share "
+                    f"{'n/a' if share is None else f'{share:.2f}'} < "
+                    f"{SERVE_SHARE_TARGET:g} [{bound_state}]"
                 )
         else:
-            if burn is None or burn < BURN_OFF or bound_state == "idle":
+            if (
+                burn is None
+                or burn < BURN_OFF
+                or bound_state == "idle"
+                or (share is not None and share >= SERVE_SHARE_TARGET)
+            ):
                 self._release(
                     f"burn {burn if burn is not None else 0:.2f} < "
-                    f"{BURN_OFF:g} or idle [{bound_state}]"
+                    f"{BURN_OFF:g}, share "
+                    f"{'n/a' if share is None else f'{share:.2f}'}, "
+                    f"or idle [{bound_state}]"
                 )
 
     def _engage(self, reason: str) -> None:
@@ -500,6 +535,8 @@ class DeviceTimePartitioner:
             "priority_scale": PRIORITY_SCALE,
             "shifts": self.shifts,
             "reason": self.reason,
+            "serve_share": self.serve_share,
+            "share_target": SERVE_SHARE_TARGET,
         }
 
 
@@ -617,16 +654,22 @@ class ServingTier:
         filters: List[Any],
         search_fn: Callable[[List[Any], List[Any], List[Any]], List[list]],
         index_id: int = 0,
+        q_keys: Optional[List[Any]] = None,
     ) -> List[list]:
         """search_many wrapped with the result cache: serve hits from the
         generation-checked cache, search only the misses, fill on the way
-        out.  Order-preserving."""
+        out.  Order-preserving.  Hits are reported to qtrace (the span
+        books its wall under a distinct ``cache`` stage with zero device
+        charge, keeping cached latency out of the device digest) and to
+        the cost ledger (per-tenant cache-savings — computed from the
+        live uncached-query cost, not inferred from the hit rate)."""
         cache = self.cache
         if cache.capacity <= 0:
             return search_fn(values, ks, filters)
         results: List[Any] = [None] * len(values)
         cache_keys: List[Any] = [None] * len(values)
         miss: List[int] = []
+        hit_idx: List[int] = []
         for i, (v, k, f) in enumerate(zip(values, ks, filters)):
             ck = cache.make_key(index_id, v, k, f)
             if ck is None:
@@ -638,6 +681,9 @@ class ServingTier:
                 miss.append(i)
             else:
                 results[i] = hit
+                hit_idx.append(i)
+        if hit_idx and q_keys is not None:
+            self._note_cache_hits([q_keys[i] for i in hit_idx])
         if miss:
             searched = search_fn(
                 [values[i] for i in miss],
@@ -649,6 +695,19 @@ class ServingTier:
                 if cache_keys[i] is not None:
                     cache.put(cache_keys[i], res)
         return results
+
+    @staticmethod
+    def _note_cache_hits(keys: List[Any]) -> None:
+        from pathway_tpu.internals import costledger, qtrace
+
+        tenants: List[str] = []
+        if qtrace.ENABLED:
+            tenants = qtrace.tracker().note_cache_hits(keys)
+        if costledger.ENABLED:
+            # untraced hits land in the "" tenant bucket — still counted
+            costledger.note_cache_hits(
+                tenants + [""] * (len(keys) - len(tenants))
+            )
 
     # -- lifecycle / status ------------------------------------------------
 

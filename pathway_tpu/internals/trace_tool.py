@@ -1,4 +1,4 @@
-"""`pathway-tpu trace` and `pathway-tpu status` implementations.
+"""`pathway-tpu trace`, `status`, and `top` implementations.
 
 `trace` runs a user script with epoch tracing forced on (every epoch by
 default), bounds the run with a termination watchdog, then serialises
@@ -315,6 +315,145 @@ def main_status(args) -> int:
     else:
         print(render_status(status))
     return 0
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "?"
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TB"
+
+
+def render_top(status: dict) -> str:
+    """One frame of `pathway-tpu top`: who is spending the device RIGHT
+    NOW, from the /status JSON alone (no in-process state) — headline
+    (bound-state, MFU, SLO burn, HBM headroom), per-workload device
+    shares over the ledger's rolling window, and the heaviest
+    (workload, route, tenant) attribution cells."""
+    cost = status.get("cost") or {}
+    util = status.get("utilization") or {}
+    queries = status.get("queries") or {}
+    memory = status.get("memory") or {}
+
+    head = [f"workers={status.get('worker_count')}"]
+    if cost.get("devices"):
+        head.append(f"devices={cost['devices']}")
+    if util.get("enabled"):
+        head.append(f"bound={util.get('bound_state', '?')}")
+        mfu = util.get("mfu_pct")
+        if mfu is not None:
+            head.append(f"mfu={mfu:.1f}%")
+    slo = queries.get("slo") or {}
+    if slo.get("target_p99_ms") is not None:
+        burn = slo.get("burn_rate")
+        head.append(
+            f"slo_burn={burn}" + (" BURNING" if slo.get("burning") else "")
+        )
+    if memory.get("enabled", True) and memory.get("headroom_pct") is not None:
+        head.append(
+            f"hbm_headroom={memory['headroom_pct']:.1f}% "
+            f"({_fmt_bytes(memory.get('hbm_headroom_bytes'))})"
+        )
+    lines = ["pathway-tpu top — " + " ".join(head)]
+
+    if not cost.get("enabled"):
+        lines.append("cost ledger disabled (PATHWAY_COSTLEDGER=0)")
+        return "\n".join(lines)
+    if not cost.get("active"):
+        lines.append("cost ledger idle — no dataflow charged yet")
+        return "\n".join(lines)
+
+    shares = cost.get("shares") or {}
+    per = shares.get("shares") or {}
+    seconds = shares.get("seconds") or {}
+    parts = [
+        f"{w}={per[w]:.0%} ({seconds.get(w, 0):.3f}s)"
+        for w in sorted(per)
+        if per[w] is not None
+    ]
+    if parts:
+        lines.append(
+            f"device share [{shares.get('window_s')}s window]: "
+            + "  ".join(parts)
+        )
+    cons = cost.get("conservation") or {}
+    if cons.get("ratio") is not None:
+        lines.append(
+            f"conservation: attributed={cons.get('attributed_s')}s "
+            f"window={cons.get('utilization_window_s'):.6f}s "
+            f"ratio={cons['ratio']}"
+        )
+    eff = cost.get("efficiency_pct")
+    if eff is not None:
+        lines.append(f"attributed efficiency: {eff}% of peak")
+    elif not cost.get("device_capacity_known", True):
+        lines.append(
+            "attributed efficiency: n/a (device peak unknown — PWT802)"
+        )
+
+    top = cost.get("top") or []
+    if top:
+        lines.append(
+            f"{'WORKLOAD':<12}{'ROUTE':<18}{'TENANT':<14}"
+            f"{'DEV_S':>10}{'SHARE':>7}{'QUERIES':>9}{'DOCS':>8}"
+            f"{'BYTES':>10}"
+        )
+        total_s = sum(c.get("device_s", 0.0) for c in top) or None
+        for cell in top:
+            dev_s = cell.get("device_s", 0.0)
+            share = f"{dev_s / total_s:.0%}" if total_s else "-"
+            lines.append(
+                f"{cell.get('workload', ''):<12}"
+                f"{(cell.get('route') or '-'):<18}"
+                f"{(cell.get('tenant') or '-'):<14}"
+                f"{dev_s:>10.4f}{share:>7}"
+                f"{cell.get('queries', 0):>9}{cell.get('docs', 0):>8}"
+                f"{_fmt_bytes(cell.get('bytes')):>10}"
+            )
+    savings = cost.get("cache_savings") or {}
+    for tenant, s in sorted(savings.items()):
+        lines.append(
+            f"cache savings [{tenant or '-'}]: {s.get('hits')} hits, "
+            f"{s.get('saved_device_s')}s device time saved"
+        )
+    return "\n".join(lines)
+
+
+def main_top(args) -> int:
+    """Entry point for the cli.py `top` subcommand: a curses-free live
+    dashboard — fetch /status, render one frame, ANSI clear-screen and
+    redraw every ``--interval`` seconds (default 1 Hz).  ``--iterations``
+    bounds the loop (0 = until interrupted); ``--once`` prints a single
+    frame with no screen clearing (scripts, tests)."""
+    import time as time_mod
+
+    url = args.url or f"http://127.0.0.1:{args.port}/status"
+    iterations = 1 if args.once else args.iterations
+    n = 0
+    try:
+        while True:
+            try:
+                status = fetch_status(url)
+            except Exception as exc:  # noqa: BLE001 — connection refused etc.
+                print(f"error: could not fetch {url}: {exc}", file=sys.stderr)
+                return 1
+            frame = render_top(status)
+            if args.once:
+                print(frame)
+            else:
+                # ANSI clear + home: live redraw without curses
+                sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+                sys.stdout.flush()
+            n += 1
+            if iterations and n >= iterations:
+                return 0
+            time_mod.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def main_restart(args) -> int:

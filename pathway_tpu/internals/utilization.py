@@ -219,6 +219,18 @@ def current_bound_state() -> str:
     return classify_bound_state(window, prep, dispatch, wait, dispatches)
 
 
+def device_window_seconds() -> float:
+    """Total noted device-busy seconds over the rolling window — the
+    denominator of the cost ledger's conservation cross-check
+    (internals/costledger.py): attributed device-seconds must sum to
+    within 5% of this."""
+    t = _TRACKER
+    now = time.monotonic()
+    with t._lock:
+        t._prune(now)
+        return sum(d for _, d in t._spans["device"])
+
+
 def reset_window(window_s: float = WINDOW_S) -> UtilizationTracker:
     """Replace the process tracker with a fresh (empty) window — used by
     tests and by bench.py to scope the live-MFU cross-check to exactly

@@ -239,12 +239,15 @@ class _RestSubject(ConnectorSubjectBase):
             # sheds (no validation, no engine row, no device work)
             tier = _serving.tier() if _serving.ENABLED else None
             admitted = False
+            # resolved once, whether or not a tier admits: the tenant
+            # rides the qtrace span into batched dispatch so exemplars,
+            # digests, and the cost ledger can attribute per tenant
+            tenant = (
+                request.headers.get("X-Tenant", "default")
+                if request is not None
+                else "default"
+            )
             if tier is not None:
-                tenant = (
-                    request.headers.get("X-Tenant", "default")
-                    if request is not None
-                    else "default"
-                )
                 verdict = tier.admission.admit(tenant)
                 if verdict is not None:
                     retry_after, reason = verdict
@@ -263,7 +266,7 @@ class _RestSubject(ConnectorSubjectBase):
                 key = ref_scalar("rest", self.route, next(_request_ids))
                 if _qtrace.ENABLED:
                     _qtrace.tracker().begin(
-                        str(key), route=self.route, key=key
+                        str(key), route=self.route, key=key, tenant=tenant
                     )
                 row = {}
                 for name in names:

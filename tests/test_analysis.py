@@ -226,7 +226,7 @@ def test_matrix_covers_enough_codes():
         "PWT402", "PWT403", "PWT404", "PWT405",
         "PWT501", "PWT502", "PWT503", "PWT504",
         "PWT601", "PWT602", "PWT603", "PWT605",
-        "PWT701",
+        "PWT701", "PWT802",
     } <= codes, codes
 
 
@@ -418,6 +418,64 @@ def test_serving_pass_gated_off(monkeypatch):
     codes = {f.code for f in analyze(G, workers=1, slo=1.0).findings}
     assert not {"PWT701", "PWT702"} & codes
     del keep
+
+
+# ---------------------------------------------------------------------------
+# cost pass (PWT8xx)
+# ---------------------------------------------------------------------------
+
+
+def test_pwt801_tenant_limits_without_tracing(monkeypatch):
+    from pathway_tpu.internals import qtrace
+
+    keep = _serving_indexed_graph(encoder=None)
+    monkeypatch.setenv("PATHWAY_SERVE_TENANT_RATE", "5")
+    monkeypatch.setattr(qtrace, "ENABLED", False)
+    fs = [f for f in analyze(G, workers=1).findings if f.code == "PWT801"]
+    assert len(fs) == 1
+    assert "X-Tenant" in fs[0].message
+    assert fs[0].details["tenant_rate_per_s"] == 5.0
+    # tracing back on: the tenant rides the span, nothing to lint
+    monkeypatch.setattr(qtrace, "ENABLED", True)
+    codes = {f.code for f in analyze(G, workers=1).findings}
+    assert "PWT801" not in codes
+    # limits off: nothing to attribute against
+    monkeypatch.setattr(qtrace, "ENABLED", False)
+    monkeypatch.delenv("PATHWAY_SERVE_TENANT_RATE")
+    codes = {f.code for f in analyze(G, workers=1).findings}
+    assert "PWT801" not in codes
+    del keep
+
+
+def test_pwt802_ledger_without_capacity_entry(monkeypatch):
+    from pathway_tpu.internals import costledger, costmodel
+
+    keep = _serving_indexed_graph(encoder=None)
+    # CPU CI: no chip-table entry -> efficiency gauges will be None
+    assert not costmodel.device_capacity_known()
+    fs = [f for f in analyze(G, workers=1).findings if f.code == "PWT802"]
+    assert len(fs) == 1
+    assert "pathway_cost_efficiency_pct" in fs[0].message
+    # a known chip is silent
+    monkeypatch.setattr(costmodel, "_cached_name", "TPU v5e")
+    codes = {f.code for f in analyze(G, workers=1).findings}
+    assert "PWT802" not in codes
+    # ledger disabled: the efficiency gap is moot
+    monkeypatch.setattr(costmodel, "_cached_name", "unknown")
+    monkeypatch.setattr(costledger, "ENABLED", False)
+    codes = {f.code for f in analyze(G, workers=1).findings}
+    assert "PWT802" not in codes
+    del keep
+
+
+def test_cost_pass_needs_an_index():
+    # no anchored external index: no serve workload, nothing to lint
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str), [("a",)]
+    )
+    _sink(t)
+    codes = {f.code for f in analyze(G, workers=1).findings}
+    assert not {"PWT801", "PWT802"} & codes
 
 
 # ---------------------------------------------------------------------------

@@ -51,6 +51,39 @@ OBS_TCP_SCRIPT = """
 """
 
 
+COST_TCP_SCRIPT = """
+    import os
+    import sys
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_markdown
+    from pathway_tpu.internals import costledger
+    from pathway_tpu.internals.monitoring import PrometheusServer
+    from pathway_tpu.internals.runner import last_engine
+
+    out_dir = sys.argv[1]
+    wid = int(os.environ["PATHWAY_PROCESS_ID"])
+    t = table_from_markdown(
+        '''
+        k | v
+        0 | 1
+        1 | 2
+        '''
+    )
+    pw.io.fs.write(t, out_dir + "/out.jsonl", format="json")
+    pw.run(monitoring_level=None)
+    # charge every family on every worker; the tenant value is escaping
+    # bait (quote, backslash, newline)
+    tenant = 'acme "prod"\\\\team\\n1'
+    led = costledger.ledger()
+    led.charge("ingest", device_s=0.25, flops=5e9, bytes_moved=2048, docs=7)
+    led.charge("serve", "/search", tenant, device_s=0.05, queries=3)
+    costledger.charge_search([11, 12], 0.1, tracer=None)
+    costledger.note_cache_hits([tenant])
+    with open(out_dir + f"/metrics_{wid}.txt", "w") as f:
+        f.write(PrometheusServer(last_engine()).metrics_text())
+"""
+
+
 def _run(tmp_path):
     run_workers(OBS_TCP_SCRIPT, 2, tmp_path)
     diags = [
@@ -110,3 +143,53 @@ def test_tcp_workers_observability(tmp_path):
         for d in diags
     ]
     assert epochs[0] == epochs[1], epochs
+
+
+def test_tcp_workers_cost_exposition(tmp_path):
+    """Every pathway_cost_* family survives the strict exposition checks
+    on both worker processes, with hostile tenant label values (quote,
+    backslash, newline) escaped per spec."""
+    from pathway_tpu.internals import costledger
+    from pathway_tpu.internals.metrics import escape_label_value
+
+    if not costledger.ENABLED:
+        import pytest
+
+        pytest.skip("cost ledger disabled")
+    run_workers(COST_TCP_SCRIPT, 2, tmp_path)
+    tenant = 'acme "prod"\\team\n1'
+    escaped = escape_label_value(tenant)
+    for wid in range(2):
+        text = (tmp_path / f"metrics_{wid}.txt").read_text()
+        samples = check_exposition(text)
+        for family in (
+            "pathway_cost_device_seconds_total",
+            "pathway_cost_flops_total",
+            "pathway_cost_bytes_total",
+            "pathway_cost_device_seconds_per_1k_queries",
+            "pathway_cost_cache_saved_device_seconds_total",
+        ):
+            assert family in samples, (wid, family)
+        # process-wide families export under worker 0, like the
+        # utilization/memtrack gauges they join
+        cells = samples["pathway_cost_device_seconds_total"]
+        assert {labels["worker"] for labels, _ in cells} == {"0"}
+        by_key = {
+            (labels["workload"], labels["route"], labels["tenant"]): value
+            for labels, value in cells
+        }
+        assert by_key[("ingest", "", "")] == 0.25
+        # the bait tenant round-trips in escaped form
+        assert by_key[("serve", "/search", escaped)] == 0.05
+        assert by_key[("serve", "", "")] == 0.1
+        savings = {
+            labels["tenant"]: value
+            for labels, value in samples[
+                "pathway_cost_cache_saved_device_seconds_total"
+            ]
+        }
+        assert escaped in savings and savings[escaped] > 0
+        # CPU CI: device peak unknown -> efficiency series absent
+        # (None is skipped), never 0 — the PWT802 contract
+        assert "pathway_cost_efficiency_pct" not in samples
+        assert "pathway_cost_flops_per_doc" in samples

@@ -1246,3 +1246,98 @@ def test_serving_disabled_is_single_attribute_read():
         timeout=120, env=env,
     )
     assert proc.returncode == 0, proc.stderr
+
+
+@pytest.mark.perf_smoke
+def test_costledger_armed_idle_overhead_under_5pct():
+    """The cost ledger armed on an otherwise idle job — instantiated,
+    exporting families, zero queries in flight — must cost under 5% on
+    the device-pipeline microbench.  Each completion runs the real
+    ingest hook (one module-attr read when disabled; one per-dispatch
+    charge() under the ledger lock when armed).  Same paired min-of-N
+    protocol as the serving guard."""
+    import gc
+    from time import perf_counter
+
+    from pathway_tpu.internals import costledger
+    from pathway_tpu.internals.device_pipeline import DevicePipeline
+
+    BATCHES, REPS = 200, 9
+    meta = {
+        "rows": 4, "real_tokens": 64, "slab_tokens": 64,
+        "slab_bytes": 256, "useful_flops": 1.0e6,
+    }
+
+    def run_once(armed: bool) -> float:
+        saved = costledger.ENABLED
+        costledger.ENABLED = armed
+        costledger.reset_for_tests()
+        if armed:
+            costledger.ledger()
+        pipe = DevicePipeline(
+            lambda item: (item, dict(meta)),
+            dispatch=lambda payload: payload,
+            wait=lambda handle: None,
+            name="cost-smoke",
+            max_in_flight=2,
+        )
+        try:
+            t0 = perf_counter()
+            for i in range(BATCHES):
+                pipe.submit(i)
+            pipe.drain()
+            return perf_counter() - t0
+        finally:
+            pipe.close()
+            costledger.ENABLED = saved
+            costledger.reset_for_tests()
+
+    run_once(True), run_once(False)  # warmup (thread spin-up, imports)
+    ratios = []
+    gc.collect()
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        for _ in range(REPS):
+            ratios.append(run_once(True) / run_once(False))
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+    ratio = min(ratios)
+    assert ratio < 1.05, (
+        f"cost ledger armed-idle overhead {ratio:.3f}x (pair ratios "
+        f"{[round(r, 3) for r in ratios]})"
+    )
+
+
+@pytest.mark.perf_smoke
+def test_costledger_disabled_is_single_attribute_read():
+    """PATHWAY_COSTLEDGER=0: importing the module must not instantiate
+    the ledger or pull in jax; every hook guard is the module attribute
+    and no status/metrics call materializes the singleton."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import sys;"
+        "from pathway_tpu.internals import costledger;"
+        "assert costledger.ENABLED is False;"
+        "assert costledger._LEDGER is None;"
+        "costledger.charge('ingest', device_s=1.0, docs=4);"
+        "costledger.charge_search([1, 2], 0.5);"
+        "costledger.note_cache_hits(['acme']);"
+        "costledger.on_run_start();"
+        "assert costledger.serve_device_share() is None;"
+        "assert costledger.cost_metrics() is None;"
+        "assert costledger.cost_status() == {'enabled': False};"
+        "assert costledger._LEDGER is None, 'hooks instantiated it';"
+        "assert 'jax' not in sys.modules, 'costledger pulled in jax'"
+    )
+    env = dict(os.environ)
+    env["PATHWAY_COSTLEDGER"] = "0"
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr

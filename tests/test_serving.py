@@ -496,11 +496,15 @@ def test_cache_generation_bumps_ride_knn_mutations():
 
 
 def test_partitioner_engages_and_releases_priority():
-    from pathway_tpu.internals import device_pipeline, qtrace
+    from pathway_tpu.internals import costledger, device_pipeline, qtrace
 
     if not qtrace.ENABLED:
         pytest.skip("qtrace disabled")
     tier = serving.reset_for_tests()
+    # empty ledger window -> share None -> the binary burn heuristic is
+    # the whole decision (the share-refined path is covered in
+    # tests/test_costledger.py)
+    costledger.reset_for_tests()
     part = tier.partitioner
     qtrace.reset()
     tq = qtrace.tracker()
