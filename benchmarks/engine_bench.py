@@ -903,6 +903,13 @@ def bench_failover(kill_epoch=12, n_rows=80):
 if __name__ == "__main__":
     import sys as _sys
 
+    if "--sanitize" in _sys.argv:
+        # arm the runtime sanitizer for every benchmark below — the
+        # armed-vs-off delta on these numbers IS the sanitizer's cost
+        from pathway_tpu.internals import sanitizer as _sanitizer
+
+        _sanitizer.install()
+
     if "--multiworker" in _sys.argv:
         bench_wordcount_multiworker()
     elif "--tick-overhead" in _sys.argv:

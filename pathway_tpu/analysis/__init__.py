@@ -17,6 +17,7 @@ from typing import Any, Iterable, Optional
 
 from pathway_tpu.analysis.diagnostics import (
     CODES,
+    FAMILIES,
     SCHEMA_VERSION,
     AnalysisResult,
     Diagnostic,
@@ -28,6 +29,11 @@ from pathway_tpu.analysis.cost import cost_pass
 from pathway_tpu.analysis.fusion import FusionChain, FusionPlan, plan_fusion
 from pathway_tpu.analysis.graph import GraphView
 from pathway_tpu.analysis.mesh import MeshSpec
+from pathway_tpu.analysis.purity import (
+    classify_callable,
+    purity_pass,
+    verify_purity,
+)
 from pathway_tpu.analysis.serving import serving_pass
 from pathway_tpu.analysis.passes import (
     columnar_pass,
@@ -92,6 +98,7 @@ def analyze(
     columnar_pass(view, result, workers=workers)
     dead_pass(view, result)
     udf_pass(view, result, workers=workers)
+    purity_pass(view, result, workers=workers)
     embedder_pass(view, result, workers=workers)
     fusion_pass(view, result)
     mesh_pass(view, result, mesh=mesh, workers=workers)
@@ -106,6 +113,7 @@ __all__ = [
     "AnalysisResult",
     "CODES",
     "Diagnostic",
+    "FAMILIES",
     "FusionChain",
     "FusionPlan",
     "GraphView",
@@ -114,11 +122,14 @@ __all__ = [
     "Severity",
     "analyze",
     "capacity_pass",
+    "classify_callable",
     "cost_pass",
     "make_diag",
     "plan_fusion",
+    "purity_pass",
     "serving_pass",
     "verify_against_plan",
     "verify_capacity",
     "verify_fusion",
+    "verify_purity",
 ]

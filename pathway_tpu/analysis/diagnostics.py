@@ -77,13 +77,35 @@ CODES: Dict[str, tuple] = {
     # PWT8xx — cost attribution (internals/costledger.py)
     "PWT801": (Severity.WARNING, "tenant rate limits armed without query tracing"),
     "PWT802": (Severity.INFO, "cost ledger without a device-capacity entry"),
+    # PWT9xx — determinism & replay safety (analysis/purity.py)
+    "PWT901": (Severity.WARNING, "UDF reads a nondeterminism source"),
+    "PWT902": (Severity.WARNING, "unordered set/dict iteration feeds UDF output"),
+    "PWT903": (Severity.WARNING, "replay-unsafe side effect in UDF"),
+    "PWT904": (Severity.WARNING, "UDF closure captures unpicklable state"),
+    "PWT905": (Severity.WARNING, "UDF mutates its input rows"),
+    "PWT999": (Severity.ERROR, "determinism contract disagrees with purity analysis"),
+}
+
+# PWT family prefix -> (family name, owning pass) — the `analyze
+# --list-codes` table and the doc-sync guard derive from this instead of
+# hand-maintained doc tables.
+FAMILIES: Dict[str, tuple] = {
+    "PWT1": ("correctness", "dtype_pass / dead_pass"),
+    "PWT2": ("state growth", "state_pass"),
+    "PWT3": ("performance", "columnar_pass / udf_pass / verify_against_plan"),
+    "PWT4": ("mesh compatibility", "mesh_pass / embedder_pass"),
+    "PWT5": ("fusion planning", "fusion_pass / verify_fusion"),
+    "PWT6": ("capacity planning", "capacity_pass / verify_capacity"),
+    "PWT7": ("serving", "serving_pass"),
+    "PWT8": ("cost attribution", "cost_pass"),
+    "PWT9": ("determinism", "purity_pass / verify_purity"),
 }
 
 # JSON schema version for analyze --json payloads and the golden matrix.
 # Bump when the payload shape changes (v2: schema_version stamp itself,
 # deterministic finding order, the "fusion" plan section; v3: the
-# "capacity" plan section).
-SCHEMA_VERSION = 3
+# "capacity" plan section; v4: the "purity" verdict section).
+SCHEMA_VERSION = 4
 
 
 def _trace_to_dict(trace: Any) -> Optional[Dict[str, Any]]:
@@ -195,6 +217,10 @@ class AnalysisResult:
     # capacity-plan section (analysis/capacity.py): predicted per-index /
     # per-device byte breakdown; None when the graph has no external index
     capacity: Optional[Dict[str, Any]] = None
+    # purity-verdict section (analysis/purity.py): callable name ->
+    # {"verdict": "deterministic"|"impure"|"unknown", "codes": [...]};
+    # None when the graph has no UDF call sites
+    purity: Optional[Dict[str, Any]] = None
 
     @property
     def fusion(self) -> Optional[Dict[str, Any]]:
@@ -233,6 +259,9 @@ class AnalysisResult:
             "capacity": (
                 dict(self.capacity) if self.capacity is not None else None
             ),
+            "purity": (
+                dict(self.purity) if self.purity is not None else None
+            ),
             "summary": self.counts(),
         }
 
@@ -240,11 +269,13 @@ class AnalysisResult:
     def from_dict(cls, d: Dict[str, Any]) -> "AnalysisResult":
         fusion = d.get("fusion")
         capacity = d.get("capacity")
+        purity = d.get("purity")
         return cls(
             findings=[Diagnostic.from_dict(f) for f in d.get("findings", [])],
             predictions=[dict(p) for p in d.get("predictions", [])],
             _fusion=dict(fusion) if fusion is not None else None,
             capacity=dict(capacity) if capacity is not None else None,
+            purity=dict(purity) if purity is not None else None,
         )
 
     def render_text(self) -> str:

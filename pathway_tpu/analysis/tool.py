@@ -48,8 +48,59 @@ def analyze_script(path: str, *, mesh=None) -> AnalysisResult:
     return analyze(G, mesh=mesh)
 
 
+def list_codes(*, as_json: bool = False) -> str:
+    """`analyze --list-codes`: render the diagnostics registry — every
+    PWT code with its default severity, title and owning pass family —
+    from diagnostics.CODES/FAMILIES, so docs and users never
+    hand-maintain the table."""
+    from pathway_tpu.analysis.diagnostics import CODES, FAMILIES
+
+    def family_of(code: str):
+        return FAMILIES.get(code[:4], ("", ""))
+
+    if as_json:
+        payload = {
+            "codes": [
+                {
+                    "code": code,
+                    "severity": str(sev),
+                    "title": title,
+                    "family": family_of(code)[0],
+                    "pass": family_of(code)[1],
+                }
+                for code, (sev, title) in sorted(CODES.items())
+            ],
+            "families": {
+                prefix: {"family": fam, "pass": owner}
+                for prefix, (fam, owner) in sorted(FAMILIES.items())
+            },
+        }
+        return json.dumps(payload, indent=2, sort_keys=True)
+    lines: List[str] = []
+    last_prefix = None
+    for code, (sev, title) in sorted(CODES.items()):
+        prefix = code[:4]
+        if prefix != last_prefix:
+            fam, owner = family_of(code)
+            lines.append(f"{prefix}xx — {fam} ({owner})")
+            last_prefix = prefix
+        lines.append(f"  {code}  {str(sev):7s}  {title}")
+    lines.append(f"{len(CODES)} registered code(s)")
+    return "\n".join(lines)
+
+
 def main_analyze(args) -> int:
     """Entry point for the cli.py `analyze` subcommand."""
+    if getattr(args, "list_codes", False):
+        print(list_codes(as_json=bool(args.json)))
+        return 0
+    if not getattr(args, "script", None):
+        print(
+            "error: a script argument is required unless --list-codes "
+            "is given",
+            file=sys.stderr,
+        )
+        return 2
     mesh = getattr(args, "mesh", None)
     if mesh is not None:
         from pathway_tpu.analysis.mesh import MeshSpec

@@ -116,9 +116,20 @@ def main(argv=None) -> int:
         help="statically analyze a script's dataflow graph without "
         "running it",
     )
-    analyze.add_argument("script", help="python script that builds a graph")
+    analyze.add_argument(
+        "script",
+        nargs="?",
+        default=None,
+        help="python script that builds a graph",
+    )
     analyze.add_argument(
         "--json", action="store_true", help="machine-readable output"
+    )
+    analyze.add_argument(
+        "--list-codes",
+        action="store_true",
+        help="list every registered PWT diagnostic code (with severity, "
+        "title and owning pass) instead of analyzing a script",
     )
     analyze.add_argument(
         "--fail-on",

@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 from pathway_tpu.engine.stream import Delta, TableState, consolidate
 from pathway_tpu.engine.value import ERROR, Error, Pointer
 from pathway_tpu.internals import qtrace as _qtrace
+from pathway_tpu.internals import sanitizer as _sanitizer
 
 
 class EngineError(Exception):
@@ -314,6 +315,10 @@ class Engine:
                 hook()
         self._scheduled_times.clear()
         self.current_time = 0
+        if _sanitizer.ACTIVE:
+            # the time rewind that follows is a sanctioned rollback, not
+            # a frontier-monotonicity violation
+            _sanitizer.tracker().on_rollback(self)
 
     def schedule_time(self, time: int) -> None:
         if time > self.current_time:
@@ -388,6 +393,8 @@ class Engine:
 
     # -- driving ----------------------------------------------------------
     def process_time(self, time: int) -> None:
+        if _sanitizer.ACTIVE:
+            _sanitizer.tracker().on_tick(self, time)
         self.current_time = time
         self._scheduled_times.discard(time)
         m = self.metrics

@@ -45,6 +45,7 @@ import time as time_mod
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from pathway_tpu.internals import costledger as _costledger
+from pathway_tpu.internals import sanitizer as _sanitizer
 
 _LEN = struct.Struct("!I")
 
@@ -1585,6 +1586,10 @@ def _make_exchange_node():
             stamp = tr is not None and tr.in_epoch(time)
             own = self._scatter(deltas, coord, time, stamp)
             received = coord.collect(self.channel, time)
+            if _sanitizer.ACTIVE:
+                # routing invariant (key.shard % n == me) + per-channel
+                # frontier monotonicity; raises SanitizerError on breach
+                _sanitizer.tracker().on_exchange(self, time, received)
             # stamps are drained UNCONDITIONALLY so the coordinator's
             # stamp buffers stay bounded even if a peer's sampling env
             # diverges; they arrive before collect() returns because they

@@ -23,6 +23,7 @@ from pathway_tpu.internals.device_pipeline import (
     pipeline_enabled as _pipeline_enabled,
 )
 from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals import sanitizer as _sanitizer
 from pathway_tpu.internals.expression import (
     ApplyExpression,
     BinaryOpExpression,
@@ -608,6 +609,24 @@ def _compile_apply(expr: ApplyExpression, ctx: EvalContext) -> BatchProgram:
                 logger.error_logger(_udf_error_message(exc))
                 out[i] = ERROR
         return out
+
+    if _sanitizer.ACTIVE:
+        # arming happens in runner.run before node build, so every apply
+        # program of a sanitized run compiles through here.  The wrapper
+        # re-checks the hashing flag at call time: it only turns on when
+        # operator snapshots are configured (nothing replays otherwise).
+        udf_name = getattr(fun, "__qualname__", None) or getattr(
+            fun, "__name__", repr(fun)
+        )
+
+        def run_apply_sanitized(keys, rows):
+            out = run_apply(keys, rows)
+            t = _sanitizer.tracker()
+            if t.hashing:
+                t.note_udf_batch(udf_name, keys, out)
+            return out
+
+        return run_apply_sanitized
 
     return run_apply
 

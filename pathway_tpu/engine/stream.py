@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Any, Iterable, List, Tuple
 
 from pathway_tpu.engine.value import Pointer, values_equal
+from pathway_tpu.internals import sanitizer as _sanitizer
 
 # (key, values-tuple, diff)
 Delta = Tuple[Pointer, tuple, int]
@@ -145,6 +146,8 @@ class TableState:
         self._next = 0
 
     def apply(self, deltas: Iterable[Delta], *, source: str = "") -> None:
+        if _sanitizer.ACTIVE:
+            _sanitizer.tracker().note_multiset()
         if self.multiset:
             self._apply_multiset(deltas, source)
             return
@@ -154,6 +157,8 @@ class TableState:
         for key, values, diff in deltas:
             if diff == -1:
                 if pop(key, _ABSENT) is _ABSENT:
+                    if _sanitizer.ACTIVE:
+                        _sanitizer.tracker().multiset_violation(source, key)
                     raise KeyError(
                         f"{source}: retraction of absent key {key!r}"
                     )
@@ -168,6 +173,10 @@ class TableState:
             elif diff < 0:
                 for _ in range(-diff):
                     if pop(key, _ABSENT) is _ABSENT:
+                        if _sanitizer.ACTIVE:
+                            _sanitizer.tracker().multiset_violation(
+                                source, key
+                            )
                         raise KeyError(
                             f"{source}: retraction of absent key {key!r}"
                         )
@@ -200,6 +209,10 @@ class TableState:
                             sids.remove(sid)
                             break
                     else:
+                        if _sanitizer.ACTIVE:
+                            _sanitizer.tracker().multiset_violation(
+                                source, key
+                            )
                         raise KeyError(
                             f"{source}: retraction of absent row {key!r}"
                         )

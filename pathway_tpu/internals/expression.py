@@ -583,21 +583,39 @@ def map_refs(expr: ColumnExpression, fn):
     return out
 
 
-def collect_tables(expr: ColumnExpression, out: set) -> set:
-    """All concrete tables referenced by an expression tree."""
+def collect_tables_ordered(expr: ColumnExpression) -> list:
+    """All concrete tables referenced by an expression tree, in
+    deterministic discovery order.  Use this variant wherever the
+    result feeds recorded op inputs or build operands: iterating the
+    set variant below hands back id-hash order, which varies between
+    otherwise identical runs and would break byte-identical builds."""
+    from pathway_tpu.internals.table import Table
+
+    out: list = []
+    seen: set = set()
+
+    def _add(t):
+        if id(t) not in seen:
+            seen.add(id(t))
+            out.append(t)
+
     stack = [expr]
     while stack:
         node = stack.pop()
         if isinstance(node, ColumnReference):
-            out.add(node._table)
+            _add(node._table)
         if isinstance(node, PointerExpression) and node._table is not None:
-            from pathway_tpu.internals.table import Table
-
             if isinstance(node._table, Table):
-                out.add(node._table)
+                _add(node._table)
         stack.extend(node._deps())
         for attr in ("_left", "_right", "_arg", "_expr", "_if", "_then", "_else"):
             child = getattr(node, attr, None)
             if isinstance(child, ColumnExpression):
                 stack.append(child)
+    return out
+
+
+def collect_tables(expr: ColumnExpression, out: set) -> set:
+    """All concrete tables referenced by an expression tree."""
+    out.update(collect_tables_ordered(expr))
     return out

@@ -505,6 +505,11 @@ class PrometheusServer:
         from pathway_tpu.internals.costledger import cost_metrics
 
         add(cost_metrics())
+        # consistency sanitizer (internals/sanitizer.py): invariant
+        # checks performed / violations detected, by check kind
+        from pathway_tpu.internals.sanitizer import sanitizer_metrics
+
+        add(sanitizer_metrics())
         return regs
 
     def metrics_text(self) -> str:
@@ -580,6 +585,7 @@ class PrometheusServer:
         from pathway_tpu.internals.memtrack import memory_status
         from pathway_tpu.internals.mesh_backend import mesh_status
         from pathway_tpu.internals.qtrace import qtrace_status
+        from pathway_tpu.internals.sanitizer import sanitizer_status
         from pathway_tpu.internals.serving import serving_status
         from pathway_tpu.internals.tracing import merged_critical_path
         from pathway_tpu.internals.utilization import utilization_status
@@ -633,6 +639,9 @@ class PrometheusServer:
             # fusion contract: planned chains vs built fused nodes with
             # per-chain op counts (None when fusion was disabled)
             "fusion": fusion_status(e0),
+            # consistency sanitizer (internals/sanitizer.py): invariant
+            # check/violation counters, recent violations, certified UDFs
+            "sanitizer": sanitizer_status(),
         }
 
     def _merged_freshness(self) -> list:

@@ -146,12 +146,14 @@ def _apply_analysis(
         verify_against_plan,
         verify_capacity,
         verify_fusion,
+        verify_purity,
     )
 
     result = analyze(G, workers=engine.worker_count, mesh=mesh, slo=slo)
     verify_against_plan(engine, result)
     verify_fusion(engine, result)
     verify_capacity(engine, result)
+    verify_purity(engine, result)
     baseline_info = None
     if baseline:
         from pathway_tpu.analysis.baseline import apply_baseline
@@ -229,6 +231,13 @@ def run(
     # Arm the chaos harness once per run, before any worker starts
     # (per-worker arming would race and reset fire-once budgets).
     faults.install_from_env()
+
+    # Arm the consistency sanitizer before the graph builds: UDF apply
+    # programs compile with the replay-hash wrapper only when the
+    # sanitizer is already ACTIVE at compile time.
+    from pathway_tpu.internals import sanitizer as _sanitizer
+
+    _sanitizer.install_from_env()
 
     # Reset the health controller's transient per-run state (drained
     # replicas, held backpressure) so one run's degradations never leak

@@ -25,6 +25,7 @@ from pathway_tpu.internals.expression import (
     PointerExpression,
     ReducerExpression,
     collect_tables,
+    collect_tables_ordered,
     smart_wrap,
 )
 from pathway_tpu.internals.parse_graph import G
@@ -189,10 +190,15 @@ class Table:
         build = _rowwise_build(self, cols)
         from pathway_tpu.internals.parse_graph import record_op
 
-        foreign: set = set()
+        # discovery order, not set order: the recorded inputs tuple must
+        # be identical between identical runs (byte-identical builds)
+        foreign: List[Table] = []
+        f_seen = {id(self)}
         for e in cols.values():
-            collect_tables(e, foreign)
-        foreign.discard(self)
+            for t in collect_tables_ordered(e):
+                if id(t) not in f_seen:
+                    f_seen.add(id(t))
+                    foreign.append(t)
         return record_op(
             Table(schema=schema, universe=self._universe, build=build),
             "select",
@@ -216,7 +222,7 @@ class Table:
         2
         """
         expr = desugar(filter_expression, self._mapping())
-        foreign = [t for t in collect_tables(expr, set()) if t is not self]
+        foreign = [t for t in collect_tables_ordered(expr) if t is not self]
         if foreign:
             for other in foreign:
                 if not solver.query_are_equal(
@@ -1783,7 +1789,7 @@ def _ordered_tables(primary: Table, exprs: Iterable[ColumnExpression]) -> List[T
     tables = [primary]
     seen = {id(primary)}
     for e in exprs:
-        for t in collect_tables(e, set()):
+        for t in collect_tables_ordered(e):
             if id(t) not in seen:
                 tables.append(t)
                 seen.add(id(t))

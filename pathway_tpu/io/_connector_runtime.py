@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from pathway_tpu.engine.value import Pointer, ref_scalar
 from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import sanitizer as _sanitizer
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.schema import Schema
 from pathway_tpu.internals.table import Table
@@ -586,6 +587,10 @@ class StreamingDriver:
                 self.engine.worker_id,
             )
             snap_interval = snap_ms / 1000.0
+            if _sanitizer.ACTIVE:
+                # replay-divergence hashing only means something when
+                # operator snapshots exist to replay against
+                _sanitizer.tracker().enable_replay_hashing()
 
         def restore_states():
             """Load + apply the newest commonly-restorable operator
@@ -616,6 +621,11 @@ class StreamingDriver:
                 agreed = local_time
             if agreed >= 0:
                 op_mgr.apply_states(self.engine, states)
+                if _sanitizer.ACTIVE:
+                    # rewind this thread's UDF hash accumulators to the
+                    # manifest baseline; whatever was accumulated beyond
+                    # it (the pre-crash tail) becomes the replay target
+                    _sanitizer.tracker().on_restore(manifest)
                 return agreed
             return None
 

@@ -19,6 +19,7 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from pathway_tpu.internals import faults, memtrack
+from pathway_tpu.internals import sanitizer as _sanitizer
 
 
 def _store_fault(key: str) -> None:
@@ -45,6 +46,33 @@ def graph_fingerprint(engine) -> List[Tuple[int, str, str, int]]:
         (idx, type(node).__name__, getattr(node, "name", ""), len(node.inputs))
         for idx, node in enumerate(engine.nodes)
     ]
+
+
+def _unpicklable_path(obj: Any, prefix: str = "state", depth: int = 4) -> Optional[str]:
+    """Best-effort dotted path to the first unpicklable leaf inside a
+    node's snapshot state, so the skip diagnostics say WHICH attribute
+    disabled the snapshot (`state['accum'].lock`), not just which node.
+    Returns None when `obj` pickles fine."""
+    try:
+        pickle.dumps(obj)
+        return None
+    except Exception:  # noqa: BLE001 — any pickle failure counts
+        pass
+    if depth > 0:
+        if isinstance(obj, dict):
+            items = [(f"{prefix}[{k!r}]", v) for k, v in obj.items()]
+        elif isinstance(obj, (list, tuple)):
+            items = [(f"{prefix}[{i}]", v) for i, v in enumerate(obj)]
+        else:
+            d = getattr(obj, "__dict__", None)
+            items = (
+                [(f"{prefix}.{k}", v) for k, v in d.items()] if d else []
+            )
+        for path, v in items:
+            found = _unpicklable_path(v, path, depth - 1)
+            if found is not None:
+                return found
+    return prefix
 
 
 class PersistenceBackend:
@@ -437,17 +465,30 @@ class OperatorSnapshotManager:
                 # skip only this node: the manifest records it so restore
                 # refuses the partial snapshot and full-replays instead
                 skipped.append(idx)
+                path = _unpicklable_path(state) or "state"
                 warn_once = getattr(engine, "warn_once", None)
                 msg = (
                     "operator snapshot skips node %d (%s): state does not "
-                    "pickle: %s"
+                    "pickle at %s: %s"
                 )
                 if warn_once is not None:
                     warn_once(f"snapshot-unpicklable-{idx}", msg, idx,
-                              node.name, exc)
+                              node.name, path, exc)
                 else:
                     logging.getLogger("pathway_tpu").warning(
-                        msg, idx, node.name, exc
+                        msg, idx, node.name, path, exc
+                    )
+                # structured twin of the warn-once: a flight event naming
+                # the offending attribute path (the static PWT904 finding
+                # points at the same capture before the run ever starts)
+                m = getattr(engine, "metrics", None)
+                if m is not None:
+                    m.recorder.record(
+                        "snapshot_skip",
+                        time=time,
+                        node=idx,
+                        name=f"{node.name}: unpicklable at {path}",
+                        errors=1,
                     )
 
         if memtrack.ENABLED:
@@ -521,6 +562,14 @@ class OperatorSnapshotManager:
                     "state_nodes": [idx for idx, _ in states],
                     "skipped_nodes": skipped,
                     "folded_through": folded_through,
+                    # replay-divergence baselines (PATHWAY_SANITIZE):
+                    # per-UDF [rows, hash] as of this snapshot's frontier
+                    "udf_hashes": (
+                        _sanitizer.tracker().hashes_for_manifest()
+                        if _sanitizer.ACTIVE
+                        and _sanitizer.tracker().hashing
+                        else None
+                    ),
                 }
             ),
         )

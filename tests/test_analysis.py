@@ -11,6 +11,8 @@ Regenerate after an intentional message change with:
 
 import json
 import os
+import threading
+import time
 
 import pytest
 
@@ -161,10 +163,59 @@ def build_lintful_graph():
                  "mlp_dim": 1536, "max_len": 512},
     )
 
+    # PWT901 + PWT999: reads the clock while *declaring* determinism —
+    # the static half of the sanitizer's parity contract
+    @pw.udf(deterministic=True)
+    def clock_liar(x: int) -> float:
+        return x + time.time()
+
+    nondet_udf = t.select(name=t.name, c=clock_liar(t.age))
+
+    # PWT902: set iteration order leaks into the output string
+    def scrambled(s: str) -> str:
+        return "".join(set(s))
+
+    unordered = t.select(
+        name=t.name, u=pw.apply_with_type(scrambled, str, t.name)
+    )
+
+    # PWT903: file write from a UDF feeding a stateful reduce — failover
+    # replay re-runs it, duplicating the side effect
+    def audit_row(v: int) -> int:
+        with open("/tmp/pathway_audit.log", "a") as fh:
+            fh.write(str(v))
+        return v
+
+    audited = t.select(name=t.name, a=pw.apply_with_type(audit_row, int, t.age))
+    audited_red = audited.groupby(audited.name).reduce(
+        audited.name, s=pw.reducers.sum(audited.a)
+    )
+
+    # PWT904: stateful combiner whose closure captures an unpicklable
+    # lock — would disable the reduce node's operator snapshot
+    lock = threading.Lock()
+
+    def guarded_max(state, v):
+        with lock:
+            return max(state or 0, v)
+
+    locked_red = t.groupby(t.name).reduce(
+        t.name, m=pw.reducers.stateful_single(guarded_max)(t.age)
+    )
+
+    # PWT905: in-place mutation of an input row value — breaks
+    # FusedChainNode batch sharing
+    def mutate_row(xs) -> int:
+        xs.append(0)
+        return len(xs)
+
+    mutated = left.select(n=pw.apply_with_type(mutate_row, int, left.key))
+
     _sink(
         lossy, bad_cmp, arith, by_float, tup, joined, nd_red, au_red,
         win, it, narrow, emb, stateful, pinned_sel, fan_a, fan_b,
-        chain_red, idx_unknown, idx_sized,
+        chain_red, idx_unknown, idx_sized, nondet_udf, unordered,
+        audited_red, locked_red, mutated,
     )
     # PWT110: computed after the sinks, read by nobody.  Returned so the
     # caller keeps it alive — the parse graph tracks tables by weakref,
@@ -227,6 +278,7 @@ def test_matrix_covers_enough_codes():
         "PWT501", "PWT502", "PWT503", "PWT504",
         "PWT601", "PWT602", "PWT603", "PWT605",
         "PWT701", "PWT802",
+        "PWT901", "PWT902", "PWT903", "PWT904", "PWT905", "PWT999",
     } <= codes, codes
 
 

@@ -1,0 +1,95 @@
+"""Documentation/registry sync — tier 1.
+
+The PWT code registry (analysis/diagnostics.py CODES + FAMILIES) is the
+contract CI and users match on, and three things must not drift from
+it: the family overviews in ARCHITECTURE.md and README.md, the
+`--list-codes` surface, and the golden matrix's coverage.  Every code
+must either appear in tests/golden/analysis_matrix.json (a bait in
+tests/test_analysis.py build_lintful_graph triggers it) or sit in the
+explicit exemption list below with the reason it cannot appear there —
+and an exemption goes stale the moment the matrix does cover the code.
+"""
+
+import json
+from pathlib import Path
+
+from pathway_tpu.analysis.diagnostics import CODES, FAMILIES
+
+ROOT = Path(__file__).resolve().parent.parent
+
+# codes that cannot be produced by the static golden matrix, each with
+# the place that does exercise it
+GOLDEN_EXEMPT = {
+    # runtime parity verifiers: emitted after an engine BUILDS (or runs)
+    # and the plan disagrees with reality — the golden matrix never
+    # builds an engine; negative tests force each one
+    "PWT399": "verify_against_plan drift (test_perf_smoke parity tests)",
+    "PWT599": "verify_fusion drift (PATHWAY_FUSION_FORCE_SKIP tests)",
+    "PWT699": "verify_capacity drift (test_memtrack)",
+    # environment-dependent lints the matrix's pinned env doesn't arm
+    "PWT304": "flatten vector gate disabled (test_analysis unit tests)",
+    "PWT604": "headroom warn band sits between PWT603's trigger and "
+              "clean — covered by capacity unit tests (test_analysis)",
+    "PWT702": "needs a declared SLO target below the batch window "
+              "(test_serving / test_analysis unit tests)",
+    "PWT801": "needs PATHWAY_SERVE_TENANT_RATE armed with qtrace off "
+              "(test_costledger)",
+}
+
+
+def _golden_codes() -> set:
+    payload = json.loads(
+        (ROOT / "tests" / "golden" / "analysis_matrix.json").read_text()
+    )
+    return {f["code"] for f in payload["findings"]}
+
+
+def test_every_family_documented_in_architecture_and_readme():
+    arch = (ROOT / "ARCHITECTURE.md").read_text()
+    readme = (ROOT / "README.md").read_text()
+    for prefix, (family, owner) in sorted(FAMILIES.items()):
+        tag = f"{prefix}xx"
+        assert tag in arch, (
+            f"{tag} ({family}, {owner}) missing from ARCHITECTURE.md"
+        )
+        assert tag in readme, (
+            f"{tag} ({family}, {owner}) missing from README.md"
+        )
+
+
+def test_every_code_belongs_to_a_registered_family():
+    prefixes = tuple(FAMILIES)
+    for code in CODES:
+        assert code.startswith(prefixes), (
+            f"{code} has no family entry in FAMILIES"
+        )
+
+
+def test_every_code_in_golden_matrix_or_exemption_list():
+    covered = _golden_codes()
+    missing = sorted(set(CODES) - covered - set(GOLDEN_EXEMPT))
+    assert not missing, (
+        f"codes neither exercised by the golden matrix nor exempted: "
+        f"{missing} — add a bait to build_lintful_graph (and regen via "
+        f"python -m tests.regen_golden) or an exemption with a reason"
+    )
+
+
+def test_exemption_list_carries_no_stale_or_unknown_entries():
+    covered = _golden_codes()
+    stale = sorted(set(GOLDEN_EXEMPT) & covered)
+    assert not stale, (
+        f"exempted codes now covered by the golden matrix — prune "
+        f"them: {stale}"
+    )
+    unknown = sorted(set(GOLDEN_EXEMPT) - set(CODES))
+    assert not unknown, f"exemptions for unregistered codes: {unknown}"
+
+
+def test_list_codes_surface_matches_registry():
+    from pathway_tpu.analysis.tool import list_codes
+
+    payload = json.loads(list_codes(as_json=True))
+    listed = {entry["code"] for entry in payload["codes"]}
+    assert listed == set(CODES)
+    assert set(payload["families"]) == set(FAMILIES)

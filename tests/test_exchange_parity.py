@@ -232,3 +232,81 @@ def test_columnar_sink_output_deterministic_across_runs(tmp_path):
         assert (a / name).read_bytes() == (b / name).read_bytes(), (
             f"part {name} differs between identical runs"
         )
+
+
+# a UDF-bearing topology with a multi-table select (two foreign tables
+# joined in), exercising the expression-eval kwargs path and the ordered
+# table collection (internals/expression.py collect_tables_ordered) —
+# the surfaces where set/dict iteration order could leak into the build
+UDF_PIPELINE = textwrap.dedent(
+    """
+    import sys
+
+    import pathway_tpu as pw
+
+    out_dir, seed = sys.argv[1], int(sys.argv[2])
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, v=int),
+        [(i % 9, (i * seed) % 101) for i in range(300)],
+    )
+
+    def fmt(v: int, scale: int = 3) -> str:
+        return f"v={v * scale:06d}"
+
+    labeled = t.select(
+        t.k,
+        t.v,
+        s=pw.apply_with_type(fmt, str, t.v, scale=7),
+    )
+    big = labeled.filter(labeled.v > 20)
+    agg = big.groupby(big.k).reduce(
+        big.k,
+        n=pw.reducers.count(),
+        total=pw.reducers.sum(big.v),
+        first=pw.reducers.min(big.s),
+    )
+    pw.io.fs.write(agg, out_dir + "/udf_agg.jsonl", format="json")
+    pw.run(monitoring_level=None)
+    """
+)
+
+
+def test_udf_topology_byte_identical_across_hash_seeds(tmp_path):
+    """Two identical runs of a UDF-bearing topology under DIFFERENT
+    PYTHONHASHSEEDs must write byte-identical sink parts: neither
+    expression compilation (kwargs iteration), table collection for
+    build operands, nor the exchange may let set/dict iteration order
+    leak into output."""
+    script = tmp_path / "udf_pipeline.py"
+    script.write_text(UDF_PIPELINE)
+
+    def run(label: str, hashseed: str) -> Path:
+        out_dir = tmp_path / label
+        out_dir.mkdir()
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO,
+            PYTHONHASHSEED=hashseed,
+            PATHWAY_THREADS="2",
+        )
+        proc = subprocess.run(
+            [sys.executable, str(script), str(out_dir), str(SEED)],
+            env=env,
+            cwd=tmp_path,
+            capture_output=True,
+            timeout=180,
+        )
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        return out_dir
+
+    a = run("seed0", "0")
+    b = run("seed1", "1")
+    parts_a = sorted(p.name for p in a.iterdir())
+    parts_b = sorted(p.name for p in b.iterdir())
+    assert parts_a == parts_b and parts_a, parts_a
+    for name in parts_a:
+        assert (a / name).read_bytes() == (b / name).read_bytes(), (
+            f"part {name} differs under a different PYTHONHASHSEED"
+        )

@@ -546,6 +546,58 @@ def test_analyzer_new_passes_overhead_under_5pct():
     )
 
 
+def test_analyzer_purity_pass_overhead_under_5pct():
+    """The purity pass (PWT9xx, the analyzer's 12th pass) on the same
+    CI gate: its marginal cost over the other eleven passes must stay
+    under 5%.  Measured separately from the fusion+mesh guard above —
+    that pair already sits near its own budget, and the purity pass's
+    steady-state cost is a per-code-object cache hit (purity.py
+    _source_cache), which this guard is really pinning down."""
+    import gc
+    import json as _json
+    from time import perf_counter
+
+    import pathway_tpu.analysis as analysis_mod
+    from benchmarks.engine_bench import GRAPH_BUILDERS
+    from pathway_tpu.analysis.purity import purity_pass
+
+    REPS = 12
+
+    def _noop(*a, **k):
+        return None
+
+    def run_gate(with_purity: bool) -> float:
+        analysis_mod.purity_pass = purity_pass if with_purity else _noop
+        pw.G.clear()
+        gc.collect()
+        t0 = perf_counter()
+        tails = tuple(b() for b in GRAPH_BUILDERS.values())
+        result = analysis_mod.analyze(
+            pw.G, extra_tables=tails, workers=2, mesh="dp=2,tp=2"
+        )
+        _json.dumps(result.to_dict())
+        return perf_counter() - t0
+
+    ratios = []
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        run_gate(True)  # warmup both arms (and the purity caches)
+        run_gate(False)
+        for _ in range(REPS):
+            ratios.append(run_gate(True) / run_gate(False))
+    finally:
+        analysis_mod.purity_pass = purity_pass
+        if gc_was_enabled:
+            gc.enable()
+        pw.G.clear()
+    ratio = min(ratios)
+    assert ratio < 1.05, (
+        f"purity pass overhead {ratio:.3f}x (pair ratios "
+        f"{[round(r, 3) for r in ratios]})"
+    )
+
+
 @pytest.mark.perf_smoke
 def test_mesh_none_builds_stay_byte_identical():
     """The mesh execution backend must be FULLY dormant without a mesh:
@@ -1336,6 +1388,97 @@ def test_costledger_disabled_is_single_attribute_read():
     )
     env = dict(os.environ)
     env["PATHWAY_COSTLEDGER"] = "0"
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_sanitizer_armed_idle_overhead_under_5pct():
+    """PATHWAY_SANITIZE=1 on a healthy job: every tick pays one frontier
+    bookkeeping call and every TableState batch one counted multiset
+    check, with no violations ever recorded.  That armed-idle cost must
+    stay under 5% on the engine microbench loop — same min-of-N
+    interleaved protocol as the fault-harness guard above."""
+    import gc
+    from time import perf_counter
+
+    from pathway_tpu.engine.engine import InputQueueSource, RowwiseNode
+    from pathway_tpu.internals import sanitizer
+
+    ROWS, TICKS, REPS = 512, 40, 5
+    deltas = [(ref_scalar("k", i), (i,), 1) for i in range(ROWS)]
+
+    def ident(keys, cols):
+        return cols[0]
+
+    def run_once(armed: bool) -> float:
+        sanitizer.clear()
+        if armed:
+            sanitizer.install()
+        eng = Engine(metrics=False)
+        src = InputQueueSource(eng)
+        node = src
+        for _ in range(3):
+            node = RowwiseNode(eng, [node], ident)
+        try:
+            time = 2
+            for _ in range(8):  # warmup
+                src.push(time, deltas)
+                eng.process_time(time)
+                time += 2
+            t0 = perf_counter()
+            for _ in range(TICKS):
+                src.push(time, deltas)
+                eng.process_time(time)
+                time += 2
+            return perf_counter() - t0
+        finally:
+            eng._gc_unfreeze()
+
+    ratios = []
+    gc.collect()
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        run_once(True), run_once(False)  # warmup
+        for _ in range(REPS):
+            ratios.append(run_once(True) / run_once(False))
+    finally:
+        sanitizer.clear()
+        if gc_was_enabled:
+            gc.enable()
+    ratio = min(ratios)
+    assert ratio < 1.05, (
+        f"sanitizer armed-idle overhead {ratio:.3f}x (pair ratios "
+        f"{[round(r, 3) for r in ratios]})"
+    )
+
+
+@pytest.mark.perf_smoke
+def test_sanitizer_disabled_is_single_attribute_read():
+    """PATHWAY_SANITIZE unset/0: importing the module must not create
+    the tracker; every engine hook is gated on the ACTIVE module
+    attribute, and the status/metrics surfaces short-circuit without
+    materializing the singleton."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import sys;"
+        "from pathway_tpu.internals import sanitizer;"
+        "sanitizer.install_from_env();"
+        "assert sanitizer.ACTIVE is False;"
+        "assert sanitizer._TRACKER is None;"
+        "assert sanitizer.sanitizer_status() == {'enabled': False};"
+        "assert sanitizer.sanitizer_metrics() is None;"
+        "assert sanitizer._TRACKER is None, 'surfaces instantiated it';"
+        "assert 'jax' not in sys.modules, 'sanitizer pulled in jax'"
+    )
+    env = dict(os.environ)
+    env["PATHWAY_SANITIZE"] = "0"
     proc = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,
         timeout=120, env=env,
