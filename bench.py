@@ -681,6 +681,74 @@ def _tracing_overhead() -> float | None:
         return None
 
 
+def _provenance_overhead() -> float:
+    """Cost of the armed lineage tracker on the pure-host engine loop:
+    min-of-N A/B of provenance.install() vs clear() over the same
+    microbench as _observability_overhead.  Both arms pay the metrics
+    layer; the delta is pure edge recording + on_tick bookkeeping.
+    NEVER null (BENCH r05): returns 0.0 when the A/B cannot run."""
+    from time import perf_counter
+
+    from pathway_tpu.engine.engine import (
+        Engine,
+        InputQueueSource,
+        RowwiseNode,
+    )
+    from pathway_tpu.engine.value import ref_scalar
+    from pathway_tpu.internals import provenance
+
+    rows, ticks = 512, 40
+    deltas = [(ref_scalar("k", i), (i,), 1) for i in range(rows)]
+
+    def ident(keys, cols):
+        return cols[0]
+
+    def run_once(armed: bool) -> float:
+        if armed:
+            provenance.install()
+        else:
+            provenance.clear()
+        eng = Engine()
+        src = InputQueueSource(eng)
+        node = src
+        for _ in range(3):
+            node = RowwiseNode(eng, [node], ident)
+        try:
+            t = 2
+            for _ in range(8):  # warmup
+                src.push(t, deltas)
+                eng.process_time(t)
+                t += 2
+            t0 = perf_counter()
+            for _ in range(ticks):
+                src.push(t, deltas)
+                eng.process_time(t)
+                t += 2
+            return perf_counter() - t0
+        finally:
+            eng._gc_unfreeze()
+            provenance.clear()
+
+    try:
+        import gc
+
+        gc.collect()
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            on, off = [], []
+            for _ in range(5):
+                on.append(run_once(True))
+                off.append(run_once(False))
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+            provenance.clear()
+        return round(min(on) / min(off) - 1.0, 4)
+    except Exception:  # noqa: BLE001 — never sink the main bench
+        return 0.0
+
+
 def _fallback_payload(err: str, device_status: dict) -> dict:
     """The host-only artifact for any round where the device cannot carry
     the main number — preflight failure OR a mid-run device death.  A
@@ -710,6 +778,7 @@ def _fallback_payload(err: str, device_status: dict) -> dict:
         "exchange_throughput": exchange,
         "observability_overhead": _observability_overhead(),
         "tracing_overhead": _tracing_overhead(),
+        "provenance_overhead": _provenance_overhead(),
         "failover_recovery_s": _failover_recovery_s(),
         **_serving_facts(),
         **_multichip_facts(),
@@ -843,6 +912,7 @@ def _run_device_round(device_status: dict) -> None:
                 "exchange_throughput": _exchange_numbers(),
                 "observability_overhead": _observability_overhead(),
                 "tracing_overhead": _tracing_overhead(),
+                "provenance_overhead": _provenance_overhead(),
                 "failover_recovery_s": _failover_recovery_s(),
                 "device": _device_name(),
                 **_mfu_facts(docs_per_sec, docs),

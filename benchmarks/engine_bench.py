@@ -215,6 +215,41 @@ def bench_wordcount(n_rows=5_000_000, vocab=10_000, batch=200_000):
     return rps
 
 
+def bench_provenance(n_rows=1_000_000, vocab=10_000, batch=100_000):
+    """Armed-delta of the lineage tracker on the wordcount hot path:
+    the same graph run with the provenance tracker off, then armed
+    (PATHWAY_PROVENANCE=1 equivalent) — the rows/s ratio IS the cost of
+    recording reduce lineage + source offsets for every delta."""
+    from pathway_tpu.internals import provenance
+
+    rates = {}
+    for label, armed in (("off", False), ("armed", True)):
+        if armed:
+            provenance.install()
+        else:
+            provenance.clear()
+        try:
+            res = build_wordcount_graph(n_rows, vocab=vocab, batch=batch)
+            t0 = _time.perf_counter()
+            (capture,) = run_tables(res, record_stream=True)
+            elapsed = _time.perf_counter() - t0
+            total = sum(r[1] for r in capture.state.rows.values())
+            assert total == n_rows
+            rates[label] = n_rows / elapsed
+        finally:
+            provenance.clear()
+    delta = rates["off"] / rates["armed"] - 1.0
+    print(json.dumps({
+        "metric": "provenance_armed_delta",
+        "value": round(delta, 4),
+        "unit": "fractional slowdown, armed vs off (wordcount)",
+        "rows_per_sec_off": round(rates["off"]),
+        "rows_per_sec_armed": round(rates["armed"]),
+        "n_rows": n_rows,
+    }))
+    return delta
+
+
 def _node_seconds(log_path, node_types):
     """Sum per-node wall time from a PATHWAY_NODE_TIMING_LOG dump for
     the given node class names — isolates the operator under test from
@@ -925,6 +960,8 @@ if __name__ == "__main__":
         bench_pipeline()
     elif "--fusion" in _sys.argv:
         bench_fused_chain()
+    elif "--provenance" in _sys.argv:
+        bench_provenance()
     else:
         bench_group_update_flatness()
         bench_wordcount()

@@ -34,6 +34,7 @@ from pathway_tpu.analysis.purity import (
     purity_pass,
     verify_purity,
 )
+from pathway_tpu.analysis.provenance import provenance_pass
 from pathway_tpu.analysis.serving import serving_pass
 from pathway_tpu.analysis.passes import (
     columnar_pass,
@@ -105,6 +106,7 @@ def analyze(
     capacity_pass(view, result, mesh=mesh, workers=workers)
     serving_pass(view, result, slo=slo)
     cost_pass(view, result)
+    provenance_pass(view, result)
     return result
 
 
@@ -126,6 +128,7 @@ __all__ = [
     "cost_pass",
     "make_diag",
     "plan_fusion",
+    "provenance_pass",
     "purity_pass",
     "serving_pass",
     "verify_against_plan",

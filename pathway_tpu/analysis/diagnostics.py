@@ -84,6 +84,9 @@ CODES: Dict[str, tuple] = {
     "PWT904": (Severity.WARNING, "UDF closure captures unpicklable state"),
     "PWT905": (Severity.WARNING, "UDF mutates its input rows"),
     "PWT999": (Severity.ERROR, "determinism contract disagrees with purity analysis"),
+    # PWT10xx — provenance / lineage coverage (analysis/provenance.py)
+    "PWT1001": (Severity.WARNING, "lineage-opaque operator on an anchored path"),
+    "PWT1099": (Severity.ERROR, "explain required but graph contains an opaque node"),
 }
 
 # PWT family prefix -> (family name, owning pass) — the `analyze
@@ -99,6 +102,7 @@ FAMILIES: Dict[str, tuple] = {
     "PWT7": ("serving", "serving_pass"),
     "PWT8": ("cost attribution", "cost_pass"),
     "PWT9": ("determinism", "purity_pass / verify_purity"),
+    "PWT10": ("provenance", "provenance_pass"),
 }
 
 # JSON schema version for analyze --json payloads and the golden matrix.

@@ -56,7 +56,10 @@ def list_codes(*, as_json: bool = False) -> str:
     from pathway_tpu.analysis.diagnostics import CODES, FAMILIES
 
     def family_of(code: str):
-        return FAMILIES.get(code[:4], ("", ""))
+        # the family prefix is everything but the two code digits —
+        # "PWT101" -> "PWT1", "PWT1001" -> "PWT10" (a fixed [:4] slice
+        # would misfile the four-digit families under PWT1)
+        return FAMILIES.get(code[:-2], ("", ""))
 
     if as_json:
         payload = {
@@ -79,7 +82,7 @@ def list_codes(*, as_json: bool = False) -> str:
     lines: List[str] = []
     last_prefix = None
     for code, (sev, title) in sorted(CODES.items()):
-        prefix = code[:4]
+        prefix = code[:-2]
         if prefix != last_prefix:
             fam, owner = family_of(code)
             lines.append(f"{prefix}xx — {fam} ({owner})")

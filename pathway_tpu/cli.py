@@ -107,6 +107,12 @@ def _restart(args) -> int:
     return main_restart(args)
 
 
+def _explain(args) -> int:
+    from pathway_tpu.internals.trace_tool import main_explain
+
+    return main_explain(args)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="pathway")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -288,6 +294,33 @@ def main(argv=None) -> int:
         help="comma-separated worker ids to roll (default: all)",
     )
     restart.set_defaults(func=_restart)
+
+    explain = sub.add_parser(
+        "explain",
+        help="backward lineage of one output row of a running job: "
+        "which operators produced it, from which input offsets, and "
+        "its emit/retract history (requires PATHWAY_PROVENANCE=1)",
+    )
+    explain.add_argument(
+        "key",
+        help="output row key — full 32-hex pointer value or ^-prefixed "
+        "pointer repr",
+    )
+    explain.add_argument(
+        "--url",
+        default=None,
+        help="base monitoring URL of the running job (overrides --port)",
+    )
+    explain.add_argument(
+        "--port",
+        type=int,
+        default=20000,
+        help="local monitoring port (default: worker 0's 20000)",
+    )
+    explain.add_argument(
+        "--json", action="store_true", help="raw JSON lineage tree"
+    )
+    explain.set_defaults(func=_explain)
 
     spawn = sub.add_parser("spawn", help="run a program on multiple workers")
     spawn.add_argument("--threads", "-t", type=int, default=1)

@@ -28,6 +28,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from pathway_tpu.engine.stream import Delta, TableState, consolidate
 from pathway_tpu.engine.value import ERROR, Error, Pointer
+from pathway_tpu.internals import provenance as _provenance
 from pathway_tpu.internals import qtrace as _qtrace
 from pathway_tpu.internals import sanitizer as _sanitizer
 
@@ -320,6 +321,20 @@ class Engine:
             # a frontier-monotonicity violation
             _sanitizer.tracker().on_rollback(self)
 
+    def explain(self, key: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Backward lineage of an output row (internals/provenance.py):
+        a JSON tree from `key` down to source-connector offsets with the
+        key's emit/retract history.  `key` may be a Pointer, the raw
+        128-bit int, or the canonical 32-hex string the surfaces print.
+        Requires PATHWAY_PROVENANCE=1 (or provenance.install())."""
+        if not _provenance.ACTIVE:
+            return {
+                "key": str(key),
+                "found": False,
+                "error": "provenance disabled (set PATHWAY_PROVENANCE=1)",
+            }
+        return _provenance.tracker().explain(key, **kwargs)
+
     def schedule_time(self, time: int) -> None:
         if time > self.current_time:
             self._scheduled_times.add(time)
@@ -432,6 +447,11 @@ class Engine:
             # query spans: non-zero workers ship their marks to worker 0,
             # worker 0 absorbs whatever arrived (MSG_STAMP side-channel)
             _qtrace.tracker().on_tick(self)
+        if _provenance.ACTIVE:
+            # lineage edges: epoch accounting + memtrack refresh, and in
+            # multi-process runs the MSG_LINEAGE ship/absorb toward the
+            # worker-0 gather (internals/provenance.py)
+            _provenance.tracker().on_tick(self)
         self._gc_pulse()
 
     def _process_time_metrics(self, time: int, m) -> None:
@@ -779,6 +799,8 @@ class StaticSource(Node):
             if self.engine.coord.worker_count > 1:
                 owns = self.engine.owns_key
                 deltas = [d for d in deltas if owns(d[0])]
+            if _provenance.ACTIVE:
+                _provenance.tracker().record_source(self, time, deltas)
             self.emit_consolidated(time, deltas)
 
 
@@ -821,13 +843,14 @@ class TimedSource(Node):
     def process(self, time: int) -> None:
         deltas = self._by_time.pop(time, None)
         if deltas:
-            if self.engine.coord.worker_count == 1:
-                self.emit(time, deltas)
-                return
-            # multi-worker: each worker emits only its shard of the
-            # (identical) event script
-            owns = self.engine.owns_key
-            self.emit(time, [d for d in deltas if owns(d[0])])
+            if self.engine.coord.worker_count > 1:
+                # multi-worker: each worker emits only its shard of the
+                # (identical) event script
+                owns = self.engine.owns_key
+                deltas = [d for d in deltas if owns(d[0])]
+            if _provenance.ACTIVE:
+                _provenance.tracker().record_source(self, time, deltas)
+            self.emit(time, deltas)
 
 
 class InputQueueSource(Node):
@@ -857,6 +880,8 @@ class InputQueueSource(Node):
             if self.shard_filter and self.engine.worker_count > 1:
                 owns = self.engine.owns_key
                 deltas = [d for d in deltas if owns(d[0])]
+            if _provenance.ACTIVE:
+                _provenance.tracker().record_source(self, time, deltas)
             self.emit(time, deltas)
 
 

@@ -162,6 +162,17 @@ class Coordinator:
         """Pop every received query-span payload: [(origin, payload)]."""
         return []
 
+    def send_lineage(self, dest: int, origin: int, payload: Any) -> None:
+        """Ship a lineage-edge payload (internals/provenance.py) toward
+        one destination worker.  Same contract as qspans: fire-and-
+        forget, rides the per-peer FIFO, never counted toward
+        punctuation; same-process workers share one tracker, so the
+        default is a no-op."""
+
+    def take_lineage(self) -> list:
+        """Pop every received lineage payload: [(origin, payload)]."""
+        return []
+
     def close(self) -> None:
         pass
 
@@ -264,6 +275,9 @@ class TcpCoordinator(Coordinator):
         # received query-span payloads: [(origin, payload)] — bounded by
         # the drain in take_qspans(); capped defensively on receive
         self._qspans: list = []
+        # received lineage payloads (internals/provenance.py), same
+        # bounding discipline as _qspans
+        self._lineage: list = []
         # round -> {worker: payload}
         self._coord: Dict[int, Dict[int, Any]] = {}
         self._round = 0
@@ -561,6 +575,10 @@ class TcpCoordinator(Coordinator):
                         _, origin, payload = msg
                         if len(self._qspans) < 4096:  # drop, never grow
                             self._qspans.append((origin, payload))
+                    elif kind == "lineage":
+                        _, origin, payload = msg
+                        if len(self._lineage) < 4096:  # drop, never grow
+                            self._lineage.append((origin, payload))
                     elif kind == "coord":
                         _, round_no, payload = msg
                         if round_no == FENCE_ROUND:
@@ -647,6 +665,7 @@ class TcpCoordinator(Coordinator):
         for stamps in self._stamps.values():
             stamps.pop(peer, None)
         self._qspans = [q for q in self._qspans if q[0] != peer]
+        self._lineage = [q for q in self._lineage if q[0] != peer]
         for votes in self._coord.values():
             votes.pop(peer, None)
 
@@ -890,6 +909,16 @@ class TcpCoordinator(Coordinator):
     def take_qspans(self) -> list:
         with self._cv:
             out, self._qspans = self._qspans, []
+            return out
+
+    def send_lineage(self, dest: int, origin: int, payload: Any) -> None:
+        if dest == self.worker_id:
+            return
+        self._dispatch(dest, self._encode_frame(("lineage", origin, payload)))
+
+    def take_lineage(self) -> list:
+        with self._cv:
+            out, self._lineage = self._lineage, []
             return out
 
     def collect(self, channel: int, time: int, timeout: float = 600.0) -> list:
@@ -1362,6 +1391,19 @@ class _ThreadWorkerCoordinator(Coordinator):
         if g.tcp is None:
             return []
         return g.tcp.take_qspans()
+
+    def send_lineage(self, dest: int, origin: int, payload: Any) -> None:
+        g = self.group
+        dest_p, _dest_t = divmod(dest, g.threads)
+        if dest_p == g.process_id:
+            return  # same process: the provenance tracker is shared
+        g.tcp.send_lineage(dest_p, origin, payload)
+
+    def take_lineage(self) -> list:
+        g = self.group
+        if g.tcp is None:
+            return []
+        return g.tcp.take_lineage()
 
     def take_stamps(self, channel: int, time: int) -> dict:
         g = self.group

@@ -19,6 +19,7 @@ from pathway_tpu.engine.engine import Engine, Node
 from pathway_tpu.engine.operators import _DiffCache
 from pathway_tpu.engine.value import ERROR, Error, Pointer
 from pathway_tpu.internals import costledger as _costledger
+from pathway_tpu.internals import provenance as _provenance
 from pathway_tpu.internals import qtrace as _qtrace
 from pathway_tpu.internals import serving as _serving
 
@@ -262,6 +263,10 @@ class ExternalIndexNode(Node):
             gone = set(self.cache.emitted.keys()) - set(current.keys())
             for qk in gone:
                 self.cache.diff(qk, {}, out)
+        if _provenance.ACTIVE and out:
+            # served result row links back to its query key AND the index
+            # rows that scored it (row[0] = ranked match ids)
+            _provenance.tracker().record_knn(self, time, out)
         self.emit(time, out)
 
     def _timed_search(self, q_keys, values, ks, filters) -> List[List[tuple]]:

@@ -24,6 +24,7 @@ from pathway_tpu.engine.stream import (
     values_equal_tuple,
 )
 from pathway_tpu.engine.value import ERROR, Error, Pointer, ref_scalar
+from pathway_tpu.internals import provenance as _provenance
 
 
 class _DiffCache:
@@ -187,6 +188,8 @@ class JoinNode(Node):
         self._delta_side(
             right_deltas, right_jvs, self.right_index, self.left_index, False, out
         )
+        if _provenance.ACTIVE:
+            _provenance.tracker().record_join(self, time, out)
         self.emit(time, out)
 
     def process(self, time: int) -> None:
@@ -227,6 +230,8 @@ class JoinNode(Node):
                 for rk, rrow in rights.items():
                     new_rows[self._out_id(None, rk)] = (None, rk, *l_nones, *rrow)
             self.cache.diff(jv, new_rows, out)
+        if _provenance.ACTIVE:
+            _provenance.tracker().record_join(self, time, out)
         self.emit(time, out)
 
 
@@ -335,12 +340,17 @@ class ReduceNode(Node):
         per_reducer_args = [fn(keys, rows) for fn in self.args_fns]
         sort_vals = self.sort_fn(keys, rows) if self.sort_fn is not None else None
         affected: Set[Pointer] = set()
+        contrib: Optional[Dict[Any, list]] = (
+            {} if _provenance.ACTIVE else None
+        )
         for i, (key, values, diff) in enumerate(deltas):
             gkey, gvals = gks[i]
             if isinstance(gkey, Error):
                 self.log_error("Error value in groupby key")
                 continue
             affected.add(gkey)
+            if contrib is not None:
+                contrib.setdefault(_provenance.key_str(gkey), []).append(key)
             st = self.groups.get(gkey)
             if st is None:
                 st = self._new_group()
@@ -402,6 +412,8 @@ class ReduceNode(Node):
                         results.append(ERROR)
                 new_rows[gkey] = (*st.gvals(), *results)
             self.cache.diff(gkey, new_rows, out)
+        if contrib is not None:
+            _provenance.tracker().record_reduce(self, time, out, contrib)
         self.emit(time, out)
 
 
@@ -675,6 +687,7 @@ class FlattenNode(Node):
         self.rows_processed += len(deltas)
         self.batches_processed += 1
         out: List[Delta] = []
+        lineage: Optional[list] = [] if _provenance.ACTIVE else None
         for key, values, diff in deltas:
             seq = values[self.flat_idx]
             if isinstance(seq, Error):
@@ -708,6 +721,10 @@ class FlattenNode(Node):
                     values[: self.flat_idx] + (elem,) + values[self.flat_idx + 1 :]
                 )
                 out.append((new_key, new_row, diff))
+                if lineage is not None:
+                    lineage.append((new_key, key, diff))
+        if lineage is not None:
+            _provenance.tracker().record_flatten(self, time, lineage)
         self.emit(time, out)
 
 
@@ -1397,4 +1414,10 @@ class FusedChainNode(Node):
                 keys, values, diffs = nk, nv, nd
             else:
                 values = fn(keys, values)
-        self.emit(time, list(zip(keys, values, diffs)))
+        out = list(zip(keys, values, diffs))
+        if _provenance.ACTIVE:
+            # fusion must not lose lineage: the collapsed chain records
+            # endpoint identity edges tagged with its chain id (keys are
+            # unchanged through select/filter stages)
+            _provenance.tracker().record_fused(self, time, out)
+        self.emit(time, out)

@@ -31,6 +31,7 @@ from pathway_tpu.engine.engine import Engine, Node
 from pathway_tpu.engine.operators import FlattenNode
 from pathway_tpu.engine.stream import Delta
 from pathway_tpu.engine.value import Error, flatten_triples_batch
+from pathway_tpu.internals import provenance as _provenance
 
 # Flip to force the classic FlattenNode everywhere (tests / A-B benches).
 VECTOR_FLATTEN_ENABLED = True
@@ -111,6 +112,7 @@ class VectorFlattenNode(FlattenNode):
 
         idx = self.flat_idx
         # pass 1: extract elements per parent (classic branches)
+        lineage_keys = [] if _provenance.ACTIVE else None
         parent_vals: List[int] = []
         parent_rows: List[tuple] = []
         counts: List[int] = []
@@ -147,6 +149,8 @@ class VectorFlattenNode(FlattenNode):
             if not m:
                 continue
             parent_vals.append(key.value)
+            if lineage_keys is not None:
+                lineage_keys.append(key)
             parent_rows.append(values)
             counts.append(m)
             elems.extend(elements)
@@ -179,6 +183,17 @@ class VectorFlattenNode(FlattenNode):
         out: List[Delta] = flatten_triples_batch(
             buf, parent_rows, counts, elems, idx, diffs
         )
+        if lineage_keys is not None:
+            # element key -> parent key pairs, classic FlattenNode parity
+            pairs = []
+            i = 0
+            for p_idx, m in enumerate(counts):
+                pk = lineage_keys[p_idx]
+                d = diffs[p_idx]
+                for _ in range(m):
+                    pairs.append((out[i][0], pk, d))
+                    i += 1
+            _provenance.tracker().record_flatten(self, time, pairs)
         if pure_insert:
             # distinct (parent, position) pairs -> distinct derived keys:
             # nothing to cancel or sum, skip the consolidation pass

@@ -55,6 +55,7 @@ from pathway_tpu.engine.value import (
     join_triples_batch,
     pair_keys_from_pointers,
 )
+from pathway_tpu.internals import provenance as _provenance
 
 # Flip to force the classic JoinNode everywhere (tests / A-B benches).
 VECTOR_JOIN_ENABLED = True
@@ -180,6 +181,8 @@ class VectorJoinNode(JoinNode):
             out = join_triples_batch(lk, rk, lrow, rrow, diffs)
         if not out:
             return
+        if _provenance.ACTIVE:
+            _provenance.tracker().record_join(self, time, out)
         if retract:
             # retractions can cancel against same-batch insertions of the
             # same pair; route through the consolidating emit like the
@@ -284,4 +287,6 @@ class VectorJoinNode(JoinNode):
                         None, rkey, *l_nones, *rrow
                     )
             self.cache.diff(code, new_rows, out)
+        if _provenance.ACTIVE:
+            _provenance.tracker().record_join(self, time, out)
         self.emit(time, out)

@@ -36,6 +36,7 @@ import numpy as np
 from pathway_tpu.engine.engine import Engine, Node
 from pathway_tpu.engine.stream import Delta, values_equal_tuple
 from pathway_tpu.engine.value import ERROR, Error, Pointer
+from pathway_tpu.internals import provenance as _provenance
 
 VECTOR_REDUCERS = {"count", "sum", "min", "max", "avg", "any"}
 
@@ -618,6 +619,16 @@ class VectorReduceNode(Node):
             )
 
         affected = np.nonzero(occur)[0].tolist()
+        contrib = None
+        if _provenance.ACTIVE:
+            # lineage: the input delta keys that touched each group this
+            # batch (classic ReduceNode parity — see record_reduce)
+            ck = keys if kept_idx is None else [keys[i] for i in kept_idx]
+            contrib = {}
+            for i in range(len(codes)):
+                contrib.setdefault(
+                    _provenance.key_str(gkeys[int(codes[i])]), []
+                ).append(ck[i])
         out: List[Delta] = []
         out_append = out.append
         emitted = self.emitted
@@ -649,6 +660,8 @@ class VectorReduceNode(Node):
                 elif old is not None:
                     out_append((gkeys[g], old, -1))
                     emitted[g] = None
+            if contrib is not None:
+                _provenance.tracker().record_reduce(self, time, out, contrib)
             self.emit_consolidated(time, out)
             return
         for g in affected:
@@ -668,6 +681,8 @@ class VectorReduceNode(Node):
             elif old is not None:
                 out_append((gkeys[g], old, -1))
                 emitted[g] = None
+        if contrib is not None:
+            _provenance.tracker().record_reduce(self, time, out, contrib)
         # per-group retract-before-insert pairs are already minimal and
         # per-key ordered: skip the consolidation pass
         self.emit_consolidated(time, out)

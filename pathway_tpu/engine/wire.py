@@ -82,6 +82,12 @@ MSG_STAMP = 0x05
 # punctuation, rides the per-peer FIFO so spans for an epoch arrive
 # before the punctuation that completes it.
 MSG_QSPAN = 0x06
+# lineage-edge shipment (internals/provenance.py): u32 origin worker +
+# uvarint-length JSON blob of recorded backward-lineage edges, gathered
+# on worker 0 so `explain` sees the whole mesh.  Same contract as
+# MSG_QSPAN: Python-codec only, diagnostics-only, never counted toward
+# punctuation.
+MSG_LINEAGE = 0x07
 
 _pack_d = struct.Struct("<d")
 _pack_u32 = struct.Struct("<I")
@@ -561,6 +567,14 @@ def py_encode_message(msg: tuple) -> bytes:
         raw = _json.dumps(msg[2], separators=(",", ":")).encode("utf-8")
         _uvarint(out, len(raw))
         out += raw
+    elif kind == "lineage":
+        import json as _json
+
+        out.append(MSG_LINEAGE)
+        out += _pack_u32.pack(msg[1])
+        raw = _json.dumps(msg[2], separators=(",", ":")).encode("utf-8")
+        _uvarint(out, len(raw))
+        out += raw
     else:
         raise WireError(f"unknown message kind {kind!r}")
     return bytes(out)
@@ -611,6 +625,15 @@ def _py_decode_message(blob: bytes) -> tuple:
         except (UnicodeDecodeError, ValueError) as exc:
             raise WireError(f"bad qspan payload: {exc}") from None
         msg = ("qspan", origin, payload)
+    elif kind == MSG_LINEAGE:
+        import json as _json
+
+        origin = _pack_u32.unpack(r.take(4))[0]
+        try:
+            payload = _json.loads(r.take(r.uvarint()).decode("utf-8"))
+        except (UnicodeDecodeError, ValueError) as exc:
+            raise WireError(f"bad lineage payload: {exc}") from None
+        msg = ("lineage", origin, payload)
     else:
         raise WireError(f"unknown message type {kind}")
     if r.pos != r.end:
@@ -633,7 +656,7 @@ def _load_native():
 
 
 def encode_message(msg: tuple) -> bytes:
-    if msg[0] in ("stamp", "qspan"):
+    if msg[0] in ("stamp", "qspan", "lineage"):
         # newer than the native twin: pure-Python codec only
         return py_encode_message(msg)
     ext = _load_native()
@@ -643,7 +666,7 @@ def encode_message(msg: tuple) -> bytes:
 
 
 def decode_message(blob: bytes) -> tuple:
-    if blob and blob[0] in (MSG_STAMP, MSG_QSPAN):
+    if blob and blob[0] in (MSG_STAMP, MSG_QSPAN, MSG_LINEAGE):
         return py_decode_message(blob)
     ext = _load_native()
     if ext is not None:
@@ -663,7 +686,7 @@ def encode_frame(msg: tuple) -> bytes:
     """The full length-prefixed wire frame for `msg` in one buffer — the
     native path reserves the 4-byte length slot up front and patches it
     after the body lands, avoiding the `pack(n) + blob` concat copy."""
-    ext = None if msg[0] in ("stamp", "qspan") else _load_native()
+    ext = None if msg[0] in ("stamp", "qspan", "lineage") else _load_native()
     if ext is not None and hasattr(ext, "encode_frame"):
         return ext.encode_frame(msg)
     blob = encode_message(msg)

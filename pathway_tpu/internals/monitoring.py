@@ -423,7 +423,9 @@ class PrometheusServer:
     Routes: ``/metrics`` (and ``/``) — Prometheus exposition format;
     ``/status`` — JSON with graph topology, per-node p50/p99 latency,
     connector stats, and the flight-recorder tail per worker;
-    ``/qtrace`` — Chrome-trace JSON of recent query span trees."""
+    ``/qtrace`` — Chrome-trace JSON of recent query span trees;
+    ``/explain?key=...`` — backward lineage tree for one output key
+    (404 unless ``PATHWAY_PROVENANCE=1``)."""
 
     def __init__(self, engine, process_id: int = 0, port: int | None = None):
         self.engine = engine
@@ -510,6 +512,11 @@ class PrometheusServer:
         from pathway_tpu.internals.sanitizer import sanitizer_metrics
 
         add(sanitizer_metrics())
+        # record-level lineage (internals/provenance.py): edge store
+        # size/bytes, records, truncations, sampled fraction
+        from pathway_tpu.internals.provenance import provenance_metrics
+
+        add(provenance_metrics())
         return regs
 
     def metrics_text(self) -> str:
@@ -584,6 +591,7 @@ class PrometheusServer:
         from pathway_tpu.internals.health import health_status
         from pathway_tpu.internals.memtrack import memory_status
         from pathway_tpu.internals.mesh_backend import mesh_status
+        from pathway_tpu.internals.provenance import provenance_status
         from pathway_tpu.internals.qtrace import qtrace_status
         from pathway_tpu.internals.sanitizer import sanitizer_status
         from pathway_tpu.internals.serving import serving_status
@@ -642,6 +650,9 @@ class PrometheusServer:
             # consistency sanitizer (internals/sanitizer.py): invariant
             # check/violation counters, recent violations, certified UDFs
             "sanitizer": sanitizer_status(),
+            # record-level lineage (internals/provenance.py): edges
+            # stored, bytes, truncations, sampled fraction
+            "provenance": provenance_status(),
         }
 
     def _merged_freshness(self) -> list:
@@ -777,6 +788,29 @@ class PrometheusServer:
                         payload = qtrace.tracker().chrome_trace()
                     else:
                         payload, code = {"error": "qtrace disabled"}, 404
+                    body = json.dumps(payload, default=str).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/explain"):
+                    # backward lineage tree for one output key
+                    # (internals/provenance.py): /explain?key=<hex|^ptr>
+                    from urllib.parse import parse_qs, urlparse
+
+                    from pathway_tpu.internals import provenance
+
+                    qs = parse_qs(urlparse(self.path).query)
+                    key = (qs.get("key") or [""])[0]
+                    if not provenance.ACTIVE:
+                        payload, code = (
+                            {"error": "provenance disabled "
+                                      "(set PATHWAY_PROVENANCE=1)"},
+                            404,
+                        )
+                    elif not key:
+                        payload, code = (
+                            {"error": "missing key= query parameter"}, 400
+                        )
+                    else:
+                        payload = provenance.tracker().explain(key)
                     body = json.dumps(payload, default=str).encode()
                     ctype = "application/json"
                 else:

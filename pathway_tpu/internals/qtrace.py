@@ -577,6 +577,18 @@ class QueryTracer:
         exemplar["replica"] = replica
         exemplar["wall"] = rec["marks"].get("respond")
         exemplar["device_busy_s_30s"] = self.device_busy_s()
+        # slow-query exemplars carry the result row's lineage when the
+        # provenance tracker is armed — "why was THIS row slow AND where
+        # did it come from" in one /status read
+        from pathway_tpu.internals import provenance as _provenance
+
+        if _provenance.ACTIVE and rec.get("key") is not None:
+            try:
+                exemplar["lineage"] = _provenance.tracker().explain_brief(
+                    rec["key"]
+                )
+            except Exception:
+                pass
         self.exemplars.append(exemplar)
         self.recorder.record(
             "slow_query",
@@ -693,6 +705,7 @@ class QueryTracer:
                         "qid", "route", "tenant", "total_ms",
                         "slowest_stage", "stages_ms", "replica",
                         "threshold_ms", "wall", "device_busy_s_30s",
+                        "lineage",
                     )
                 }
                 for e in list(self.exemplars)[-8:]

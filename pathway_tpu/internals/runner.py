@@ -239,6 +239,16 @@ def run(
 
     _sanitizer.install_from_env()
 
+    # Arm the lineage tracker before the graph runs; non-zero processes
+    # ship their edges to worker 0 over MSG_LINEAGE for explain stitch.
+    from pathway_tpu.internals import provenance as _provenance
+
+    _provenance.install_from_env()
+    if _provenance.ACTIVE and cfg.processes > 1:
+        _provenance.tracker().attach_worker(
+            cfg.process_id * max(1, cfg.threads)
+        )
+
     # Reset the health controller's transient per-run state (drained
     # replicas, held backpressure) so one run's degradations never leak
     # into the next; action counters stay cumulative.

@@ -698,7 +698,7 @@ class ServingTier:
 
     @staticmethod
     def _note_cache_hits(keys: List[Any]) -> None:
-        from pathway_tpu.internals import costledger, qtrace
+        from pathway_tpu.internals import costledger, provenance, qtrace
 
         tenants: List[str] = []
         if qtrace.ENABLED:
@@ -708,6 +708,10 @@ class ServingTier:
             costledger.note_cache_hits(
                 tenants + [""] * (len(keys) - len(tenants))
             )
+        if provenance.ACTIVE:
+            # tag the served rows' lineage edges "knn:cache_hit" so
+            # explain distinguishes fresh scores from cache replays
+            provenance.tracker().note_cache_hits(keys)
 
     # -- lifecycle / status ------------------------------------------------
 

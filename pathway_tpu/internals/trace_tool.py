@@ -296,6 +296,15 @@ def render_status(status: dict) -> str:
                 f"  tenant {tenant}: tokens={tb.get('tokens')} "
                 f"rate={tb.get('rate')}/s burst={tb.get('burst')}"
             )
+    prov = status.get("provenance")
+    if prov and prov.get("enabled"):
+        line = (
+            f"provenance: edges={prov.get('edges')} "
+            f"bytes={prov.get('bytes')} "
+            f"truncations={prov.get('truncations')} "
+            f"sampled={prov.get('sampled_fraction')}"
+        )
+        lines.append(line)
     analysis = status.get("analysis")
     if analysis and analysis.get("findings"):
         lines.append(f"analysis findings: {len(analysis['findings'])}")
@@ -361,7 +370,19 @@ def render_top(status: dict) -> str:
     lines = ["pathway-tpu top — " + " ".join(head)]
 
     if not cost.get("enabled"):
+        # /status may lack the "cost" key entirely (PATHWAY_COSTLEDGER=0
+        # on an older job): render a full dashed frame, never crash or
+        # go blank — the dashboard stays useful for the headline fields
         lines.append("cost ledger disabled (PATHWAY_COSTLEDGER=0)")
+        lines.append(
+            f"{'WORKLOAD':<12}{'ROUTE':<18}{'TENANT':<14}"
+            f"{'DEV_S':>10}{'SHARE':>7}{'QUERIES':>9}{'DOCS':>8}"
+            f"{'BYTES':>10}"
+        )
+        lines.append(
+            f"{'-':<12}{'-':<18}{'-':<14}"
+            f"{'-':>10}{'-':>7}{'-':>9}{'-':>8}{'-':>10}"
+        )
         return "\n".join(lines)
     if not cost.get("active"):
         lines.append("cost ledger idle — no dataflow charged yet")
@@ -454,6 +475,87 @@ def main_top(args) -> int:
             time_mod.sleep(args.interval)
     except KeyboardInterrupt:
         return 0
+
+
+def render_explain(payload: dict) -> str:
+    """Terminal render of one /explain lineage tree: the retraction
+    story first, then the backward tree indented two spaces per hop,
+    each node listing its operator hops and source offsets."""
+    lines = [f"key {payload.get('key')}"]
+    if not payload.get("found"):
+        lines.append("  (no lineage recorded for this key)")
+        return "\n".join(lines)
+    for story in payload.get("retractions") or []:
+        lines.append(f"  {story}")
+
+    def _walk(node: dict, depth: int) -> None:
+        pad = "  " * (depth + 1)
+        label = node.get("key", "?")
+        ops = node.get("ops") or []
+        line = f"{pad}{label}"
+        if ops:
+            line += " <- " + ", ".join(ops)
+        if not node.get("found"):
+            line += " (source / untracked)"
+        lines.append(line)
+        offs = node.get("source_offsets")
+        if offs:
+            lines.append(
+                f"{pad}  source offsets: "
+                + ", ".join(str(o) for o in offs)
+            )
+        chains = node.get("chains")
+        if chains:
+            lines.append(f"{pad}  fused chains: " + ", ".join(chains))
+        if node.get("truncated"):
+            lines.append(f"{pad}  ... (tree truncated)")
+        for child in node.get("inputs") or []:
+            _walk(child, depth + 1)
+
+    tree = payload.get("tree")
+    if tree:
+        _walk(tree, 0)
+    return "\n".join(lines)
+
+
+def main_explain(args) -> int:
+    """Entry point for the cli.py `explain` subcommand: fetch the
+    backward lineage tree of one output key from a RUNNING job
+    (``/explain?key=...``; requires PATHWAY_PROVENANCE=1 on the job)
+    and render it as an indented tree (or raw JSON with ``--json``)."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    base = args.url or f"http://127.0.0.1:{args.port}"
+    url = (
+        base.rstrip("/")
+        + "/explain?"
+        + urllib.parse.urlencode({"key": args.key})
+    )
+    try:
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            payload = json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        try:
+            payload = json.loads(exc.read().decode())
+        except Exception:  # noqa: BLE001
+            payload = {"error": str(exc)}
+    except Exception as exc:  # noqa: BLE001 — connection refused etc.
+        print(
+            f"error: could not reach {url}: {exc} — is the job running "
+            "with pw.run(with_http_server=True)?",
+            file=sys.stderr,
+        )
+        return 1
+    if payload.get("error"):
+        print(f"error: {payload['error']}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        print(render_explain(payload))
+    return 0
 
 
 def main_restart(args) -> int:

@@ -1456,6 +1456,102 @@ def test_sanitizer_armed_idle_overhead_under_5pct():
     )
 
 
+def test_provenance_armed_idle_overhead_under_5pct(monkeypatch):
+    """PATHWAY_PROVENANCE=1 with the sample stride past every bench
+    epoch: rowwise maps record no edges by design and the source hook
+    bails at the sampling check, so the armed-idle cost is the ACTIVE
+    attribute read per hook site plus per-tick sampling/epoch
+    bookkeeping.  That must stay under 5% on the engine microbench loop
+    — same min-of-N interleaved protocol as the sanitizer guard above.
+    (The cost of actually RECORDING lineage is the measured, sampling-
+    controllable number `engine_bench --provenance` and bench.py's
+    `provenance_overhead` key report — not a guarded invariant.)"""
+    import gc
+    from time import perf_counter
+
+    from pathway_tpu.engine.engine import InputQueueSource, RowwiseNode
+    from pathway_tpu.internals import provenance
+
+    monkeypatch.setenv("PATHWAY_PROVENANCE_SAMPLE", "1000000007")
+    ROWS, TICKS, REPS = 512, 40, 5
+    deltas = [(ref_scalar("k", i), (i,), 1) for i in range(ROWS)]
+
+    def ident(keys, cols):
+        return cols[0]
+
+    def run_once(armed: bool) -> float:
+        provenance.clear()
+        if armed:
+            provenance.install()
+        eng = Engine(metrics=False)
+        src = InputQueueSource(eng)
+        node = src
+        for _ in range(3):
+            node = RowwiseNode(eng, [node], ident)
+        try:
+            time = 2
+            for _ in range(8):  # warmup
+                src.push(time, deltas)
+                eng.process_time(time)
+                time += 2
+            t0 = perf_counter()
+            for _ in range(TICKS):
+                src.push(time, deltas)
+                eng.process_time(time)
+                time += 2
+            return perf_counter() - t0
+        finally:
+            eng._gc_unfreeze()
+
+    ratios = []
+    gc.collect()
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        run_once(True), run_once(False)  # warmup
+        for _ in range(REPS):
+            ratios.append(run_once(True) / run_once(False))
+    finally:
+        provenance.clear()
+        if gc_was_enabled:
+            gc.enable()
+    ratio = min(ratios)
+    assert ratio < 1.05, (
+        f"provenance armed-idle overhead {ratio:.3f}x (pair ratios "
+        f"{[round(r, 3) for r in ratios]})"
+    )
+
+
+@pytest.mark.perf_smoke
+def test_provenance_disabled_is_single_attribute_read():
+    """PATHWAY_PROVENANCE unset/0: importing the module must not create
+    the tracker; every engine hook is gated on the ACTIVE module
+    attribute, and the status/metrics surfaces short-circuit without
+    materializing the singleton."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import sys;"
+        "from pathway_tpu.internals import provenance;"
+        "provenance.install_from_env();"
+        "assert provenance.ACTIVE is False;"
+        "assert provenance._TRACKER is None;"
+        "assert provenance.provenance_status() == {'enabled': False};"
+        "assert provenance.provenance_metrics() is None;"
+        "assert provenance._TRACKER is None, 'surfaces instantiated it';"
+        "assert 'jax' not in sys.modules, 'provenance pulled in jax'"
+    )
+    env = dict(os.environ)
+    env["PATHWAY_PROVENANCE"] = "0"
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
 @pytest.mark.perf_smoke
 def test_sanitizer_disabled_is_single_attribute_read():
     """PATHWAY_SANITIZE unset/0: importing the module must not create

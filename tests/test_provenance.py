@@ -1,0 +1,725 @@
+"""Record-level provenance & lineage (internals/provenance.py) — tier 1.
+
+The contract under test, per layer:
+
+  * store: bounded edge accounting (base + per-input bytes), oldest-epoch
+    eviction under PATHWAY_PROVENANCE_BUDGET_BYTES with a
+    ``provenance_truncated`` flight event, PATHWAY_PROVENANCE_SAMPLE
+    epoch striding;
+  * hooks: sources stamp per-connector row offsets, joins link both
+    sides, groupbys link the delta keys that touched the group, flatten
+    links elements to parents, KNN links results to query + index rows
+    (cache hits tagged), and fused chains record tagged identity edges
+    that NEVER add tree levels — explain(fused) == explain(classic);
+  * transport: MSG_LINEAGE frames (wire codec + a real TCP pair) gather
+    non-zero workers' edges onto worker 0;
+  * surfaces: engine.explain / /explain?key= / `pathway-tpu explain`,
+    the "provenance" /status key, pathway_provenance_* metrics, qtrace
+    slow-query exemplars;
+  * the default: disabled means one module-attribute read and no jax
+    import (subprocess-proven), and PWT10xx only fires when armed.
+
+Plus the satellite CLI regressions: `top` renders a dashed frame when
+/status lacks "cost" entirely, and `status --json` is a raw passthrough.
+
+NOTE on string keys in store-level tests: explain() canonicalizes
+hex-parseable strings to 32-hex, so synthetic keys here always contain
+a non-hex letter ("k0", "q1", "out2") to stay identity-stable.
+"""
+
+import argparse
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time as time_mod
+import urllib.error
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine import wire
+from pathway_tpu.engine.engine import Engine, InputQueueSource
+from pathway_tpu.engine.value import ref_scalar
+from pathway_tpu.internals import provenance
+from pathway_tpu.internals import trace_tool
+from pathway_tpu.internals.provenance import (
+    _EDGE_BASE_BYTES,
+    _EDGE_INPUT_BYTES,
+    key_str,
+)
+from pathway_tpu.internals.runner import run_tables
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    provenance.clear()
+    yield
+    provenance.clear()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# store: edge accounting, canonical identity
+# ---------------------------------------------------------------------------
+
+
+def test_edge_accounting_and_status_counters():
+    provenance.install()
+    tr = provenance.tracker()
+    tr.record_edges(
+        "op#1", 0, [("out_k1", ("in_ka", "in_kb"), 1), ("out_k2", (), -1)]
+    )
+    st = tr.status()
+    assert st["enabled"] is True
+    assert st["edges"] == 2 and st["keys"] == 2 and st["records"] == 2
+    assert st["bytes"] == 2 * _EDGE_BASE_BYTES + 2 * _EDGE_INPUT_BYTES
+    assert st["truncations"] == 0 and st["edges_evicted"] == 0
+    # None inputs (outer-join pads) are dropped, not stored
+    tr.record_edges("op#1", 0, [("out_k3", ("in_ka", None), 1)])
+    edges = tr._edges[key_str("out_k3")]
+    assert edges[0][2] == ("in_ka",)
+
+
+def test_key_identity_is_full_hex_value_and_canon_round_trips():
+    k = ref_scalar("some", "row")
+    ks = key_str(k)
+    assert ks == format(k.value, "032x") and len(ks) == 32
+    provenance.install()
+    tr = provenance.tracker()
+    tr.record_edges("op#1", 0, [(k, (), 1)])
+    # every spelling the surfaces print resolves to the same row: the
+    # Pointer, the raw 128-bit int, the 32-hex string, the ^-prefixed
+    # (possibly truncated-looking) repr of the full value
+    for spelling in (k, k.value, ks, "^" + ks.upper()):
+        assert tr.explain(spelling)["found"], spelling
+
+
+def test_disabled_surfaces_without_instantiating_tracker():
+    assert provenance.ACTIVE is False
+    assert provenance.provenance_status() == {"enabled": False}
+    assert provenance.provenance_metrics() is None
+    assert provenance._TRACKER is None
+    eng = Engine(metrics=False)
+    out = eng.explain(ref_scalar("x"))
+    assert out["found"] is False and "disabled" in out["error"]
+    assert provenance._TRACKER is None
+
+
+# ---------------------------------------------------------------------------
+# end to end: wordcount, join, flatten reach source offsets
+# ---------------------------------------------------------------------------
+
+
+def _wordcount():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(word=str), [("a",), ("b",), ("a",)]
+    )
+    return t.groupby(t.word).reduce(t.word, n=pw.reducers.count())
+
+
+def _leaf_offsets(node, acc):
+    acc.extend(node.get("source_offsets", ()))
+    for child in node.get("inputs", ()):
+        _leaf_offsets(child, acc)
+    return acc
+
+
+def test_wordcount_explain_reaches_source_offsets():
+    provenance.install()
+    (cap,) = run_tables(_wordcount(), record_stream=True)
+    rows = cap.state.rows
+    key_a = next(k for k, r in rows.items() if r[0] == "a")
+    exp = cap.engine.explain(key_a)
+    assert exp["found"]
+    assert exp["tree"]["ops"][0].startswith("reduce")
+    # 'a' came from source rows 0 and 2; 'b' from row 1 — exactly
+    assert _leaf_offsets(exp["tree"], []) == [0, 2]
+    (story,) = exp["retractions"]
+    assert story.startswith("emitted at epoch")
+    assert story.endswith("via input offsets 0, 2")
+    key_b = next(k for k, r in rows.items() if r[0] == "b")
+    assert _leaf_offsets(cap.engine.explain(key_b)["tree"], []) == [1]
+    st = provenance.tracker().status()
+    (n_rows,) = st["sources"].values()
+    assert n_rows == 3 and st["edges"] > 0
+
+
+def test_join_explain_links_both_sides_to_their_sources():
+    provenance.install()
+    left = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, a=int), [("x", 1), ("y", 2)]
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, b=int), [("x", 10)]
+    )
+    j = left.join(right, left.k == right.k).select(pw.left.a, pw.right.b)
+    (cap,) = run_tables(j, record_stream=True)
+    assert sorted(cap.state.rows.values()) == [(1, 10)]
+    (key,) = cap.state.rows
+    exp = provenance.tracker().explain(key)
+    assert exp["found"]
+    # the join edge carries (left_key, right_key); debug tables key rows
+    # positionally so the two sides may share a pointer — what must hold
+    # is that the children trace to BOTH source connectors at offset 0
+    children = exp["tree"]["inputs"]
+    assert 1 <= len(children) <= 2
+    source_hits = {}
+    for child in children:
+        assert child["found"]
+        for entry in child["history"]:
+            source_hits.setdefault(entry["op"], set()).add(entry["offset"])
+    assert len(source_hits) == 2
+    assert all(0 in offs for offs in source_hits.values())
+    srcs = provenance.tracker().status()["sources"]
+    assert len(srcs) == 2
+
+
+def test_flatten_explain_links_elements_to_parent_rows():
+    provenance.install()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str), [("a",), ("b",)]
+    ).select(
+        k=pw.this.k,
+        parts=pw.apply_with_type(
+            lambda s: (s, s + "!"), tuple, pw.this.k
+        ),
+    )
+    flat = t.flatten(t.parts)
+    (cap,) = run_tables(flat, record_stream=True)
+    assert len(cap.state.rows) == 4
+    for key, row in cap.state.rows.items():
+        exp = provenance.tracker().explain(key)
+        assert exp["found"], row
+        assert exp["tree"]["ops"][0].startswith("flatten")
+        want = 0 if row[-1].startswith("a") else 1
+        assert _leaf_offsets(exp["tree"], []) == [want], row
+
+
+# ---------------------------------------------------------------------------
+# fused chains: lineage parity, annotations never traverse
+# ---------------------------------------------------------------------------
+
+
+def _fusable_wordcount():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int),
+        [("a", 3), ("b", -1), ("a", 5)],
+    )
+    s1 = t.select(k=t.k, v=t.v * 2)
+    s2 = s1.filter(s1.v > 0)
+    s3 = s2.select(k=s2.k, v=s2.v)
+    return s3.groupby(s3.k).reduce(s3.k, n=pw.reducers.count())
+
+
+def _normalize(payload):
+    """Node indices shift between the fused and classic builds (a chain
+    collapses three nodes into one), so operator labels normalize to
+    their kind — keys, epochs, diffs, offsets, and tree shape must match
+    exactly."""
+    import re
+
+    return json.loads(re.sub(r"#\d+", "", json.dumps(payload)))
+
+
+def test_fused_and_classic_builds_yield_identical_explain_trees(monkeypatch):
+    counts = _fusable_wordcount()
+
+    monkeypatch.setenv("PATHWAY_DISABLE_FUSION", "1")
+    provenance.install()
+    (classic,) = run_tables(counts, record_stream=True)
+    key = next(k for k, r in classic.state.rows.items() if r[0] == "a")
+    exp_classic = provenance.tracker().explain(key)
+    brief_classic = provenance.tracker().explain_brief(key)
+    assert "chain:" not in json.dumps(
+        provenance.tracker().explain(key, include_chains=True)
+    )
+
+    provenance.clear()
+    monkeypatch.setenv("PATHWAY_DISABLE_FUSION", "0")
+    provenance.install()
+    (fused,) = run_tables(counts, record_stream=True)
+    assert fused.engine.fused_chains, "chain was not fused"
+    assert fused.state.rows == classic.state.rows
+
+    # the tentpole invariant: fusion must not lose (or reshape) lineage
+    exp_fused = provenance.tracker().explain(key)
+    assert _normalize(exp_fused) == _normalize(exp_classic)
+    assert exp_fused["found"]
+    assert _leaf_offsets(exp_fused["tree"], []) == [0, 2]
+    assert _normalize(provenance.tracker().explain_brief(key)) == \
+        _normalize(brief_classic)
+    # the chain IS visible on request, as an annotation on the endpoint
+    # keys — never as an extra tree level
+    annotated = provenance.tracker().explain(key, include_chains=True)
+    assert "chain:" in json.dumps(annotated)
+    strip = _normalize(annotated)
+
+    def _drop(node):
+        node.pop("chains", None)
+        for c in node.get("inputs", ()):
+            _drop(c)
+
+    _drop(strip["tree"])
+    assert strip == _normalize(exp_classic)
+
+
+# ---------------------------------------------------------------------------
+# retraction history under a delete/update stream
+# ---------------------------------------------------------------------------
+
+
+def test_retraction_history_under_update_and_delete():
+    provenance.install()
+    eng = Engine(metrics=False)
+    src = InputQueueSource(eng)
+    k = ref_scalar("chaos", 1)
+    src.push(2, [(k, ("v1",), 1)])
+    eng.process_time(2)
+    # update = retract old + emit new, then a final delete
+    src.push(4, [(k, ("v1",), -1), (k, ("v2",), 1)])
+    eng.process_time(4)
+    src.push(6, [(k, ("v2",), -1)])
+    eng.process_time(6)
+    exp = eng.explain(k)
+    assert exp["found"]
+    story = exp["retractions"]
+    assert len(story) == 4
+    assert story[0].startswith("emitted at epoch 2")
+    assert "(input offset 0)" in story[0]
+    assert story[1].startswith("retracted at epoch 4")
+    assert story[2].startswith("emitted at epoch 4")
+    assert story[3].startswith("retracted at epoch 6")
+    assert "(input offset 3)" in story[3]
+    # the full emit/retract ledger rides the tree node too
+    assert [h["diff"] for h in exp["tree"]["history"]] == [1, -1, 1, -1]
+    assert exp["tree"]["source_offsets"] == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# budget eviction + sampling
+# ---------------------------------------------------------------------------
+
+
+def test_budget_evicts_oldest_epoch_and_records_flight_event(monkeypatch):
+    # 3 inputless edges/epoch = 480 bytes/epoch against a 600-byte
+    # budget: epoch 1's arrival forces epoch 0 out, exactly once
+    monkeypatch.setenv("PATHWAY_PROVENANCE_BUDGET_BYTES", "600")
+    provenance.install()
+    tr = provenance.tracker()
+    assert tr.budget_bytes == 600
+    tr.record_edges("op#1", 0, [(f"old_k{i}", (), 1) for i in range(3)])
+    assert tr.truncations == 0
+    tr.record_edges("op#1", 1, [(f"new_k{i}", (), 1) for i in range(3)])
+    st = tr.status()
+    assert st["truncations"] == 1 and st["edges_evicted"] == 3
+    assert st["edges"] == 3 and st["bytes"] == 3 * _EDGE_BASE_BYTES
+    assert not tr.explain("old_k0")["found"]
+    assert tr.explain("new_k0")["found"]
+    (event,) = st["flight_recorder"]
+    assert event["kind"] == "provenance_truncated"
+    assert event["name"] == "evicted epoch 0" and event["rows"] == 3
+
+
+def test_sample_stride_skips_odd_epochs(monkeypatch):
+    monkeypatch.setenv("PATHWAY_PROVENANCE_SAMPLE", "2")
+    provenance.install()
+    tr = provenance.tracker()
+    assert tr.sample_every == 2
+    for epoch in range(4):
+        tr.record_edges("op#1", epoch, [(f"sk{epoch}", (), 1)])
+    assert tr.explain("sk0")["found"] and tr.explain("sk2")["found"]
+    assert not tr.explain("sk1")["found"]
+    assert tr.edges_stored == 2
+
+    class _Eng:
+        current_time = 0
+        coord = None
+
+    for epoch in range(4):
+        _Eng.current_time = epoch
+        tr.on_tick(_Eng)
+    st = tr.status()
+    assert st["sample_every"] == 2 and st["sampled_fraction"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# KNN / serving: query + index-row inputs, cache-hit tagging
+# ---------------------------------------------------------------------------
+
+
+def test_knn_edges_link_query_to_index_rows_and_tag_cache_hits():
+    provenance.install()
+    tr = provenance.tracker()
+
+    class _Node:
+        name = "knn"
+        _idx = 7
+
+    tr.note_cache_hits(["q1"])
+    out = [
+        ("q1", (("m1", "m2"), (0.9, 0.8)), 1),
+        ("q2", (("m1",), (0.7,)), 1),
+    ]
+    tr.record_knn(_Node(), 5, out)
+    hit = tr.explain_brief("q1")
+    assert hit["tags"] == ["knn:cache_hit"] and hit["ops"] == ["knn#7"]
+    miss = tr.explain_brief("q2")
+    assert miss["tags"] == ["knn"]
+    # result rows link back to the query key and the scoring index rows
+    (entry,) = tr.explain("q2")["tree"]["history"]
+    assert entry["inputs"] == ["q2", "m1"]
+    # the hit set is consumed: the same key served again is a plain edge
+    tr.record_knn(_Node(), 6, [("q1", (("m3",), (0.5,)), 1)])
+    assert tr.explain_brief("q1")["tags"] == ["knn:cache_hit", "knn"]
+
+
+# ---------------------------------------------------------------------------
+# cross-worker: wire codec, flush/absorb, a real TCP pair
+# ---------------------------------------------------------------------------
+
+
+def test_lineage_codec_round_trip():
+    payload = {"edges": [["00ab", "reduce#3", 7, ["00cd", "00ef"], -1, None]]}
+    msg = ("lineage", 2, payload)
+    blob = wire.encode_message(msg)
+    assert blob[0] == wire.MSG_LINEAGE
+    assert wire.decode_message(blob) == msg
+    with pytest.raises((wire.WireError, ValueError)):
+        wire.py_decode_message(blob[: len(blob) // 2])
+
+
+def test_nonzero_worker_flushes_edges_that_worker0_absorbs():
+    provenance.install()
+    w1 = provenance.tracker()
+    w1.attach_worker(1)
+
+    class _Node:
+        name = "input"
+        _idx = 0
+
+    k = ref_scalar("w1", "row")
+    w1.record_source(_Node(), 0, [(k, ("v",), 1)])
+
+    sent = []
+
+    class _Coord:
+        def send_lineage(self, dest, origin, payload):
+            sent.append((dest, origin, payload))
+
+        def take_lineage(self):
+            return []
+
+    class _Eng:
+        current_time = 0
+
+        def __init__(self, coord):
+            self.coord = coord
+
+    w1.on_tick(_Eng(_Coord()))
+    ((dest, origin, payload),) = sent
+    assert dest == 0 and origin == 1 and payload["edges"]
+    # the buffer drains: a second tick ships nothing
+    w1.on_tick(_Eng(_Coord()))
+    assert len(sent) == 1
+
+    # worker 0 stitches the shipped edges into its own store
+    provenance.clear()
+    provenance.install()
+    w0 = provenance.tracker()
+
+    class _Coord0:
+        def __init__(self, payloads):
+            self._p = payloads
+
+        def take_lineage(self):
+            p, self._p = self._p, []
+            return p
+
+    class _Eng0:
+        current_time = 0
+
+        def __init__(self):
+            self.coord = _Coord0([(1, payload)])
+
+    w0.on_tick(_Eng0())
+    exp = w0.explain(k)
+    assert exp["found"]
+    assert exp["tree"]["source_offsets"] == [0]
+    assert "(input offset 0)" in exp["retractions"][0]
+
+
+def test_lineage_merge_over_real_tcp_pair():
+    """2-worker TCP acceptance: worker 1's MSG_LINEAGE frame crosses a
+    real socket pair and lands in worker 0's take_lineage()."""
+    from pathway_tpu.engine.exchange import TcpCoordinator
+
+    from _fakes import free_port_base
+
+    port = free_port_base(2)
+    coords = {}
+
+    def start(worker_id):
+        coords[worker_id] = TcpCoordinator(
+            worker_id, 2, port, run_id="lineagetest", connect_timeout=10
+        )
+
+    threads = [
+        threading.Thread(target=start, args=(w,), daemon=True)
+        for w in (0, 1)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert set(coords) == {0, 1}
+    try:
+        payload = {
+            "edges": [["00ab", "join#2", 4, ["00cd"], 1, "offset:3"]]
+        }
+        coords[1].send_lineage(0, 1, payload)
+        deadline = time_mod.monotonic() + 10
+        got = []
+        while time_mod.monotonic() < deadline and not got:
+            got = coords[0].take_lineage()
+            if not got:
+                time_mod.sleep(0.05)
+        assert got == [(1, payload)]
+        # sending to yourself is a no-op, not a loopback frame
+        coords[0].send_lineage(0, 0, payload)
+        assert coords[0].take_lineage() == []
+    finally:
+        coords[0].close()
+        coords[1].close()
+
+
+# ---------------------------------------------------------------------------
+# surfaces: /explain + /status + /metrics + the CLI, qtrace exemplars
+# ---------------------------------------------------------------------------
+
+
+def test_http_explain_status_metrics_and_cli(capsys):
+    from pathway_tpu.internals.monitoring import PrometheusServer
+
+    provenance.install()
+    (cap,) = run_tables(_wordcount(), record_stream=True)
+    key = next(k for k, r in cap.state.rows.items() if r[0] == "a")
+    ks = format(key.value, "032x")
+    server = PrometheusServer(cap.engine, port=_free_port())
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(
+            f"{base}/explain?key={ks}", timeout=5
+        ) as r:
+            payload = json.loads(r.read().decode())
+        assert payload["found"] and payload["key"] == ks
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(base + "/explain", timeout=5)
+        assert exc_info.value.code == 400
+        with urllib.request.urlopen(base + "/status", timeout=5) as r:
+            status = json.loads(r.read().decode())
+        prov = status["provenance"]
+        assert prov["enabled"] is True and prov["edges"] > 0
+        assert "provenance:" in trace_tool.render_status(status)
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "pathway_provenance_edges" in text
+        assert "pathway_provenance_records_total" in text
+
+        # the CLI against the live endpoint: tree render, then raw JSON
+        args = argparse.Namespace(url=base, port=None, key=ks, json=False)
+        assert trace_tool.main_explain(args) == 0
+        out = capsys.readouterr().out
+        assert f"key {ks}" in out
+        assert "via input offsets 0, 2" in out
+        assert "source offsets: 0" in out and "source offsets: 2" in out
+        args.json = True
+        assert trace_tool.main_explain(args) == 0
+        assert json.loads(capsys.readouterr().out)["found"] is True
+    finally:
+        server.stop()
+
+
+def test_http_explain_404_when_disabled():
+    from pathway_tpu.internals.monitoring import PrometheusServer
+
+    (cap,) = run_tables(_wordcount(), record_stream=True)
+    provenance.clear()
+    server = PrometheusServer(cap.engine, port=_free_port())
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(base + "/explain?key=00ab", timeout=5)
+        assert exc_info.value.code == 404
+        assert "disabled" in json.loads(exc_info.value.read().decode())[
+            "error"
+        ]
+        with urllib.request.urlopen(base + "/status", timeout=5) as r:
+            status = json.loads(r.read().decode())
+        assert status["provenance"] == {"enabled": False}
+    finally:
+        server.stop()
+
+
+def test_render_explain_handles_missing_lineage():
+    out = trace_tool.render_explain({"key": "deadk", "found": False})
+    assert "no lineage recorded" in out
+    payload = {
+        "key": "rootk",
+        "found": True,
+        "retractions": ["emitted at epoch 0 by reduce#1"],
+        "tree": {
+            "key": "rootk",
+            "found": True,
+            "ops": ["reduce#1"],
+            "inputs": [{"key": "leafk", "found": False}],
+            "truncated": True,
+        },
+    }
+    out = trace_tool.render_explain(payload)
+    assert "emitted at epoch 0 by reduce#1" in out
+    assert "<- reduce#1" in out
+    assert "(source / untracked)" in out and "tree truncated" in out
+
+
+def test_slow_query_exemplars_carry_lineage():
+    from pathway_tpu.internals.qtrace import QueryTracer
+
+    provenance.install()
+    provenance.tracker().record_edges(
+        "knn#3", 1, [("qslow", ("idx_k",), 1)], tag="knn"
+    )
+    tq = QueryTracer()
+    tq.set_slo(0.0001)  # everything is an exemplar
+    assert tq.begin("q1", route="/v1/retrieve", key="qslow")
+    time_mod.sleep(0.002)
+    tq.finish("q1")
+    (ex,) = tq.status()["exemplars"]
+    assert ex["lineage"]["ops"] == ["knn#3"]
+    assert ex["lineage"]["tags"] == ["knn"]
+
+
+# ---------------------------------------------------------------------------
+# the thirteenth pass: PWT1001 / PWT1099
+# ---------------------------------------------------------------------------
+
+
+def _opaque_graph():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(1,), (2,), (2,)]
+    )
+    return t.deduplicate(value=pw.this.v, acceptor=lambda new, old: new > old)
+
+
+def test_pwt1001_flags_lineage_opaque_operator_when_armed():
+    from pathway_tpu.analysis import analyze
+
+    provenance.install()
+    result = analyze(extra_tables=(_opaque_graph(),))
+    hits = [f for f in result.findings if f.code == "PWT1001"]
+    assert hits and hits[0].details["kind"] == "deduplicate"
+    assert not [f for f in result.findings if f.code == "PWT1099"]
+
+
+def test_pwt1099_errors_when_explain_is_required(monkeypatch):
+    from pathway_tpu.analysis import analyze
+    from pathway_tpu.analysis.diagnostics import Severity
+
+    provenance.install()
+    monkeypatch.setenv("PATHWAY_PROVENANCE_REQUIRE", "1")
+    result = analyze(extra_tables=(_opaque_graph(),))
+    (hit,) = [f for f in result.findings if f.code == "PWT1099"]
+    assert hit.severity is Severity.ERROR
+    assert hit.details["kinds"] == ["deduplicate"]
+
+
+def test_provenance_pass_is_silent_when_disarmed():
+    from pathway_tpu.analysis import analyze
+
+    result = analyze(extra_tables=(_opaque_graph(),))
+    assert not [
+        f for f in result.findings if f.code.startswith("PWT10")
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the default: disabled = one attribute read, never imports jax
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_path_is_inert_in_a_fresh_process():
+    code = (
+        "import sys\n"
+        "from pathway_tpu.internals import provenance\n"
+        "assert provenance.ACTIVE is False\n"
+        "assert provenance._TRACKER is None\n"
+        "assert provenance.provenance_status() == {'enabled': False}\n"
+        "assert provenance.provenance_metrics() is None\n"
+        "assert provenance._TRACKER is None\n"
+        "assert 'jax' not in sys.modules\n"
+    )
+    env = {"PATH": "/usr/bin:/bin", "PATHWAY_PROVENANCE": "0"}
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert res.returncode == 0, res.stderr
+
+
+# ---------------------------------------------------------------------------
+# satellite CLI regressions: `top` without "cost", `status --json`
+# ---------------------------------------------------------------------------
+
+
+def test_top_renders_dashed_frame_when_status_lacks_cost_key():
+    frame = trace_tool.render_top({"worker_count": 2})
+    assert "workers=2" in frame
+    assert "cost ledger disabled" in frame
+    assert "WORKLOAD" in frame and "TENANT" in frame
+    # a full dashed row, one dash per column — never a crash or a blank
+    assert any(
+        line.count("-") == 8 and set(line.strip()) == {"-", " "}
+        for line in frame.splitlines()
+    )
+
+
+def test_top_once_exits_zero_without_cost_key(monkeypatch, capsys):
+    monkeypatch.setattr(
+        trace_tool, "fetch_status", lambda url, timeout=5.0: {
+            "worker_count": 1
+        }
+    )
+    args = argparse.Namespace(
+        url=None, port=20000, once=True, iterations=1, interval=0.01
+    )
+    assert trace_tool.main_top(args) == 0
+    out = capsys.readouterr().out
+    assert "cost ledger disabled" in out and "WORKLOAD" in out
+
+
+def test_status_json_is_a_raw_passthrough(monkeypatch, capsys):
+    payload = {
+        "worker_count": 1,
+        "provenance": {"enabled": False},
+        "queries": {"enabled": False},
+    }
+    monkeypatch.setattr(
+        trace_tool, "fetch_status", lambda url, timeout=5.0: payload
+    )
+    args = argparse.Namespace(url=None, port=20000, json=True)
+    assert trace_tool.main_status(args) == 0
+    assert json.loads(capsys.readouterr().out) == payload
